@@ -1,0 +1,29 @@
+"""lightctr_tpu — a TPU-native (JAX/XLA/pjit/Pallas) CTR & ML framework.
+
+A from-scratch re-design of the capabilities of cnkuangshi/LightCTR
+(reference layer map in SURVEY.md §1) for TPU hardware:
+
+  - the reference's AVX kernel layer (``common/avx.h``) -> XLA fusion + Pallas
+  - ThreadPool/Barrier row-parallelism -> batched, jitted SPMD programs
+  - ZeroMQ Delivery / ParamServer / Ring-AllReduce -> ``jax.sharding`` meshes
+    with ICI collectives (psum / reduce_scatter / all_gather / all_to_all)
+  - fp16 wire codec -> native bfloat16 precision policies
+  - hand-written VJPs (``dag/operator``) -> ``jax.grad`` plus a thin graph API
+
+Subpackages
+-----------
+core    meshes, precision policy, config, RNG
+ops     activations, losses, metrics (AUC), quantization codecs
+optim   SGD / Adagrad / RMSprop / Adadelta / Adam / FTRL / DCASGD transforms
+nn      dense, conv, pooling, adapter, VAE sample, LSTM, attention modules
+models  FM, FFM, NFM, Wide&Deep, CNN, RNN, VAE, word2vec, GBM, GMM, PLSA, ANN
+embed   sharded embedding tables (the parameter-server capability)
+dist    data-parallel & collective utilities, multi-host bootstrap
+data    libFFM / dense CSV loaders with host sharding
+ckpt    orbax-backed checkpoint / resume
+cli     single entry point replacing the reference's ``-D`` ifdef tree
+"""
+
+__version__ = "0.1.0"
+
+from lightctr_tpu.core.config import TrainConfig  # noqa: F401

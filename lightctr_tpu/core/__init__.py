@@ -1,0 +1,12 @@
+from lightctr_tpu.core.config import TrainConfig
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh, local_mesh
+from lightctr_tpu.core.precision import Policy, DEFAULT_POLICY
+
+__all__ = [
+    "TrainConfig",
+    "MeshSpec",
+    "make_mesh",
+    "local_mesh",
+    "Policy",
+    "DEFAULT_POLICY",
+]

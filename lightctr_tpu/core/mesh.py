@@ -1,0 +1,92 @@
+"""Device meshes and sharding helpers.
+
+The reference's parallel topology is assembled by hand: a Master assigns node
+ids and broadcasts peer addresses (``distribut/master.h:146-190``), workers
+talk to parameter-server shards chosen by a consistent-hash ring
+(``distribut/consistent_hash.h:30-40``), and ring-allreduce neighbours are
+computed from rank (``distribut/ring_collect.h:26-40``).
+
+On TPU the topology is a :class:`jax.sharding.Mesh`. We use up to four logical
+axes:
+
+  ``data``   data parallelism (the reference's worker ranks)
+  ``model``  tensor parallelism for wide layers (beyond-reference capability)
+  ``embed``  shards of the sparse embedding table (the reference's PS shards /
+             DHT ring -> one mesh axis; key routing becomes a static
+             ``fid % n_shards`` partition instead of murmur-hash virtual nodes)
+  ``seq``    sequence/context parallelism for long-sequence models
+
+Axes of size 1 are kept in the mesh so sharding rules can always name them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+AXES = ("data", "model", "embed", "seq")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape. Axes default to 1 (absent)."""
+
+    data: int = 1
+    model: int = 1
+    embed: int = 1
+    seq: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.model * self.embed * self.seq
+
+    def shape(self) -> tuple:
+        return (self.data, self.model, self.embed, self.seq)
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with the canonical axis order ``(data, model, embed, seq)``."""
+    if devices is None:
+        devices = jax.devices()
+    if spec.size > len(devices):
+        raise ValueError(
+            f"mesh spec {spec.shape()} needs {spec.size} devices, have {len(devices)}"
+        )
+    devs = np.asarray(devices[: spec.size]).reshape(spec.shape())
+    return Mesh(devs, AXES)
+
+
+def local_mesh(n_data: Optional[int] = None) -> Mesh:
+    """Data-parallel mesh over all (or the first ``n_data``) local devices."""
+    n = n_data if n_data is not None else len(jax.devices())
+    return make_mesh(MeshSpec(data=n))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-dim sharding over the ``data`` axis (leading dimension)."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def embed_sharding(mesh: Mesh) -> NamedSharding:
+    """Row-sharding for embedding tables over the ``embed`` axis.
+
+    Replaces the reference's consistent-hash key routing (consistent_hash.h:30-40):
+    row ``fid`` lives on shard ``fid % mesh.shape['embed']`` after a static
+    round-robin permutation (see lightctr_tpu.embed.table).
+    """
+    return NamedSharding(mesh, P("embed"))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Device-put a host batch with leading-dim sharding over ``data``."""
+    sh = data_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)

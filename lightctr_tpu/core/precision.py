@@ -1,0 +1,60 @@
+"""Precision policy.
+
+The reference compresses every wire value to software fp16
+(``common/float16.h:98-154``, used by the PS at ``paramserver.h:161-163`` and
+push/pull codecs) and computes in fp32 with AVX.  On TPU the native low
+precision is bfloat16 and the MXU accumulates in fp32, so the policy is:
+
+  params   fp32   (master copies)
+  compute  bf16 or fp32 (matmul inputs; MXU accumulates fp32 either way)
+  wire     bf16   (collectives — stands in for the fp16 wire codec)
+
+``Policy.cast_compute`` is applied at module boundaries; optimizers always run
+in fp32 on the param dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    wire_dtype: jnp.dtype = jnp.bfloat16
+
+    def cast_compute(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    def cast_wire(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.wire_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+    def cast_param(self, tree):
+        return jax.tree_util.tree_map(
+            lambda x: x.astype(self.param_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+DEFAULT_POLICY = Policy()
+BF16_POLICY = Policy(compute_dtype=jnp.bfloat16)
+
+
+def policy_for(compute_dtype: str) -> Policy:
+    return BF16_POLICY if compute_dtype == "bfloat16" else DEFAULT_POLICY

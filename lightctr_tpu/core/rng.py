@@ -1,0 +1,28 @@
+"""PRNG helpers.
+
+The reference uses a Box-Muller gaussian + uniform RNG (``util/random.h:17-60``)
+seeded from time; layer init draws ~N(0, 1/sqrt(fan)) (e.g. ``fm_algo_abst.h:57-62``,
+``fullyconnLayer.h:35-44``).  Here everything is ``jax.random`` with explicit
+key threading so runs are reproducible and shardable.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+
+
+def key_seq(seed: int) -> Iterator[jax.Array]:
+    """Infinite deterministic stream of fresh PRNG keys."""
+    key = jax.random.PRNGKey(seed)
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub
+
+
+def split_tree(key: jax.Array, tree):
+    """One independent key per leaf of ``tree`` (same structure)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(treedef, list(keys))

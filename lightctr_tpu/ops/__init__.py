@@ -1,0 +1,3 @@
+from lightctr_tpu.ops import activations, losses, metrics
+
+__all__ = ["activations", "losses", "metrics"]

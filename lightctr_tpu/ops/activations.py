@@ -1,0 +1,96 @@
+"""Activation functions.
+
+Re-designs ``LightCTR/util/activations.h:19-180`` as pure jittable functions.
+The reference mutates buffers in place and hand-writes each derivative; here
+forward functions are differentiated by ``jax.grad``, with ``custom_vjp`` only
+where the reference's backward deliberately differs from the true derivative
+(straight-through estimator in ``Binary_Sigmoid``, activations.h:36-60).
+
+Numerical-guard semantics preserved:
+  - Sigmoid clamps logits to +/-16 and outputs to [1e-7, 1-1e-7]
+    (activations.h:63-79).
+  - Softmax is max-shifted, supports a distillation temperature
+    (``softTargetRate``, activations.h:92-123), and clamps outputs away from
+    exact 0/1 (activations.h:107-112).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-7
+SIGMOID_CLAMP = 16.0
+
+
+def identity(x: jax.Array) -> jax.Array:
+    return x
+
+
+def sigmoid(x: jax.Array) -> jax.Array:
+    """Clamped sigmoid (activations.h:63-79): inputs beyond +/-16 saturate to
+    eps / 1-eps, so downstream log-losses never see exact 0 or 1."""
+    y = jax.nn.sigmoid(jnp.clip(x, -SIGMOID_CLAMP, SIGMOID_CLAMP))
+    return jnp.where(x < -SIGMOID_CLAMP, EPS, jnp.where(x > SIGMOID_CLAMP, 1.0 - EPS, y))
+
+
+def tanh(x: jax.Array) -> jax.Array:
+    return jnp.tanh(x)
+
+
+def relu(x: jax.Array) -> jax.Array:
+    return jax.nn.relu(x)
+
+
+def softplus(x: jax.Array) -> jax.Array:
+    return jax.nn.softplus(x)
+
+
+@partial(jax.jit, static_argnames=("axis",))
+def softmax(x: jax.Array, temperature: float = 1.0, axis: int = -1) -> jax.Array:
+    """Max-shifted softmax with distillation temperature
+    (``softTargetRate``, activations.h:92-112); outputs clamped to
+    [1e-7, 1-1e-7] like the reference."""
+    y = jax.nn.softmax(x / temperature, axis=axis)
+    return jnp.clip(y, EPS, 1.0 - EPS)
+
+
+@jax.custom_vjp
+def binary_sigmoid(x: jax.Array) -> jax.Array:
+    """XNOR-net style weight binarization (activations.h:36-60): forward
+    replaces each element with sign(x) * mean(|x|) over the vector; backward is
+    the straight-through estimator (reference backward passes delta through
+    unchanged, activations.h:54-59)."""
+    scale = jnp.mean(jnp.abs(x))
+    return jnp.sign(x) * scale
+
+
+def _binary_sigmoid_fwd(x):
+    return binary_sigmoid(x), None
+
+
+def _binary_sigmoid_bwd(_, g):
+    return (g,)
+
+
+binary_sigmoid.defvjp(_binary_sigmoid_fwd, _binary_sigmoid_bwd)
+
+
+ACTIVATIONS = {
+    "identity": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "relu": relu,
+    "softplus": softplus,
+    "softmax": softmax,
+    "binary_sigmoid": binary_sigmoid,
+}
+
+
+def get(name: str):
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; have {sorted(ACTIVATIONS)}")

@@ -1,0 +1,76 @@
+"""Loss functions.
+
+Re-designs ``LightCTR/util/loss.h:17-86``.  The reference exposes
+``loss(pred, label)`` plus a hand-written ``gradient`` whose convention is
+"gradient w.r.t. the *pre-activation*" (e.g. Logistic::gradient returns
+``sigmoid(z) - y``, loss.h:56-60).  Here losses are scalar-valued jittable
+functions of logits; ``jax.grad`` reproduces those gradients exactly, so no
+separate gradient methods exist.
+
+All losses return the **sum** over elements by default (the reference
+accumulates sums, e.g. loss.h:45-52) with a ``mean`` reduction option.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "none":
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def square_loss(pred: jax.Array, target: jax.Array, reduction: str = "sum") -> jax.Array:
+    """MSE, 0.5 * (pred - y)^2 (loss.h:25-39); grad w.r.t. pred is pred - y."""
+    d = pred - target
+    return _reduce(0.5 * d * d, reduction)
+
+
+def logistic_loss(logits: jax.Array, labels: jax.Array, reduction: str = "sum") -> jax.Array:
+    """Numerically-stable binary cross-entropy on logits.
+
+    The reference computes the *log-likelihood* ``(y - [z>=0]) z - log(1 +
+    exp(z - 2 [z>=0] z))`` (loss.h:44-52); we return its negation (a proper
+    loss, positive).  grad w.r.t. z is sigmoid(z) - y, matching loss.h:56-60.
+    """
+    z = logits
+    ll = (labels - (z >= 0)) * z - jnp.log1p(jnp.exp(z - 2.0 * (z >= 0) * z))
+    return _reduce(-ll, reduction)
+
+
+def bce_on_probs(probs: jax.Array, labels: jax.Array, reduction: str = "sum") -> jax.Array:
+    """Binary cross-entropy on probabilities already clamped away from 0/1
+    (the form the reference's predictors report, fm_predict.cpp:56-61)."""
+    p = jnp.clip(probs, 1e-7, 1.0 - 1e-7)
+    return _reduce(-(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p)), reduction)
+
+
+def softmax_cross_entropy(
+    logits: jax.Array, onehot: jax.Array, temperature: float = 1.0, reduction: str = "sum"
+) -> jax.Array:
+    """CE for one-hot targets (Logistic_Softmax, loss.h:65-86).  grad w.r.t.
+    logits is softmax(z) - onehot — the reference writes the negative of this
+    because its backward convention is "direction of increase"."""
+    logp = jax.nn.log_softmax(logits / temperature, axis=-1)
+    return _reduce(-jnp.sum(onehot * logp, axis=-1), reduction)
+
+
+LOSSES = {
+    "square": square_loss,
+    "logistic": logistic_loss,
+    "softmax_ce": softmax_cross_entropy,
+}
+
+
+def get(name: str):
+    try:
+        return LOSSES[name]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; have {sorted(LOSSES)}")

@@ -1,0 +1,124 @@
+"""Evaluation metrics: AUC, precision/recall/F1, accuracy, logloss.
+
+Re-designs ``LightCTR/util/evaluator.h``.  The reference's AUC buckets scores
+into 2^24 histogram bins and sums trapezoids from the top bin down
+(evaluator.h:61-94 ``init``/``Auc``); that algorithm vectorizes directly:
+
+    auc = sum_i  neg[i] * (cumpos_incl[i] + cumpos_excl[i]) / 2
+          over bins i sorted by descending score, normalized by P*N.
+
+We keep the histogram formulation (jittable, O(bins) memory, streaming-friendly
+across batches) with a configurable bin count (default 2^20; the reference's
+2^24, evaluator.h:101, wastes 128 MiB of int32 on device for no measurable
+accuracy gain at CTR dataset sizes), plus an exact rank-based AUC used as the
+test oracle.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BINS = 1 << 20
+
+
+def auc_histogram(scores: jax.Array, labels: jax.Array, num_bins: int = DEFAULT_BINS) -> jax.Array:
+    """Histogram-bucket AUC (evaluator.h:61-94).  ``scores`` in [0, 1].
+    Binning runs jitted on device; the final sweep runs on host in float64."""
+    pos_h, neg_h = auc_histogram_update(scores, labels, num_bins=num_bins)
+    return auc_from_histogram(pos_h, neg_h)
+
+
+@partial(jax.jit, static_argnames=("num_bins",))
+def auc_histogram_update(
+    scores: jax.Array,
+    labels: jax.Array,
+    pos_hist: jax.Array | None = None,
+    neg_hist: jax.Array | None = None,
+    num_bins: int = DEFAULT_BINS,
+):
+    """Accumulate one batch into (pos, neg) histograms — the streaming form of
+    ``AucEvaluator::init`` (evaluator.h:61-74) for epoch-long evaluation."""
+    scores = scores.reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.int32)
+    idx = jnp.clip((scores * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    pos_b = jax.ops.segment_sum(labels, idx, num_segments=num_bins)
+    neg_b = jax.ops.segment_sum(1 - labels, idx, num_segments=num_bins)
+    if pos_hist is not None:
+        pos_b = pos_b + pos_hist
+    if neg_hist is not None:
+        neg_b = neg_b + neg_hist
+    return pos_b, neg_b
+
+
+def auc_from_histogram(pos_hist: jax.Array, neg_hist: jax.Array) -> jax.Array:
+    """Trapezoid sweep from the highest-score bin down (evaluator.h:76-94).
+
+    Runs on host in float64: the histograms accumulate exactly in int32, but a
+    float32 on-device sweep loses count precision once cumulative positives
+    pass 2^24 — routine for epoch-scale streaming evaluation."""
+    import numpy as np
+
+    p = np.asarray(pos_hist)[::-1].astype(np.float64)
+    n = np.asarray(neg_hist)[::-1].astype(np.float64)
+    cum_pos = np.cumsum(p)
+    # trapezoid: width = neg in bin, heights = cum positives before/after bin
+    area = float(np.sum(n * (2.0 * cum_pos - p) * 0.5))
+    tot_pos, tot_neg = float(cum_pos[-1]), float(n.sum())
+    if tot_pos > 0 and tot_neg > 0:
+        return jnp.asarray(area / (tot_pos * tot_neg), dtype=jnp.float32)
+    return jnp.asarray(0.0, dtype=jnp.float32)
+
+
+def auc_exact(scores, labels) -> float:
+    """Exact Mann-Whitney AUC via ranks (oracle for tests; host-side)."""
+    import numpy as np
+
+    scores = np.asarray(scores, dtype=np.float64).reshape(-1)
+    labels = np.asarray(labels).reshape(-1)
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    # average ranks for ties
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    n_pos = labels.sum()
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    return float((ranks[labels == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+@jax.jit
+def accuracy(pred_labels: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((pred_labels == labels).astype(jnp.float32))
+
+
+@jax.jit
+def precision_recall_f1(pred_labels: jax.Array, labels: jax.Array):
+    """Binary P/R/F1 (evaluator.h:20-49 Precision/Recall/F1Score)."""
+    pred_labels = pred_labels.astype(jnp.bool_)
+    labels = labels.astype(jnp.bool_)
+    tp = jnp.sum(pred_labels & labels).astype(jnp.float32)
+    fp = jnp.sum(pred_labels & ~labels).astype(jnp.float32)
+    fn = jnp.sum(~pred_labels & labels).astype(jnp.float32)
+    precision = jnp.where(tp + fp > 0, tp / (tp + fp), 0.0)
+    recall = jnp.where(tp + fn > 0, tp / (tp + fn), 0.0)
+    f1 = jnp.where(precision + recall > 0, 2 * precision * recall / (precision + recall), 0.0)
+    return precision, recall, f1
+
+
+@jax.jit
+def logloss(probs: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean logloss as reported by the predictors (fm_predict.cpp:56-61)."""
+    p = jnp.clip(probs, 1e-7, 1.0 - 1e-7)
+    return -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))

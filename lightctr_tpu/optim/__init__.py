@@ -1,0 +1,27 @@
+from lightctr_tpu.optim.updaters import (
+    sgd,
+    adagrad,
+    rmsprop,
+    adadelta,
+    adam,
+    ftrl,
+    dcasgd,
+    clip_by_value,
+    add_decayed_regularization,
+    get,
+    apply_updates,
+)
+
+__all__ = [
+    "sgd",
+    "adagrad",
+    "rmsprop",
+    "adadelta",
+    "adam",
+    "ftrl",
+    "dcasgd",
+    "clip_by_value",
+    "add_decayed_regularization",
+    "get",
+    "apply_updates",
+]

@@ -1,0 +1,22 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+This replaces the reference's localhost-cluster CI trick (.travis.yml builds
+master/ps/worker binaries against 127.0.0.1, SURVEY.md §4): we test multi-chip
+sharding on one host via XLA's host-platform device-count override, so every
+mesh/collective test runs on any machine.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

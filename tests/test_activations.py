@@ -1,0 +1,58 @@
+"""Activation parity vs NumPy oracles of the reference semantics
+(LightCTR/util/activations.h)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu.ops import activations as A
+
+
+def test_sigmoid_matches_and_clamps(rng):
+    x = rng.normal(size=(64,)).astype(np.float32) * 4
+    got = np.asarray(A.sigmoid(jnp.asarray(x)))
+    want = 1.0 / (1.0 + np.exp(-x))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # clamp semantics at activations.h:66-71
+    assert np.asarray(A.sigmoid(jnp.asarray([-20.0]))) == pytest.approx(1e-7, rel=1e-2)
+    assert np.asarray(A.sigmoid(jnp.asarray([20.0]))) == pytest.approx(1 - 1e-7)
+
+
+def test_sigmoid_grad(rng):
+    x = rng.normal(size=(16,)).astype(np.float32)
+    g = jax.vmap(jax.grad(lambda v: A.sigmoid(v)))(jnp.asarray(x))
+    s = 1.0 / (1.0 + np.exp(-x))
+    np.testing.assert_allclose(np.asarray(g), s * (1 - s), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_temperature(rng):
+    x = rng.normal(size=(8, 10)).astype(np.float32)
+    for t in (1.0, 3.0):
+        got = np.asarray(A.softmax(jnp.asarray(x), temperature=t))
+        z = x / t
+        e = np.exp(z - z.max(-1, keepdims=True))
+        want = e / e.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_relu_tanh_softplus(rng):
+    x = rng.normal(size=(32,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(A.relu(jnp.asarray(x))), np.maximum(x, 0))
+    np.testing.assert_allclose(
+        np.asarray(A.tanh(jnp.asarray(x))), np.tanh(x), rtol=1e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.softplus(jnp.asarray(x))), np.log1p(np.exp(x)), rtol=1e-4, atol=2e-4
+    )
+
+
+def test_binary_sigmoid_forward_and_ste(rng):
+    x = rng.normal(size=(16,)).astype(np.float32)
+    got = np.asarray(A.binary_sigmoid(jnp.asarray(x)))
+    want = np.sign(x) * np.abs(x).mean()  # activations.h:43-52
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # straight-through backward (activations.h:54-59)
+    g = jax.grad(lambda v: jnp.sum(A.binary_sigmoid(v) * 3.0))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g), 3.0 * np.ones_like(x))

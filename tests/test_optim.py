@@ -1,0 +1,145 @@
+"""Optimizer math vs scalar NumPy oracles transcribed from the reference
+(gradientUpdater.h / momentumUpdater.h / paramserver.h DCASGD)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu import optim
+
+EPS = 1e-7
+
+
+def run_steps(tx, params, grads_seq):
+    state = tx.init(params)
+    for g in grads_seq:
+        updates, state = tx.update(g, state, params)
+        params = optim.apply_updates(params, updates)
+    return params, state
+
+
+def test_sgd(rng):
+    w = jnp.asarray(rng.normal(size=(5,)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=(5,)).astype(np.float32))
+    got, _ = run_steps(optim.sgd(0.1), w, [g])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(w) - 0.1 * np.asarray(g), rtol=1e-6)
+
+
+def test_adagrad_oracle(rng):
+    # oracle: accum += g^2; w -= lr*g/sqrt(accum+eps)  (gradientUpdater.h:138-150)
+    w0 = rng.normal(size=(4,)).astype(np.float32)
+    gs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(5)]
+    w, accum = w0.copy(), np.zeros(4, np.float32)
+    for g in gs:
+        accum += g * g
+        w -= 0.1 * g / np.sqrt(accum + EPS)
+    got, _ = run_steps(optim.adagrad(0.1), jnp.asarray(w0), [jnp.asarray(g) for g in gs])
+    np.testing.assert_allclose(np.asarray(got), w, rtol=1e-5)
+
+
+def test_rmsprop_oracle(rng):
+    # gradientUpdater.h:216-228
+    w0 = rng.normal(size=(4,)).astype(np.float32)
+    gs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(5)]
+    w, accum, q = w0.copy(), np.zeros(4, np.float32), 0.9
+    for g in gs:
+        accum = accum * q + (1 - q) * g * g
+        w -= 0.1 * g * np.sqrt(1.0 / (accum + EPS))
+    got, _ = run_steps(optim.rmsprop(0.1, 0.9), jnp.asarray(w0), [jnp.asarray(g) for g in gs])
+    np.testing.assert_allclose(np.asarray(got), w, rtol=1e-5)
+
+
+def test_adadelta_oracle(rng):
+    # momentumUpdater.h Adadelta_Num: no lr; EMA decay = momentum
+    w0 = rng.normal(size=(4,)).astype(np.float32)
+    gs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(5)]
+    w = w0.copy()
+    ag = np.zeros(4, np.float32)
+    ad = np.zeros(4, np.float32)
+    m = 0.9
+    for g in gs:
+        ag = ag * m + (1 - m) * g * g
+        dx = g * np.sqrt(ad + EPS) / np.sqrt(ag + EPS)
+        ad = ad * m + (1 - m) * dx * dx
+        w -= dx
+    got, _ = run_steps(optim.adadelta(0.9), jnp.asarray(w0), [jnp.asarray(g) for g in gs])
+    np.testing.assert_allclose(np.asarray(got), w, rtol=1e-5)
+
+
+def test_adam_oracle_with_warmup(rng):
+    # momentumUpdater.h:186-210: joint correction sqrt(1-b2^t)/(1-b1^t),
+    # eps added OUTSIDE sqrt(v)
+    w0 = rng.normal(size=(4,)).astype(np.float32)
+    gs = [rng.normal(size=(4,)).astype(np.float32) for _ in range(5)]
+    w = w0.copy()
+    mu = np.zeros(4, np.float32)
+    nu = np.zeros(4, np.float32)
+    b1, b2, lr = 0.9, 0.999, 0.1
+    for t, g in enumerate(gs, 1):
+        corr = np.sqrt(1 - b2**t) / (1 - b1**t)
+        mu = mu * b1 + (1 - b1) * g
+        nu = nu * b2 + (1 - b2) * g * g
+        w -= lr * corr * mu / (np.sqrt(nu) + EPS)
+    got, _ = run_steps(optim.adam(0.1), jnp.asarray(w0), [jnp.asarray(g) for g in gs])
+    # fp32 jnp.power vs fp64 oracle power => ~1e-3 relative slack
+    np.testing.assert_allclose(np.asarray(got), w, rtol=2e-3, atol=1e-4)
+
+
+def test_ftrl_oracle(rng):
+    # gradientUpdater.h:252-273 with alpha=.15, beta=1, l1=1, l2=1
+    alpha, beta, l1, l2 = 0.15, 1.0, 1.0, 1.0
+    w0 = np.zeros(4, np.float32)
+    gs = [rng.normal(size=(4,)).astype(np.float32) * 3 for _ in range(6)]
+    w, z, n = w0.copy(), np.zeros(4, np.float32), np.zeros(4, np.float32)
+    for g in gs:
+        g2 = g * g
+        sigma = (np.sqrt(n + g2) - np.sqrt(n)) / alpha
+        z = z + g - sigma * w
+        n = n + g2
+        for i in range(4):
+            if abs(z[i]) <= l1:
+                w[i] = 0.0
+            else:
+                t = z[i] - l1 if z[i] >= 0 else z[i] + l1
+                w[i] = -t / ((beta + np.sqrt(n[i])) / alpha + l2)
+    got, _ = run_steps(optim.ftrl(), jnp.asarray(w0), [jnp.asarray(g) for g in gs])
+    np.testing.assert_allclose(np.asarray(got), w, rtol=1e-4, atol=1e-6)
+    # L1 sparsification actually produces zeros on tiny grads
+    got2, _ = run_steps(optim.ftrl(), jnp.zeros(3), [jnp.asarray([1e-4, -1e-4, 0.0])])
+    assert np.all(np.asarray(got2) == 0.0)
+
+
+def test_dcasgd_compensation(rng):
+    # paramserver.h DCASGD: w -= lr*(g + l*g^2*(w - shadow)); first step shadow==w
+    w0 = rng.normal(size=(4,)).astype(np.float32)
+    g1 = rng.normal(size=(4,)).astype(np.float32)
+    g2 = rng.normal(size=(4,)).astype(np.float32)
+    tx = optim.dcasgd(0.1, lambda_dc=2.0)
+    state = tx.init(jnp.asarray(w0))
+    up, state = tx.update(jnp.asarray(g1), state, jnp.asarray(w0))
+    w1 = w0 - 0.1 * g1  # shadow == w at t0 -> pure sgd
+    np.testing.assert_allclose(np.asarray(optim.apply_updates(jnp.asarray(w0), up)), w1, rtol=1e-5)
+    # second step: simulate staleness — params moved by external delta
+    w1_ext = w1 + 0.05
+    up2, state = tx.update(jnp.asarray(g2), state, jnp.asarray(w1_ext))
+    want = w1_ext - 0.1 * (g2 + 2.0 * g2 * g2 * (w1_ext - w1))
+    np.testing.assert_allclose(
+        np.asarray(optim.apply_updates(jnp.asarray(w1_ext), up2)), want, rtol=1e-5
+    )
+
+
+def test_clip_and_regularization(rng):
+    g = jnp.asarray([20.0, -20.0, 1.0])
+    tx = optim.clip_by_value(15.0)
+    u, _ = tx.update(g, tx.init(None), None)
+    np.testing.assert_allclose(np.asarray(u), [15.0, -15.0, 1.0])
+    w = jnp.asarray([1.0, -2.0, 0.5])
+    rtx = optim.add_decayed_regularization(lambda_l2=0.01, lambda_l1=0.1)
+    u2, _ = rtx.update(jnp.zeros(3), rtx.init(w), w)
+    np.testing.assert_allclose(np.asarray(u2), 0.01 * np.asarray(w) + 0.1 * np.sign(np.asarray(w)), rtol=1e-6)
+
+
+def test_registry():
+    assert optim.get("adagrad", learning_rate=0.1)
+    with pytest.raises(ValueError):
+        optim.get("nope")
