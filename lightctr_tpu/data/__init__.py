@@ -1,0 +1,12 @@
+from lightctr_tpu.data.sparse import SparseDataset, load_libffm
+from lightctr_tpu.data.dense import DenseDataset, load_dense_csv
+from lightctr_tpu.data.batching import minibatches, shard_for_hosts
+
+__all__ = [
+    "SparseDataset",
+    "load_libffm",
+    "DenseDataset",
+    "load_dense_csv",
+    "minibatches",
+    "shard_for_hosts",
+]

@@ -1,0 +1,53 @@
+"""Batching / host-sharding utilities.
+
+Replaces the reference's row-range thread partitioning
+(``train_fm_algo.cpp:46-54``) and the per-worker csv splitter
+(``data/proc_file_split.py``): batches are dictionaries of equal-leading-dim
+arrays; ``shard_for_hosts`` deals rows round-robin across hosts for multi-host
+data parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def minibatches(
+    arrays: Dict[str, np.ndarray],
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    drop_remainder: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield shuffled minibatch dicts (the reference shuffles row order each
+    epoch, dl_algo_abst.h:62-66)."""
+    n = len(next(iter(arrays.values())))
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng(seed).shuffle(idx)
+    end = n - (n % batch_size) if drop_remainder else n
+    for s in range(0, end, batch_size):
+        sel = idx[s : s + batch_size]
+        yield {k: v[sel] for k, v in arrays.items()}
+
+
+def shard_for_hosts(
+    arrays: Dict[str, np.ndarray],
+    host_id: Optional[int] = None,
+    host_count: Optional[int] = None,
+) -> Dict[str, np.ndarray]:
+    """Rows for this host: row i belongs to host i % host_count.  Rows beyond
+    the largest multiple of host_count are dropped so every host sees the same
+    local shape (SPMD requires identical per-process shapes)."""
+    import jax
+
+    if host_id is None:
+        host_id = jax.process_index()
+    if host_count is None:
+        host_count = jax.process_count()
+    n = len(next(iter(arrays.values())))
+    even = n - (n % host_count)
+    return {k: v[host_id:even:host_count] for k, v in arrays.items()}

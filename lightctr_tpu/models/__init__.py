@@ -1,0 +1,4 @@
+from lightctr_tpu.models import fm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+__all__ = ["fm", "CTRTrainer"]

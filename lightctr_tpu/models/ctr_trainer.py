@@ -1,0 +1,204 @@
+"""Shared trainer for the CTR model family (FM / FFM / NFM / Wide&Deep).
+
+Replaces the reference's per-model Train()/batchGradCompute/ApplyGrad loops
+(e.g. ``train_fm_algo.cpp:35-133``): where the reference shards rows across a
+thread pool and accumulates into a shared grad buffer (Hogwild-style), here
+one jitted SPMD step computes the batched gradient and the optimizer update;
+data parallelism is a mesh axis, not threads — the grad all-reduce that the
+reference implements by hand over ZeroMQ rings (ring_collect.h:48-72) is the
+``psum`` XLA inserts for sharded-batch gradients.
+
+The reference trains FM full-batch (``__global_minibatch_size = dataRow_cnt``,
+train_fm_algo.cpp:38) with one Adagrad step per epoch; ``batch_size=None``
+reproduces that, an integer gives minibatch SGD (the DL-family default).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.core.config import TrainConfig
+from lightctr_tpu.core.mesh import replicated, shard_batch
+from lightctr_tpu.data.batching import minibatches
+from lightctr_tpu.ops import losses as losses_lib
+from lightctr_tpu.ops import metrics as metrics_lib
+from lightctr_tpu.ops.activations import sigmoid
+
+
+class CTRTrainer:
+    """Binary-CTR trainer over a ``logits(params, batch)`` function.
+
+    Parameters
+    ----------
+    params: initial parameter pytree.
+    logits_fn: (params, batch) -> [B] raw scores (pre-sigmoid).
+    l2_fn: optional (params, batch) -> scalar penalty (already summed; it is
+        divided by batch size alongside the mean loss).
+    optimizer: any optax transform; defaults to Adagrad at cfg.learning_rate
+        (the reference FM family's workhorse, gradientUpdater.h:127-154).
+    mesh: optional Mesh for data-parallel execution; batches are sharded over
+        the ``data`` axis, params replicated.
+    """
+
+    def __init__(
+        self,
+        params,
+        logits_fn: Callable,
+        cfg: TrainConfig,
+        l2_fn: Optional[Callable] = None,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        self.logits_fn = logits_fn
+        self.l2_fn = l2_fn
+        self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
+        self.mesh = mesh
+        self.params = params
+        self.opt_state = self.tx.init(params)
+        if mesh is not None:
+            rep = replicated(mesh)
+            self.params = jax.device_put(self.params, rep)
+            self.opt_state = jax.device_put(self.opt_state, rep)
+        self._step = jax.jit(self._make_step())
+        self._logits_j = jax.jit(self.logits_fn)
+        self._scan_cache: Dict[int, Callable] = {}
+
+    def _make_step(self):
+        lambda_l2 = self.cfg.lambda_l2
+        l2_fn = self.l2_fn
+        logits_fn = self.logits_fn
+        tx = self.tx
+
+        def loss_fn(params, batch):
+            z = logits_fn(params, batch)
+            n = z.shape[0]
+            loss = losses_lib.logistic_loss(z, batch["labels"], reduction="sum")
+            if l2_fn is not None and lambda_l2 > 0.0:
+                loss = loss + lambda_l2 * l2_fn(params, batch)
+            return loss / n
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optim_lib.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return step
+
+    # ------------------------------------------------------------------
+
+    def _put(self, batch: Dict[str, np.ndarray]):
+        if self.mesh is not None:
+            return shard_batch(self.mesh, {k: jnp.asarray(v) for k, v in batch.items()})
+        return {k: jnp.asarray(v) for k, v in batch.items()}
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> float:
+        self.params, self.opt_state, loss = self._step(self.params, self.opt_state, self._put(batch))
+        return loss
+
+    def fit(
+        self,
+        arrays: Dict[str, np.ndarray],
+        epochs: Optional[int] = None,
+        batch_size: Optional[int] = None,
+        eval_arrays: Optional[Dict[str, np.ndarray]] = None,
+        eval_every: int = 0,
+        verbose: bool = False,
+    ) -> Dict[str, list]:
+        epochs = epochs if epochs is not None else self.cfg.epochs
+        n_rows = len(next(iter(arrays.values())))
+        if batch_size is not None and batch_size > n_rows:
+            raise ValueError(
+                f"batch_size={batch_size} exceeds dataset size {n_rows} "
+                "(drop_remainder would yield zero batches); use batch_size=None "
+                "for full-batch training"
+            )
+        history = {"loss": [], "eval": []}
+        t0 = time.perf_counter()
+        full_batch = self._put(arrays) if batch_size is None else None
+        for epoch in range(epochs):
+            if batch_size is None:
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, full_batch
+                )
+            else:
+                loss = None
+                for batch in minibatches(arrays, batch_size, seed=self.cfg.seed + epoch):
+                    loss = self.train_step(batch)
+            history["loss"].append(float(loss))
+            if eval_every and eval_arrays is not None and (epoch + 1) % eval_every == 0:
+                ev = self.evaluate(eval_arrays)
+                history["eval"].append((epoch, ev))
+                if verbose:
+                    print(f"epoch {epoch}: loss={float(loss):.5f} {ev}")
+            elif verbose:
+                print(f"epoch {epoch}: loss={float(loss):.5f}")
+        history["wall_time_s"] = time.perf_counter() - t0
+        return history
+
+    def fit_fullbatch_scan(self, arrays: Dict[str, np.ndarray], epochs: int) -> np.ndarray:
+        """Run ``epochs`` full-batch steps as one on-device ``lax.scan`` —
+        zero per-epoch dispatch, the TPU equivalent of the reference's
+        T-epoch re-train loops (main.cpp:227-229).  Returns the loss
+        trajectory."""
+        batch = self._put(arrays)
+        run = self._get_scan_fn(epochs)
+        self.params, self.opt_state, losses = run(self.params, self.opt_state, batch)
+        return np.asarray(losses)
+
+    def compile_fullbatch_scan(self, arrays: Dict[str, np.ndarray], epochs: int) -> None:
+        """AOT-compile the scan (populating the jit cache) without executing —
+        benchmark warm-up that costs compile time only and leaves params
+        untouched."""
+        batch = self._put(arrays)
+        run = self._get_scan_fn(epochs)
+        run.lower(self.params, self.opt_state, batch).compile()
+
+    def _get_scan_fn(self, epochs: int):
+        run = self._scan_cache.get(epochs)
+        if run is None:
+            step = self._make_step()
+
+            def body_fn(batch):
+                def body(carry, _):
+                    params, opt_state = carry
+                    params, opt_state, loss = step(params, opt_state, batch)
+                    return (params, opt_state), loss
+
+                return body
+
+            @jax.jit
+            def run(params, opt_state, batch):
+                (params, opt_state), losses = jax.lax.scan(
+                    body_fn(batch), (params, opt_state), None, length=epochs
+                )
+                return params, opt_state, losses
+
+            self._scan_cache[epochs] = run
+        return run
+
+    def predict_proba(self, arrays: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.asarray(sigmoid(self._logits_j(self.params, self._put(arrays))))
+
+    def evaluate(self, arrays: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """Logloss / accuracy / AUC report, matching FM_Predict
+        (fm_predict.cpp:56-77)."""
+        probs = self.predict_proba(arrays)
+        labels = arrays["labels"]
+        probs_j = jnp.asarray(probs)
+        labels_j = jnp.asarray(labels)
+        return {
+            "logloss": float(metrics_lib.logloss(probs_j, labels_j)),
+            "accuracy": float(
+                metrics_lib.accuracy((probs_j > 0.5).astype(jnp.int32), labels_j.astype(jnp.int32))
+            ),
+            "auc": float(metrics_lib.auc_histogram(probs_j, labels_j.astype(jnp.int32))),
+        }

@@ -8,10 +8,16 @@ mesh/collective test runs on any machine.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# jax may already be imported at interpreter startup (axon platform hook), so
+# env vars alone are too late — update jax.config before the first backend use.
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
