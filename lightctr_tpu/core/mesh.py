@@ -79,9 +79,12 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def embed_sharding(mesh: Mesh) -> NamedSharding:
     """Row-sharding for embedding tables over the ``embed`` axis.
 
-    Replaces the reference's consistent-hash key routing (consistent_hash.h:30-40):
-    row ``fid`` lives on shard ``fid % mesh.shape['embed']`` after a static
-    round-robin permutation (see lightctr_tpu.embed.table).
+    Replaces the reference's consistent-hash key routing
+    (consistent_hash.h:30-40) with contiguous block sharding: rows
+    [s*F/S, (s+1)*F/S) live on shard s.  Load balancing of hot low ids —
+    what the reference's virtual nodes provide — is the loader's job
+    (hash/fold ids, lightctr_tpu.data.sparse) rather than a physical row
+    permutation.
     """
     return NamedSharding(mesh, P("embed"))
 

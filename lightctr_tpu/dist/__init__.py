@@ -1,0 +1,7 @@
+from lightctr_tpu.dist.collectives import (
+    ring_all_reduce,
+    ring_broadcast,
+    psum_all_reduce,
+)
+
+__all__ = ["ring_all_reduce", "ring_broadcast", "psum_all_reduce"]

@@ -1,0 +1,151 @@
+"""Collectives: explicit ring all-reduce, ring broadcast, psum wrappers.
+
+The reference implements ring all-reduce by hand over ZeroMQ
+(``distribut/ring_collect.h``): params fused into one flat buffer
+(BufferFusion), split into ``ring_size`` segments (ring_collect.h:86-109),
+N-1 reduce-scatter steps + N-1 all-gather steps around the ring neighbors
+(ring_collect.h:48-72), each step a send_sync + out-of-order-tolerant receive,
+finally dividing by N.
+
+On TPU the *production* path is simply ``psum``/sharded-grad jit — XLA lowers
+it to the ICI ring for us (``psum_all_reduce``).  ``ring_all_reduce`` below is
+the explicit algorithm — same segment schedule as the reference — written with
+``shard_map`` + ``lax.ppermute``, kept for two reasons: it is the benchmark
+parity artifact (BASELINE.md 4-node ring run), and it is the template for
+custom overlapping schedules XLA's default doesn't give.
+
+Flattening a param pytree into one vector (``ravel_pytree``) plays the role of
+``BufferFusion`` (buffer_fusion.h:53-65): N discontiguous tensors treated as
+one logical flat buffer for the collective.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ring_perm(n: int):
+    """Neighbor table: rank j sends to (j+1) % n (ring_collect.h:26-40)."""
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _ring_all_reduce_local(flat: jax.Array, axis_name: str, n: int, average: bool) -> jax.Array:
+    """Runs per-device under shard_map.  ``flat`` is this device's full-length
+    gradient vector, pre-padded to a multiple of n."""
+    idx = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(n)
+    segs = flat.reshape(n, -1)
+
+    def rs_step(i, segs):
+        send_idx = (idx - i) % n
+        buf = jnp.take(segs, send_idx, axis=0)
+        recv = jax.lax.ppermute(buf, axis_name, perm)
+        return segs.at[(idx - i - 1) % n].add(recv)
+
+    segs = jax.lax.fori_loop(0, n - 1, rs_step, segs)  # reduce-scatter
+    # rank idx now owns fully-reduced segment (idx + 1) % n
+
+    def ag_step(i, segs):
+        send_idx = (idx + 1 - i) % n
+        buf = jnp.take(segs, send_idx, axis=0)
+        recv = jax.lax.ppermute(buf, axis_name, perm)
+        return segs.at[(idx - i) % n].set(recv)
+
+    segs = jax.lax.fori_loop(0, n - 1, ag_step, segs)  # all-gather
+    out = segs.reshape(-1)
+    if average:
+        out = out / n  # ring_collect.h:61-68 divides by ring size
+    return out
+
+
+def ring_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):
+    """Explicit ring all-reduce of per-device gradient pytrees.
+
+    ``stacked_tree``: pytree whose leaves have a leading device dimension of
+    size ``mesh.shape[axis]`` (one slice per ring member — the per-worker
+    gradients).  Returns the same structure where every slice holds the
+    reduced (mean by default) values.
+    """
+    n = mesh.shape[axis]
+    leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
+    # BufferFusion: flatten each device's slice into one contiguous vector
+    flat0, unravel = ravel_pytree([leaf[0] for leaf in leaves])
+    length = flat0.shape[0]
+    padded = ((length + n - 1) // n) * n
+
+    stacked_flat = jnp.stack(
+        [ravel_pytree([leaf[d] for leaf in leaves])[0] for d in range(n)]
+    )
+    if padded != length:
+        stacked_flat = jnp.pad(stacked_flat, ((0, 0), (0, padded - length)))
+
+    fn = shard_map(
+        partial(_ring_all_reduce_local, axis_name=axis, n=n, average=average),
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(axis),
+        
+    )
+    # shard_map splits the leading dim: each device gets its [padded] vector
+    out = fn(stacked_flat.reshape(n * padded))
+    out = out.reshape(n, padded)[:, :length]
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(stacked_tree),
+        [
+            jnp.stack([unravel(out[d])[i] for d in range(n)])
+            for i in range(len(leaves))
+        ],
+    )
+
+
+def ring_broadcast(mesh: Mesh, stacked_tree, axis: str = "data"):
+    """Rank-0's values circulated to every ring member — ``syncInitializer``
+    parity (ring_collect.h:74-79)."""
+    n = mesh.shape[axis]
+
+    def local(x):
+        # one hop per step: after n-1 steps all ranks hold rank 0's data
+        def step(i, v):
+            recv = jax.lax.ppermute(v, axis, _ring_perm(n))
+            idx = jax.lax.axis_index(axis)
+            # ranks > 0 adopt what arrives from the left on their turn
+            return jnp.where((idx > i) & (idx <= i + 1), recv, v)
+
+        return jax.lax.fori_loop(0, n - 1, step, x)
+
+    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    return jax.tree_util.tree_map(lambda leaf: fn(leaf.reshape((-1,) + leaf.shape[2:])).reshape(leaf.shape), stacked_tree)
+
+
+def psum_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):
+    """The production path: XLA's own all-reduce (lowers to the ICI ring).
+    One shard_map over the whole pytree so XLA fuses the reductions."""
+    n = mesh.shape[axis]
+
+    def local(tree):
+        def one(x):
+            r = jax.lax.psum(x, axis)
+            return r / n if average else r
+
+        return jax.tree_util.tree_map(one, tree)
+
+    shapes = jax.tree_util.tree_map(lambda leaf: leaf.shape, stacked_tree)
+    flat_tree = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((n * int(np.prod(leaf.shape[1:])),))
+        if leaf.ndim > 1
+        else leaf,
+        stacked_tree,
+    )
+    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    out = fn(flat_tree)
+    return jax.tree_util.tree_map(
+        lambda leaf, shape: leaf.reshape(shape), out, shapes,
+        is_leaf=lambda x: isinstance(x, jax.Array),
+    )

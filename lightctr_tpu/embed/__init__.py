@@ -1,0 +1,23 @@
+from lightctr_tpu.embed.table import (
+    init_table,
+    init_adagrad_state,
+    init_dcasgd_state,
+    lookup,
+    dedup_grads,
+    sparse_sgd_update,
+    sparse_adagrad_update,
+    sparse_dcasgd_update,
+)
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+__all__ = [
+    "init_table",
+    "init_adagrad_state",
+    "init_dcasgd_state",
+    "lookup",
+    "dedup_grads",
+    "sparse_sgd_update",
+    "sparse_adagrad_update",
+    "sparse_dcasgd_update",
+    "AsyncParamServer",
+]

@@ -1,0 +1,151 @@
+"""Host-driven asynchronous parameter server — SSP/DCASGD parity mode.
+
+The synchronous mesh path (``lightctr_tpu.embed.table``) is the TPU-natural
+replacement for the reference's PS; this module preserves the reference's
+*asynchronous* semantics — bounded staleness (SSP) and delayed-compensation
+updates — as a host-side coordinator for workloads that want them
+(SURVEY.md §7 hard part (c)).
+
+Reference semantics reproduced from ``distribut/paramserver.h``:
+
+  - epoch-version ledger: the PS tracks ``last_epoch_version`` and the
+    slowest worker's staleness (paramserver.h:189-210);
+  - SSP pull gate: a pull from a worker *ahead* of the slowest by more than
+    ``kStalenessStepThreshold`` (=10, paramserver.h:20) returns nothing and
+    the worker retries after a sleep (pull.h:50-67);
+  - push drop: a push more than the threshold *behind* is discarded
+    (paramserver.h:201-205);
+  - per-key update rules SGD / Adagrad / DCASGD / DCASGDA with per-worker
+    shadow copies (paramserver.h:252-300);
+  - lazy param init: first pull of a key creates it ~ N(0,1)*sqrt(1/dim)
+    (paramserver.h:315-339).
+
+Workers here are threads or host processes driving device steps; the "wire"
+is in-process numpy (the reference's VarUint+fp16 codec belongs to ZeroMQ
+transport, which has no equivalent need on a single host).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
+
+
+class AsyncParamServer:
+    """Sparse KV store with bounded-staleness async updates."""
+
+    def __init__(
+        self,
+        dim: int = 1,
+        updater: str = "adagrad",
+        learning_rate: float = 0.1,
+        n_workers: int = 1,
+        staleness_threshold: int = STALENESS_THRESHOLD,
+        dcasgd_lambda: float = 0.1,
+        momentum_rate: float = 0.95,
+        seed: int = 0,
+        eps: float = 1e-7,
+    ):
+        if updater not in ("sgd", "adagrad", "dcasgd", "dcasgda"):
+            raise ValueError(f"unknown updater {updater!r}")
+        self.dim = dim
+        self.updater = updater
+        self.lr = learning_rate
+        self.n_workers = n_workers
+        self.staleness_threshold = staleness_threshold
+        self.dcasgd_lambda = dcasgd_lambda
+        self.momentum_rate = momentum_rate
+        self.eps = eps
+        self._rng = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._data: Dict[int, np.ndarray] = {}
+        self._accum: Dict[int, np.ndarray] = {}
+        self._shadow: Dict[int, np.ndarray] = {}  # key -> [n_workers, dim]
+        self.last_epoch_version = 0
+        self.staleness = 0
+        self.staleness_worker: Optional[int] = None
+        self.dropped_pushes = 0
+        self.withheld_pulls = 0
+
+    # -- storage -----------------------------------------------------------
+
+    def _check_and_find(self, key: int) -> np.ndarray:
+        """Lazy init ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339)."""
+        v = self._data.get(key)
+        if v is None:
+            v = (self._rng.standard_normal(self.dim) * np.sqrt(1.0 / self.dim)).astype(
+                np.float32
+            )
+            self._data[key] = v
+            self._accum[key] = np.zeros(self.dim, np.float32)
+            self._shadow[key] = np.tile(v, (self.n_workers, 1))
+        return v
+
+    # -- protocol ----------------------------------------------------------
+
+    def pull(self, keys, worker_epoch: int) -> Optional[Dict[int, np.ndarray]]:
+        """Returns key->value, or None when SSP-withheld (the worker should
+        sleep and retry, pull.h:63-67)."""
+        with self._lock:
+            if (
+                worker_epoch > self.last_epoch_version
+                and self.staleness > self.staleness_threshold
+            ):
+                self.withheld_pulls += 1
+                return None
+            return {int(k): self._check_and_find(int(k)).copy() for k in keys}
+
+    def push(self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int) -> bool:
+        """Apply per-key grads; returns False when dropped as too stale
+        (paramserver.h:201-205).  Grads are batch-summed; they are divided by
+        the minibatch size by the caller (we take pre-averaged grads)."""
+        with self._lock:
+            # staleness ledger (paramserver.h:189-200)
+            behind = self.last_epoch_version - worker_epoch
+            if self.staleness > 0 and worker_id == self.staleness_worker:
+                self.staleness = max(0, behind)
+            if behind > self.staleness:
+                self.staleness = behind
+                self.staleness_worker = worker_id
+            if worker_epoch + self.staleness_threshold < self.last_epoch_version:
+                self.dropped_pushes += 1
+                return False
+            self.last_epoch_version = max(self.last_epoch_version, worker_epoch)
+
+            for key, g in grads.items():
+                key = int(key)
+                g = np.asarray(g, np.float32).reshape(self.dim)
+                w = self._check_and_find(key)
+                if self.updater == "sgd":
+                    w -= self.lr * g
+                elif self.updater == "adagrad":
+                    self._accum[key] += g * g
+                    w -= self.lr * g / np.sqrt(self._accum[key] + self.eps)
+                elif self.updater == "dcasgd":
+                    shadow = self._shadow[key][worker_id]
+                    comp = g + self.dcasgd_lambda * g * g * (w - shadow)
+                    w -= self.lr * comp
+                    self._shadow[key][worker_id] = w.copy()
+                elif self.updater == "dcasgda":
+                    self._accum[key] = self.momentum_rate * self._accum[key] + (
+                        1.0 - self.momentum_rate
+                    ) * g * g
+                    shadow = self._shadow[key][worker_id]
+                    comp = g + (
+                        self.dcasgd_lambda
+                        * g
+                        * g
+                        * (w - shadow)
+                        / np.sqrt(self._accum[key] + self.eps)
+                    )
+                    w -= self.lr * comp
+                    self._shadow[key][worker_id] = w.copy()
+            return True
+
+    def snapshot(self) -> Dict[int, np.ndarray]:
+        with self._lock:
+            return {k: v.copy() for k, v in self._data.items()}

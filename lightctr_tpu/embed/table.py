@@ -1,0 +1,146 @@
+"""Sharded embedding tables — the parameter-server capability, TPU-native.
+
+The reference's sparse KV layer is ``ParamServer<TKey, TValue>``
+(``distribut/paramserver.h``): murmur-hash DHT routing of keys to PS shards
+(``consistent_hash.h:30-40``), unique-key batched pulls (``pull.h:43-99``),
+fp16 wire codec, and per-key optimizer state on the server.  On TPU this
+becomes:
+
+  - table rows sharded over the mesh ``embed`` axis (``P("embed", None)``) —
+    the DHT ring collapses to a static modular partition XLA understands;
+  - pull  -> ``jnp.take`` (XLA emits the cross-shard gather collectives);
+  - push  -> duplicate-key gradient summing (``dedup_grads``) + scatter-add;
+  - per-key optimizer state -> a second table with identical sharding,
+    updated ONLY at touched rows — preserving the sparse semantics of
+    ``AdagradUpdater_Num`` (skip when g == 0, gradientUpdater.h:143) that a
+    dense optax transform would violate (state decay on untouched rows).
+
+Update rules mirror the PS's ``UpdaterType`` branches (paramserver.h:252-300):
+SGD, Adagrad, DCASGD (delayed-compensation with per-worker shadow copies).
+Grad convention: pre-averaged over the batch (the PS divides by
+``__global_minibatch_size`` server-side).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.core.mesh import embed_sharding
+
+
+def init_table(
+    key: jax.Array, num_rows: int, dim: int, mesh=None, scale: Optional[float] = None
+) -> jax.Array:
+    """N(0, 1/dim) rows (the PS lazy-init draws gaussian*sqrt(1/dim),
+    paramserver.h:315-339 check_and_find); row-sharded over ``embed`` when a
+    mesh is given."""
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(float(dim))
+    t = jax.random.normal(key, (num_rows, dim), jnp.float32) * scale
+    if mesh is not None:
+        t = jax.device_put(t, embed_sharding(mesh))
+    return t
+
+
+def lookup(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """Pull: gather rows; with a sharded table XLA inserts the collective."""
+    return jnp.take(table, ids, axis=0)
+
+
+def _bcast(valid: jax.Array, like: jax.Array) -> jax.Array:
+    """Reshape the [n] validity mask to broadcast against [n, ...] deltas."""
+    return valid.reshape((-1,) + (1,) * (like.ndim - 1))
+
+
+def dedup_grads(
+    ids: jax.Array, grads: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sum gradients of duplicate keys (the worker batches unique keys per
+    push, pull.h:46-52 / push.h:55-66).  Static-shape: returns (unique_ids,
+    summed, valid) padded to ``ids.size``.  Padded slots repeat id 0, so ALL
+    downstream scatters must be ``.add`` of ``valid``-masked deltas — a
+    ``.set`` would race between a real id-0 slot and padding."""
+    flat_ids = ids.reshape(-1)
+    flat_g = grads.reshape(flat_ids.shape[0], -1)
+    uids, inv = jnp.unique(
+        flat_ids, return_inverse=True, size=flat_ids.shape[0], fill_value=0
+    )
+    inv = inv.reshape(-1)
+    summed = jax.ops.segment_sum(flat_g, inv, num_segments=flat_ids.shape[0])
+    valid = (jnp.arange(flat_ids.shape[0]) <= jnp.max(inv)).astype(flat_g.dtype)
+    return uids, summed, valid
+
+
+def sparse_sgd_update(
+    table: jax.Array, ids: jax.Array, grads: jax.Array, lr: float
+) -> jax.Array:
+    """PS simple-SGD branch (paramserver.h:296-300)."""
+    uids, g, valid = dedup_grads(ids, grads)
+    g = g.reshape((uids.shape[0],) + table.shape[1:])
+    return table.at[uids].add(-lr * g * _bcast(valid, g))
+
+
+class SparseAdagradState(NamedTuple):
+    accum: jax.Array  # [rows, dim], same sharding as the table
+
+
+def init_adagrad_state(table: jax.Array) -> SparseAdagradState:
+    return SparseAdagradState(accum=jnp.zeros_like(table))
+
+
+def sparse_adagrad_update(
+    table: jax.Array,
+    state: SparseAdagradState,
+    ids: jax.Array,
+    grads: jax.Array,
+    lr: float,
+    eps: float = 1e-7,
+) -> Tuple[jax.Array, SparseAdagradState]:
+    """PS Adagrad branch (paramserver.h:287-295), touched rows only:
+    accum[k] += g^2 ; w[k] -= lr * g / sqrt(accum[k] + eps)."""
+    uids, g, valid = dedup_grads(ids, grads)
+    g = g.reshape((uids.shape[0],) + table.shape[1:])
+    vmask = _bcast(valid, g)
+    accum_rows = jnp.take(state.accum, uids, axis=0) + g * g
+    update = -lr * g * jax.lax.rsqrt(accum_rows + eps)
+    new_accum = state.accum.at[uids].add(g * g * vmask)
+    return table.at[uids].add(update * vmask), SparseAdagradState(accum=new_accum)
+
+
+class SparseDCASGDState(NamedTuple):
+    """Per-worker shadow copies (paramserver.h:33-39 ValueWrapper.shadow_copies)."""
+
+    shadow: jax.Array  # [workers, rows, dim]
+
+
+def init_dcasgd_state(table: jax.Array, n_workers: int) -> SparseDCASGDState:
+    return SparseDCASGDState(shadow=jnp.broadcast_to(table, (n_workers,) + table.shape).copy())
+
+
+def sparse_dcasgd_update(
+    table: jax.Array,
+    state: SparseDCASGDState,
+    worker_id: int,
+    ids: jax.Array,
+    grads: jax.Array,
+    lr: float,
+    dcasgd_lambda: float = 0.1,
+) -> Tuple[jax.Array, SparseDCASGDState]:
+    """PS DCASGD branch (paramserver.h:252-268):
+    g' = g + lambda * g^2 * (w_cur - shadow[worker]);
+    w -= lr * g'; shadow[worker] <- w_new."""
+    uids, g, valid = dedup_grads(ids, grads)
+    g = g.reshape((uids.shape[0],) + table.shape[1:])
+    vmask = _bcast(valid, g)
+    cur = jnp.take(table, uids, axis=0)
+    shadow_rows = jnp.take(state.shadow[worker_id], uids, axis=0)
+    comp = g + dcasgd_lambda * g * g * (cur - shadow_rows)
+    delta = -lr * comp * vmask
+    new_table = table.at[uids].add(delta)
+    # shadow <- w_new, expressed as an add of the masked difference
+    new_shadow = state.shadow.at[worker_id, uids].add(
+        (cur + delta - shadow_rows) * vmask
+    )
+    return new_table, SparseDCASGDState(shadow=new_shadow)

@@ -1,0 +1,71 @@
+"""Field-aware Factorization Machine — TPU-native.
+
+Capability parity with ``Train_FFM_Algo`` (``train/train_ffm_algo.cpp``).  The
+reference computes, per row, the O(nnz^2 * k) pairwise sum
+
+    sum_{i<j}  <V[f_i, field_j], V[f_j, field_i]> * x_i * x_j
+    (train_ffm_algo.cpp:62-70)
+
+as a scalar loop.  A per-pair loop is hostile to the MXU, so we re-derive a
+field-bucketed form.  Let
+
+    G[b, f, g, :] = sum_{i : field_i = f}  x_i * V[fid_i, g, :]
+
+(each feature's embedding *targeted at* field g, bucketed by its own field f).
+Then
+
+    sum_{i != j} x_i x_j <V[f_i, field_j], V[f_j, field_i]>
+        = sum_{f,g} <G[b,f,g,:], G[b,g,f,:]>  -  sum_i x_i^2 |V[fid_i, field_i, :]|^2
+
+and the i<j sum is half that.  G is built with a one-hot field matmul
+(einsum — MXU work), giving O(nnz * field^2 * k) batched flops with no
+per-pair control flow.  An oracle test checks this against the reference's
+literal pairwise formula.
+
+Init parity: V ~ N(0, 1)/sqrt(k) per fm_algo_abst.h:61-64 (field-aware memsize
+branch at fm_algo_abst.h:57-59); W zero.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, feature_cnt: int, field_cnt: int, factor_cnt: int) -> Dict[str, jax.Array]:
+    return {
+        "w": jnp.zeros((feature_cnt,), jnp.float32),
+        "v": jax.random.normal(key, (feature_cnt, field_cnt, factor_cnt), jnp.float32)
+        / jnp.sqrt(float(factor_cnt)),
+    }
+
+
+def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+    vals = batch["vals"] * batch["mask"]                      # [B, P]
+    fids = batch["fids"]                                      # [B, P]
+    fields = batch["fields"]                                  # [B, P]
+    field_cnt = params["v"].shape[1]
+
+    w = jnp.take(params["w"], fids, axis=0)                   # [B, P]
+    linear = jnp.sum(w * vals, axis=-1)
+
+    vg = jnp.take(params["v"], fids, axis=0)                  # [B, P, Fl, k]
+    vx = vg * vals[..., None, None]                           # [B, P, Fl, k]
+    onehot = jax.nn.one_hot(fields, field_cnt, dtype=vx.dtype)  # [B, P, Fl]
+    # G[b, f, g, k] = sum_p onehot[b,p,f] * vx[b,p,g,k]
+    g = jnp.einsum("bpf,bpgk->bfgk", onehot, vx)
+    cross = jnp.einsum("bfgk,bgfk->b", g, g)
+    # self-pair correction: x_i^2 * |V[fid_i, field_i, :]|^2
+    v_self = jnp.take_along_axis(vg, fields[..., None, None], axis=2)[..., 0, :]  # [B, P, k]
+    diag = jnp.sum((v_self * vals[..., None]) ** 2, axis=(1, 2))
+    return linear + 0.5 * (cross - diag)
+
+
+def l2_penalty(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+    """L2 on touched rows (train_ffm_algo.cpp adds L2Reg_ratio per occurrence)."""
+    mask = batch["mask"]
+    w = jnp.take(params["w"], batch["fids"], axis=0)
+    v = jnp.take(params["v"], batch["fids"], axis=0)
+    return 0.5 * (jnp.sum(w * w * mask) + jnp.sum(v * v * mask[..., None, None]))

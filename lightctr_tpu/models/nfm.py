@@ -1,0 +1,61 @@
+"""Neural Factorization Machine (wide + deep) — TPU-native.
+
+Capability parity with ``Train_NFM_Algo`` (``train/train_nfm_algo.{h,cpp}``):
+
+  wide    = W . x                                  (train_nfm_algo.cpp:84-85)
+  bi      = 0.5 * [(sum_i v_i x_i)^2 - sum_i (v_i x_i)^2]   in R^k
+            (the bi-interaction pooling built incrementally at
+             train_nfm_algo.cpp:86-95)
+  deep    = FC_sigmoid(k -> hidden) -> FC_sigmoid(hidden -> 1)
+            (train_nfm_algo.cpp:22-27: both layers Fully_Conn_Layer<Sigmoid>)
+  logit   = wide + deep ; pCTR = sigmoid(logit)    (train_nfm_algo.cpp:100-104)
+
+The reference hand-chains the FC backward into V's gradient
+(accumDeepGrad, train_nfm_algo.cpp:139-159); jax.grad derives the same chain.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.models.fm import l2_penalty as fm_l2_penalty
+from lightctr_tpu.nn import dense
+from lightctr_tpu.ops.activations import sigmoid
+
+
+def init(
+    key: jax.Array, feature_cnt: int, factor_cnt: int, hidden: int
+) -> Dict[str, jax.Array]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {
+        "w": jnp.zeros((feature_cnt,), jnp.float32),
+        "v": jax.random.normal(k1, (feature_cnt, factor_cnt), jnp.float32)
+        / jnp.sqrt(float(factor_cnt)),
+        "fc1": dense.init(k2, factor_cnt, hidden),
+        "fc2": dense.init(k3, hidden, 1),
+    }
+
+
+def bi_interaction(params, batch) -> jax.Array:
+    """0.5[(sum vx)^2 - sum (vx)^2] in R^k — the NFM pooling vector."""
+    vals = batch["vals"] * batch["mask"]
+    v = jnp.take(params["v"], batch["fids"], axis=0)          # [B, P, k]
+    vx = v * vals[..., None]
+    sumvx = jnp.sum(vx, axis=1)                                # [B, k]
+    return 0.5 * (sumvx * sumvx - jnp.sum(vx * vx, axis=1))
+
+
+def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+    vals = batch["vals"] * batch["mask"]
+    w = jnp.take(params["w"], batch["fids"], axis=0)
+    wide = jnp.sum(w * vals, axis=-1)                          # [B]
+    h = dense.apply(params["fc1"], bi_interaction(params, batch), activation=sigmoid)
+    deep = dense.apply(params["fc2"], h, activation=sigmoid)[:, 0]
+    return wide + deep
+
+
+# same touched-row L2 semantics over the same ('w' [F], 'v' [F,k]) params
+l2_penalty = fm_l2_penalty

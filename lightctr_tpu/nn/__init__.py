@@ -1,0 +1,3 @@
+from lightctr_tpu.nn import dense
+
+__all__ = ["dense"]

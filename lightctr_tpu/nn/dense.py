@@ -1,0 +1,60 @@
+"""Fully-connected layer.
+
+Re-designs ``train/layer/fullyconnLayer.h``: weights ~ U(-0.5, 0.5), zero bias
+(fullyconnLayer.h:43-54); per-OUTPUT-UNIT dropout (one mask entry per output
+neuron, re-sampled each minibatch, never on the network's output layer —
+fullyconnLayer.h:49,96-104,199-201).
+
+The reference's mask multiplies activations by {0,1} at train time and uses the
+same weights at inference (no keep-prob rescale).  We implement inverted
+dropout (scale by 1/keep_prob at train time) so inference is the identity —
+the statistically consistent version of the same mechanism; with
+keep_prob=1 (the reference's default configs never enable dropout) the two are
+identical.
+
+The layer is a pure function pair: ``init`` -> params dict, ``apply``.
+Batching, thread re-entrancy (the reference's ThreadLocal activations,
+fullyconnLayer.h:226-232), and the backward pass all come from vmap/jit/grad.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, in_dim: int, out_dim: int) -> Dict[str, jax.Array]:
+    """weight [out, in] ~ U(-0.5, 0.5); bias zeros (fullyconnLayer.h:43-54)."""
+    return {
+        "w": jax.random.uniform(key, (out_dim, in_dim), jnp.float32, -0.5, 0.5),
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def apply(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    activation: Optional[Callable] = None,
+    dropout_mask: Optional[jax.Array] = None,
+    keep_prob: float = 1.0,
+) -> jax.Array:
+    """y = act(x @ W.T + b), optionally masked per output unit.
+
+    ``dropout_mask`` is a [out_dim] 0/1 vector shared across the batch —
+    the reference's semantics of dropping output *units* for a whole
+    minibatch (fullyconnLayer.h:96-104), not per-example bernoulli noise.
+    """
+    y = x @ params["w"].T + params["b"]
+    if activation is not None:
+        y = activation(y)
+    if dropout_mask is not None:
+        y = y * dropout_mask / keep_prob
+    return y
+
+
+def sample_dropout_mask(key: jax.Array, out_dim: int, keep_prob: float) -> jax.Array:
+    """Per-output-unit keep mask, re-sampled once per minibatch
+    (fullyconnLayer.h:199-201)."""
+    return jax.random.bernoulli(key, keep_prob, (out_dim,)).astype(jnp.float32)

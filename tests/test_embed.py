@@ -1,0 +1,131 @@
+"""Sharded embedding tables + async PS parity (paramserver.h semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import embed
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+from lightctr_tpu.embed.table import (
+    init_adagrad_state,
+    init_dcasgd_state,
+)
+
+
+def test_dedup_grads_sums_duplicates(rng):
+    ids = jnp.asarray([3, 7, 3, 3, 9])
+    grads = jnp.asarray([[1.0], [2.0], [10.0], [100.0], [5.0]])
+    uids, summed, valid = embed.dedup_grads(ids, grads)
+    m = {int(u): float(s) for u, s, v in zip(uids, summed[:, 0], valid) if v > 0}
+    assert m == {3: 111.0, 7: 2.0, 9: 5.0}
+
+
+def test_dedup_with_real_id_zero():
+    # id 0 present both as a real key and as padding fill — masked adds must
+    # not double-count
+    ids = jnp.asarray([0, 0, 5])
+    grads = jnp.asarray([[1.0], [1.0], [3.0]])
+    table = jnp.zeros((8, 1))
+    out = embed.sparse_sgd_update(table, ids, grads, lr=1.0)
+    np.testing.assert_allclose(np.asarray(out)[0], [-2.0])
+    np.testing.assert_allclose(np.asarray(out)[5], [-3.0])
+    assert np.all(np.asarray(out)[[1, 2, 3, 4, 6, 7]] == 0)
+
+
+def test_sparse_adagrad_touches_only_seen_rows(rng):
+    table = embed.init_table(jax.random.PRNGKey(0), 16, 4)
+    state = init_adagrad_state(table)
+    ids = jnp.asarray([2, 5, 2])
+    grads = jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32))
+    new_table, new_state = embed.sparse_adagrad_update(table, state, ids, grads, lr=0.1)
+    # untouched rows identical (the g==0 skip of gradientUpdater.h:143)
+    untouched = [i for i in range(16) if i not in (2, 5)]
+    np.testing.assert_array_equal(np.asarray(new_table)[untouched], np.asarray(table)[untouched])
+    assert np.all(np.asarray(new_state.accum)[untouched] == 0)
+    # touched rows follow accum += g^2 ; w -= lr*g/sqrt(accum+eps) with summed dup grads
+    g2 = np.asarray(grads[0] + grads[2])
+    np.testing.assert_allclose(
+        np.asarray(new_state.accum)[2], g2 * g2, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_table)[2],
+        np.asarray(table)[2] - 0.1 * g2 / np.sqrt(g2 * g2 + 1e-7),
+        rtol=1e-4,
+    )
+
+
+def test_sparse_dcasgd_shadow_semantics(rng):
+    table = embed.init_table(jax.random.PRNGKey(1), 8, 2)
+    state = init_dcasgd_state(table, n_workers=2)
+    ids = jnp.asarray([1, 3])
+    g1 = jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))
+    # first push from worker 0: shadow == table -> pure SGD
+    t1, s1 = embed.sparse_dcasgd_update(table, state, 0, ids, g1, lr=0.1)
+    np.testing.assert_allclose(
+        np.asarray(t1)[1], np.asarray(table)[1] - 0.1 * np.asarray(g1)[0], rtol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(s1.shadow[0])[1], np.asarray(t1)[1], rtol=1e-6)
+    # worker 1's shadow unchanged -> its next push gets compensated
+    np.testing.assert_allclose(np.asarray(s1.shadow[1]), np.asarray(table), rtol=1e-6)
+    g2 = jnp.asarray(rng.normal(size=(2, 2)).astype(np.float32))
+    t2, s2 = embed.sparse_dcasgd_update(t1, s1, 1, ids, g2, lr=0.1)
+    gn = np.asarray(g2)[0]
+    comp = gn + 0.1 * gn * gn * (np.asarray(t1)[1] - np.asarray(table)[1])
+    np.testing.assert_allclose(np.asarray(t2)[1], np.asarray(t1)[1] - 0.1 * comp, rtol=1e-4)
+
+
+def test_sharded_table_lookup_matches_host(rng):
+    mesh = make_mesh(MeshSpec(embed=8))
+    table = embed.init_table(jax.random.PRNGKey(0), 64, 4, mesh=mesh)
+    ids = jnp.asarray(rng.integers(0, 64, size=(10,)))
+    got = np.asarray(embed.lookup(table, ids))
+    np.testing.assert_allclose(got, np.asarray(table)[np.asarray(ids)], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Async PS (host parity mode)
+# ---------------------------------------------------------------------------
+
+
+def test_async_ps_ssp_gate_and_staleness():
+    ps = embed.AsyncParamServer(dim=1, updater="sgd", learning_rate=1.0, n_workers=2,
+                                staleness_threshold=2)
+    # worker 0 races ahead; worker 1 lags
+    for epoch in range(1, 6):
+        assert ps.push(0, {1: np.asarray([0.1])}, epoch)
+    # a push 4 epochs behind (> threshold 2) records staleness then is
+    # dropped (paramserver.h:189-205)
+    assert not ps.push(1, {1: np.asarray([0.1])}, 1)
+    assert ps.dropped_pushes == 1
+    assert ps.staleness == 4 and ps.staleness_worker == 1
+    # pull from a worker ahead of last version while stale -> withheld (SSP)
+    assert ps.pull([1], worker_epoch=7) is None
+    assert ps.withheld_pulls == 1
+    # within-threshold push accepted; slowest catching up shrinks staleness
+    assert ps.push(1, {1: np.asarray([0.1])}, 4)
+    assert ps.staleness == 1
+    # once staleness clears, the fast worker's pull succeeds again
+    assert ps.pull([1], worker_epoch=7) is not None
+
+
+def test_async_ps_updaters_match_reference_math():
+    for updater in ("sgd", "adagrad", "dcasgd", "dcasgda"):
+        ps = embed.AsyncParamServer(dim=2, updater=updater, learning_rate=0.5, n_workers=1)
+        vals = ps.pull([7], worker_epoch=0)
+        w0 = vals[7].copy()
+        g = np.asarray([0.2, -0.4], np.float32)
+        ps.push(0, {7: g}, 1)
+        w1 = ps.pull([7], worker_epoch=1)[7]
+        if updater == "sgd":
+            np.testing.assert_allclose(w1, w0 - 0.5 * g, rtol=1e-5)
+        elif updater == "adagrad":
+            np.testing.assert_allclose(w1, w0 - 0.5 * g / np.sqrt(g * g + 1e-7), rtol=1e-5)
+        else:
+            # first push: shadow == w0 -> compensation term zero
+            np.testing.assert_allclose(w1, w0 - 0.5 * g, rtol=1e-4)
+
+
+def test_async_ps_lazy_init_deterministic():
+    ps1 = embed.AsyncParamServer(dim=4, seed=3)
+    ps2 = embed.AsyncParamServer(dim=4, seed=3)
+    np.testing.assert_array_equal(ps1.pull([5], 0)[5], ps2.pull([5], 0)[5])
