@@ -1,0 +1,25 @@
+"""Shared trainer plumbing (CTRTrainer / ClassifierTrainer / VAETrainer)."""
+
+from __future__ import annotations
+
+import optax
+
+from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.core.config import TrainConfig
+
+
+def default_dl_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """The reference DL layers' updater: grad clip at 15 then Adagrad
+    (fullyconnLayer.h:129-131, lstm_unit.h)."""
+    return optax.chain(
+        optim_lib.clip_by_value(cfg.grad_clip) if cfg.grad_clip else optax.identity(),
+        optim_lib.adagrad(cfg.learning_rate),
+    )
+
+
+def check_batch_size(n_rows: int, batch_size: int) -> None:
+    if batch_size > n_rows:
+        raise ValueError(
+            f"batch_size={batch_size} exceeds dataset size {n_rows} "
+            "(drop_remainder would yield zero batches)"
+        )

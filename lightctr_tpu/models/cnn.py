@@ -1,0 +1,55 @@
+"""LeNet-style CNN classifier.
+
+Capability parity with ``Train_CNN_Algo`` (train_cnn_algo.h:37-71), net
+structure for 28x28 inputs ("5x5 12 pool 6 3x3 4 3x3 2 flatten fc"):
+
+  Conv(5x5, 1->6,  stride 2, pad 0)  -> 12x12x6   (tanh)
+  MaxPool(2)                          -> 6x6x6
+  Conv(3x3, 6->16, stride 1)          -> 4x4x16    (tanh, LeNet 6x16 mask)
+  Conv(3x3, 16->20)                   -> 2x2x20    (tanh)
+  flatten (Adapter_Layer)             -> 80
+  FC(80 -> hidden)                    (tanh)
+  FC(hidden -> classes)               -> softmax head
+
+The flatten step subsumes ``Adapter_Layer`` (adapterLayer.h:31-74) — its only
+job was bridging the reference's vector<Matrix*> feature maps to a flat
+vector, a representation gap that doesn't exist with [N,H,W,C] arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.nn import conv, dense, pool
+
+
+def init(key: jax.Array, hidden: int = 200, n_classes: int = 10) -> Dict:
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    return {
+        "conv1": conv.init(k1, 5, 1, 6),
+        "conv2": conv.init(k2, 3, 6, 16),
+        "conv3": conv.init(k3, 3, 16, 20),
+        "fc1": dense.init(k4, 20 * 2 * 2, hidden, scale="fan_in"),
+        "fc2": dense.init(k5, hidden, n_classes, scale="fan_in"),
+    }
+
+
+def logits(params: Dict, feats: jax.Array) -> jax.Array:
+    """feats: [B, 784] flattened 28x28 (dl_algo_abst.h dense CSV rows)."""
+    x = feats.reshape(-1, 28, 28, 1)
+    x = conv.apply(params["conv1"], x, stride=2, activation=jnp.tanh)     # 12x12x6
+    x = pool.max_pool(x, 2)                                                # 6x6x6
+    # static LeNet connectivity (convLayer.h:18-25) — a graph constant, not a
+    # parameter: masked links get zero weight and zero gradient
+    x = conv.apply(
+        params["conv2"], x,
+        connection_mask=jnp.asarray(conv.LENET_CONNECTION_6x16),
+        activation=jnp.tanh,
+    )                                                                      # 4x4x16
+    x = conv.apply(params["conv3"], x, activation=jnp.tanh)                # 2x2x20
+    x = x.reshape(x.shape[0], -1)                                          # 80
+    x = dense.apply(params["fc1"], x, activation=jnp.tanh)
+    return dense.apply(params["fc2"], x)

@@ -1,0 +1,46 @@
+"""Sequence classifier: LSTM + additive attention.
+
+Capability parity with ``Train_RNN_Algo`` (train_rnn_algo.h:34-90): a 28x28
+image is consumed as a 28-step sequence of 28-pixel rows through an LSTM
+(hidden 50), additive attention (inner FC hidden 20) pools the per-step hidden
+states into a context vector, then FC(hidden -> 72, tanh) -> FC(72 -> classes).
+
+The reference forces serial execution for RNNs (dl_algo_abst.h:104-108)
+because its LSTM stores mutable per-step history; the scan-based LSTM
+(nn/lstm.py) has no such restriction — whole batches run in one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.nn import attention, dense, lstm
+
+
+def init(
+    key: jax.Array,
+    seq_len: int = 28,
+    in_dim: int = 28,
+    hidden: int = 50,
+    att_hidden: int = 20,
+    fc_hidden: int = 72,
+    n_classes: int = 10,
+) -> Dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "lstm": lstm.init(k1, in_dim, hidden),
+        "att": attention.init(k2, hidden, att_hidden),
+        "fc1": dense.init(k3, hidden, fc_hidden),
+        "fc2": dense.init(k4, fc_hidden, n_classes),
+    }
+
+
+def logits(params: Dict, feats: jax.Array, seq_len: int = 28, in_dim: int = 28) -> jax.Array:
+    xs = feats.reshape(-1, seq_len, in_dim)
+    hs = lstm.apply_seq(params["lstm"], xs)            # [B, T, H]
+    ctx = attention.apply(params["att"], hs)           # [B, H]
+    h = dense.apply(params["fc1"], ctx, activation=jnp.tanh)
+    return dense.apply(params["fc2"], h)

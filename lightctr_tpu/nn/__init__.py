@@ -1,3 +1,3 @@
-from lightctr_tpu.nn import dense
+from lightctr_tpu.nn import attention, conv, dense, lstm, pool, sample
 
-__all__ = ["dense"]
+__all__ = ["attention", "conv", "dense", "lstm", "pool", "sample"]

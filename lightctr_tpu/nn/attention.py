@@ -1,0 +1,37 @@
+"""Additive attention over a sequence.
+
+Re-designs ``train/unit/attention_unit.h``: per timestep a small MLP scores
+h_t -> FC(D -> fc_hidden) -> act -> FC(fc_hidden -> 1) (attention_unit.h:40-59),
+softmax over the T scores, context = sum_t alpha_t * h_t
+(attention_unit.h:60-75).  The hand-written backward through the softmax and
+inner FC (attention_unit.h:77-118) is autodiff here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.nn import dense
+
+
+def init(key: jax.Array, dim: int, fc_hidden: int) -> Dict[str, jax.Array]:
+    k1, k2 = jax.random.split(key)
+    return {
+        "score1": dense.init(k1, dim, fc_hidden),
+        "score2": dense.init(k2, fc_hidden, 1),
+    }
+
+
+def apply(
+    params: Dict[str, jax.Array],
+    hs: jax.Array,  # [B, T, D]
+    activation: Callable = jnp.tanh,
+) -> jax.Array:
+    """Returns the context vector [B, D]."""
+    s = dense.apply(params["score1"], hs, activation=activation)   # [B, T, H]
+    s = dense.apply(params["score2"], s)[..., 0]                   # [B, T]
+    alpha = jax.nn.softmax(s, axis=-1)                             # [B, T]
+    return jnp.einsum("bt,btd->bd", alpha, hs)

@@ -1,0 +1,71 @@
+"""2-D convolution layer.
+
+Re-designs ``train/layer/convLayer.h`` + ``Matrix::convolution``
+(matrix.h:290-319).  The reference hand-rolls the sliding window per feature
+map with AVX dot products and implements backward as two bespoke deconvolution
+loops (matrix.h:237-288); on TPU the whole family is one
+``lax.conv_general_dilated`` (NHWC/HWIO) whose transpose rules give both
+backward passes, and XLA lowers it onto the MXU.
+
+The LeNet-style sparse input->output map connectivity (``bConnect`` /
+``cnn_dropout_mask``, convLayer.h:18-25,247-253) becomes a static {0,1}
+[in_ch, out_ch] multiplier on the kernel — masked connections get zero weight
+AND zero gradient (mask is constant in the graph).
+
+Init: filters ~ U(-0.5, 0.5)/sqrt(fan_in) — the reference draws FC-style
+U(-0.5, 0.5) (fullyconnLayer.h:49-52); we add the fan-in scale for stable
+training at conv fan-ins (a deliberate deviation, noted for parity review).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# 6 x 16 LeNet sparse link matrix (convLayer.h:18-25)
+LENET_CONNECTION_6x16 = np.asarray(
+    [
+        [1, 0, 0, 0, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 1, 1],
+        [1, 1, 0, 0, 0, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 1],
+        [1, 1, 1, 0, 0, 0, 1, 1, 1, 0, 0, 1, 0, 1, 1, 1],
+        [0, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 0, 1, 0, 1, 1],
+        [0, 0, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 1, 1, 0, 1],
+        [0, 0, 0, 1, 1, 1, 0, 0, 1, 1, 1, 1, 0, 1, 1, 1],
+    ],
+    dtype=np.float32,
+)
+
+
+def init(key: jax.Array, filter_size: int, in_ch: int, out_ch: int) -> Dict[str, jax.Array]:
+    fan_in = filter_size * filter_size * in_ch
+    w = jax.random.uniform(
+        key, (filter_size, filter_size, in_ch, out_ch), jnp.float32, -0.5, 0.5
+    ) / jnp.sqrt(float(fan_in))
+    return {"w": w, "b": jnp.zeros((out_ch,), jnp.float32)}
+
+
+def apply(
+    params: Dict[str, jax.Array],
+    x: jax.Array,  # [N, H, W, C]
+    stride: int = 1,
+    padding: int = 0,
+    connection_mask: Optional[jax.Array] = None,  # [in_ch, out_ch] {0,1}
+    activation: Optional[Callable] = None,
+) -> jax.Array:
+    w = params["w"]
+    if connection_mask is not None:
+        w = w * connection_mask[None, None, :, :]
+    y = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    y = y + params["b"]
+    if activation is not None:
+        y = activation(y)
+    return y

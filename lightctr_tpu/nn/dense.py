@@ -25,10 +25,21 @@ import jax
 import jax.numpy as jnp
 
 
-def init(key: jax.Array, in_dim: int, out_dim: int) -> Dict[str, jax.Array]:
-    """weight [out, in] ~ U(-0.5, 0.5); bias zeros (fullyconnLayer.h:43-54)."""
+def init(
+    key: jax.Array, in_dim: int, out_dim: int, scale: str | None = None
+) -> Dict[str, jax.Array]:
+    """weight [out, in] ~ U(-0.5, 0.5); bias zeros (fullyconnLayer.h:43-54).
+
+    ``scale="fan_in"`` divides by sqrt(in_dim) — a deviation from the
+    reference for deep tanh stacks where the raw uniform saturates
+    activations (the reference compensates with hundreds of epochs)."""
+    w = jax.random.uniform(key, (out_dim, in_dim), jnp.float32, -0.5, 0.5)
+    if scale == "fan_in":
+        w = w / jnp.sqrt(float(in_dim))
+    elif scale is not None:
+        raise ValueError(f"unknown scale {scale!r}")
     return {
-        "w": jax.random.uniform(key, (out_dim, in_dim), jnp.float32, -0.5, 0.5),
+        "w": w,
         "b": jnp.zeros((out_dim,), jnp.float32),
     }
 

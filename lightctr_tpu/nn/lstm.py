@@ -1,0 +1,65 @@
+"""LSTM cell + sequence scan.
+
+Re-designs ``train/unit/lstm_unit.h``: the reference keeps 12 separate weight
+matrices (4 gates x {W_x, W_h, b}, lstm_unit.h:16-38), stores the whole
+per-step history, and hand-writes BPTT (lstm_unit.h:152-277) with gradient
+clipping at 15.  TPU-native form: one fused [in+hidden, 4*hidden] matmul per
+step (MXU-sized), the sequence rolled with ``lax.scan`` (single compiled step,
+static shapes), BPTT by autodiff through the scan, clipping in the optimizer
+(optim.clip_by_value, same threshold).
+
+Gate math (standard, as the reference's): i, f, o = sigmoid; g = tanh;
+c' = f*c + i*g ; h = o * tanh(c').
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init(key: jax.Array, in_dim: int, hidden: int) -> Dict[str, jax.Array]:
+    """Fused kernel [in+hidden, 4*hidden] ~ U(-0.5,0.5)/sqrt(fan_in) (the
+    reference draws FC-style uniforms per matrix, fullyconnLayer.h:49-52);
+    gate order [i | f | g | o]."""
+    k1, _ = jax.random.split(key)
+    fan_in = in_dim + hidden
+    return {
+        "kernel": jax.random.uniform(
+            k1, (fan_in, 4 * hidden), jnp.float32, -0.5, 0.5
+        ) / jnp.sqrt(float(fan_in)),
+        "bias": jnp.zeros((4 * hidden,), jnp.float32),
+    }
+
+
+def cell(
+    params: Dict[str, jax.Array],
+    x_t: jax.Array,      # [B, in]
+    state: Tuple[jax.Array, jax.Array],  # (h [B, H], c [B, H])
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    h, c = state
+    z = jnp.concatenate([x_t, h], axis=-1) @ params["kernel"] + params["bias"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def apply_seq(params: Dict[str, jax.Array], xs: jax.Array) -> jax.Array:
+    """Run the cell over a [B, T, in] sequence; returns all hidden states
+    [B, T, H] (the reference's ``seq_output()`` consumed by attention,
+    lstm_unit.h / train_rnn_algo.h:66)."""
+    b = xs.shape[0]
+    hidden = params["kernel"].shape[1] // 4
+    h0 = jnp.zeros((b, hidden), xs.dtype)
+    c0 = jnp.zeros((b, hidden), xs.dtype)
+
+    def step(state, x_t):
+        return cell(params, x_t, state)
+
+    _, hs = jax.lax.scan(step, (h0, c0), jnp.swapaxes(xs, 0, 1))
+    return jnp.swapaxes(hs, 0, 1)

@@ -1,0 +1,26 @@
+"""Max pooling.
+
+Re-designs ``train/layer/poolingLayer.h``: the reference stores an argmax mask
+per window in thread-local state to route the backward unpooling
+(poolingLayer.h:81-103); ``lax.reduce_window`` + autodiff reproduce exactly
+that (the VJP of a max reduction routes gradients to the argmax).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def max_pool(x: jax.Array, window: int, stride: int | None = None) -> jax.Array:
+    """[N, H, W, C] -> [N, H/w, W/w, C]; non-overlapping by default
+    (Pool_Config{2}, train_cnn_algo.h:42)."""
+    stride = stride if stride is not None else window
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )

@@ -1,0 +1,39 @@
+"""VAE reparameterization ("sample") layer.
+
+Re-designs ``train/layer/sampleLayer.h``: the input is the concatenation
+[mu, log(sigma^2)] (sampleLayer.h:49-52); forward draws
+
+    z = mu + exp(0.5 * log_sigma2) * eps ,  eps ~ N(0, 1)   (sampleLayer.h:58)
+
+and the KL-to-standard-normal term
+
+    KL = 0.5 * sum( exp(log_sigma2) - (1 + log_sigma2) + mu^2 )  (sampleLayer.h:54-56)
+
+is *added to the backward pass* by the reference, scaled by the global
+learning rate (sampleLayer.h:96-101) — i.e. the effective objective is
+``recon + lr * KL``.  Here the KL is an explicit loss term with a
+``kl_weight`` knob; pass ``kl_weight=cfg.learning_rate`` for literal parity.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def split(mu_logsigma2: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., 2G] -> (mu [..., G], log_sigma2 [..., G])."""
+    g = mu_logsigma2.shape[-1] // 2
+    return mu_logsigma2[..., :g], mu_logsigma2[..., g:]
+
+
+def sample(key: jax.Array, mu: jax.Array, log_sigma2: jax.Array) -> jax.Array:
+    eps = jax.random.normal(key, mu.shape, mu.dtype)
+    return mu + jnp.exp(0.5 * log_sigma2) * eps
+
+
+def kl_divergence(mu: jax.Array, log_sigma2: jax.Array) -> jax.Array:
+    """KL(N(mu, sigma^2) || N(0, 1)) summed over the gaussian dims, per row."""
+    return 0.5 * jnp.sum(jnp.exp(log_sigma2) - (1.0 + log_sigma2) + mu * mu, axis=-1)

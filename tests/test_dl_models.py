@@ -1,0 +1,128 @@
+"""CNN / RNN / VAE: shape oracles, LeNet mask gradient isolation, convergence
+on the reference dense dataset (the reference's own oracle is decreasing loss
++ rising accuracy, dl_algo_abst.h:132-177)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.data import load_dense_csv
+from lightctr_tpu.models import cnn, rnn, vae
+from lightctr_tpu.models.dl_trainer import ClassifierTrainer
+from lightctr_tpu.nn import conv, lstm, pool
+
+REF_DENSE = "/root/reference/data/train_dense.csv"
+
+
+def test_conv_matches_scipy_oracle(rng):
+    from scipy import signal
+
+    x = rng.normal(size=(1, 8, 8, 1)).astype(np.float32)
+    params = conv.init(jax.random.PRNGKey(0), 3, 1, 1)
+    y = np.asarray(conv.apply(params, jnp.asarray(x)))
+    w = np.asarray(params["w"])[:, :, 0, 0]
+    want = signal.correlate2d(x[0, :, :, 0], w, mode="valid") + float(params["b"][0])
+    np.testing.assert_allclose(y[0, :, :, 0], want, rtol=1e-3, atol=1e-5)
+
+
+def test_conv_stride_padding_shapes():
+    params = conv.init(jax.random.PRNGKey(0), 5, 1, 6)
+    x = jnp.zeros((2, 28, 28, 1))
+    assert conv.apply(params, x, stride=2).shape == (2, 12, 12, 6)
+    assert conv.apply(params, x, stride=1, padding=2).shape == (2, 28, 28, 6)
+
+
+def test_maxpool_routes_gradient_to_argmax():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]]).reshape(1, 2, 2, 1)
+    g = jax.grad(lambda v: pool.max_pool(v, 2).sum())(x)
+    np.testing.assert_array_equal(
+        np.asarray(g).reshape(2, 2), [[0, 0], [0, 1]]
+    )  # poolingLayer.h:81-103 unpool-to-argmax
+
+
+def test_lenet_mask_blocks_weights_and_grads():
+    params = cnn.init(jax.random.PRNGKey(0))
+    feats = jnp.asarray(np.random.default_rng(0).random((4, 784)), jnp.float32)
+    labels = jnp.asarray([1, 2, 3, 4])
+
+    def loss(p):
+        z = cnn.logits(p, feats)
+        return jnp.sum(z * jax.nn.one_hot(labels, 10))
+
+    g = jax.grad(loss)(params)
+    mask = np.asarray(conv.LENET_CONNECTION_6x16)
+    gw = np.asarray(g["conv2"]["w"])  # [3,3,6,16]
+    blocked = gw[:, :, mask == 0]
+    assert np.all(blocked == 0.0), "masked connections must get zero gradient"
+    assert np.any(np.asarray(g["conv2"]["w"]) != 0)
+
+
+def test_lstm_shapes_and_scan_equivalence(rng):
+    params = lstm.init(jax.random.PRNGKey(0), 5, 7)
+    xs = jnp.asarray(rng.normal(size=(3, 11, 5)).astype(np.float32))
+    hs = lstm.apply_seq(params, xs)
+    assert hs.shape == (3, 11, 7)
+    # scan output step t must equal manual cell iteration
+    h = jnp.zeros((3, 7)); c = jnp.zeros((3, 7))
+    for t in range(11):
+        (h, c), _ = lstm.cell(params, xs[:, t], (h, c))
+    np.testing.assert_allclose(np.asarray(hs[:, -1]), np.asarray(h), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DENSE), reason="reference data not mounted")
+def test_cnn_learns_reference_digits():
+    from lightctr_tpu import optim
+
+    ds = load_dense_csv(REF_DENSE, max_rows=300)
+    cfg = TrainConfig(learning_rate=0.1, minibatch_size=10, epochs=8)
+    params = cnn.init(jax.random.PRNGKey(0), hidden=64)
+    # Adagrad@0.1 (the reference's pairing) needs its 500-epoch budget on this
+    # net; rmsprop reaches high accuracy in 8 epochs — the point here is that
+    # the MODEL learns, with any supported optimizer
+    tr = ClassifierTrainer(
+        params, cnn.logits, cfg, n_classes=10, optimizer=optim.rmsprop(0.01)
+    )
+    hist = tr.fit(ds.features, ds.labels, epochs=8)
+    ev = tr.evaluate(ds.features, ds.labels)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert ev["accuracy"] > 0.8, ev
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DENSE), reason="reference data not mounted")
+def test_rnn_learns_reference_digits():
+    ds = load_dense_csv(REF_DENSE, max_rows=200)
+    cfg = TrainConfig(learning_rate=0.03, minibatch_size=10)  # main.cpp:61 config
+    params = rnn.init(jax.random.PRNGKey(0), hidden=32, fc_hidden=32)
+    tr = ClassifierTrainer(params, rnn.logits, cfg, n_classes=10)
+    hist = tr.fit(ds.features, ds.labels, epochs=10)
+    ev = tr.evaluate(ds.features, ds.labels)
+    assert hist["loss"][-1] < hist["loss"][0]
+    assert ev["accuracy"] > 0.4, ev
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DENSE), reason="reference data not mounted")
+def test_vae_reconstruction_improves():
+    ds = load_dense_csv(REF_DENSE, max_rows=200)
+    cfg = TrainConfig(learning_rate=0.1, minibatch_size=10)  # main.cpp:58 config
+    params = vae.init(jax.random.PRNGKey(0), 784, hidden=60, gauss_cnt=20)
+    tr = vae.VAETrainer(params, cfg)
+    hist = tr.fit(ds.features, epochs=6)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.8
+    # latent encode has the right shape and is deterministic without a key
+    z = vae.encode(tr.params, jnp.asarray(ds.features[:5]))
+    assert z.shape == (5, 20)
+
+
+def test_square_loss_mode_trains(rng):
+    # the reference's Square-on-softmax pairing (main.cpp:198)
+    feats = rng.random((64, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, size=64).astype(np.int32)
+    cfg = TrainConfig(learning_rate=0.1, minibatch_size=16)
+    params = cnn.init(jax.random.PRNGKey(0), hidden=32)
+    tr = ClassifierTrainer(params, cnn.logits, cfg, n_classes=10, loss="square")
+    hist = tr.fit(feats, labels, epochs=3)
+    assert np.isfinite(hist["loss"][-1])
