@@ -1,0 +1,286 @@
+"""word2vec-CBOW embedding training (negative sampling + hierarchical softmax).
+
+Capability parity with ``Train_Embed_Algo`` (train/train_embed_algo.{h,cpp}):
+
+  - CBOW: context window mean predicts the center word
+    (TrainDocument, train_embed_algo.cpp:97-206);
+  - negative sampling from the unigram^0.75 table
+    (train_embed_algo.h:175-200);
+  - hierarchical softmax over a Huffman tree built from word frequencies
+    (train_embed_algo.cpp:15-72);
+  - frequent-word subsampling (train_embed_algo.cpp:111-118);
+  - L2-normalized embedding export, PQ quantization hook, GMM clustering hook
+    (``Quantization()`` / ``EmbeddingCluster()``, main.cpp:234-249).
+
+TPU re-design: the reference trains one document per thread with racy
+("Hogwild", train_embed_algo.cpp:195-200) scalar updates; here center/context
+pairs are batched into fixed-shape arrays on host and each step is one jitted
+gather -> dot -> scatter-add program.  Negative sampling uses
+``jax.random.categorical`` over the unigram^0.75 logits.  Hierarchical softmax
+uses padded Huffman paths (node ids + signs + mask), turning the per-word
+tree walk into dense masked arithmetic.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.core.config import TrainConfig
+
+
+# ---------------------------------------------------------------------------
+# Vocab / corpus prep (host)
+# ---------------------------------------------------------------------------
+
+def load_vocab(path: str) -> Tuple[List[str], np.ndarray]:
+    """Parse the reference's ``vocab.txt`` lines ``id word count``."""
+    words, counts = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 3:
+                continue
+            words.append(parts[1])
+            counts.append(int(parts[2]))
+    return words, np.asarray(counts, np.int64)
+
+
+def subsample_mask(
+    counts: np.ndarray, word_ids: np.ndarray, t: float = 1e-3, seed: int = 0
+) -> np.ndarray:
+    """Frequent-word subsampling (train_embed_algo.cpp:111-118): discard word
+    occurrences with prob 1 - sqrt(t/f) (standard word2vec formulation)."""
+    freq = counts / counts.sum()
+    keep_p = np.minimum(1.0, np.sqrt(t / np.maximum(freq[word_ids], 1e-12)))
+    return np.random.default_rng(seed).random(len(word_ids)) < keep_p
+
+
+def cbow_pairs(
+    docs: List[np.ndarray], window: int, counts: Optional[np.ndarray] = None, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build (centers [M], contexts [M, 2w], ctx_mask [M, 2w]) from documents."""
+    centers, contexts, masks = [], [], []
+    for d, doc in enumerate(docs):
+        if counts is not None:
+            doc = doc[subsample_mask(counts, doc, seed=seed + d)]
+        n = len(doc)
+        for i in range(n):
+            lo, hi = max(0, i - window), min(n, i + window + 1)
+            ctx = np.concatenate([doc[lo:i], doc[i + 1 : hi]])
+            if len(ctx) == 0:
+                continue
+            pad = np.zeros(2 * window, np.int32)
+            m = np.zeros(2 * window, np.float32)
+            pad[: len(ctx)] = ctx
+            m[: len(ctx)] = 1.0
+            centers.append(doc[i])
+            contexts.append(pad)
+            masks.append(m)
+    return (
+        np.asarray(centers, np.int32),
+        np.stack(contexts).astype(np.int32),
+        np.stack(masks).astype(np.float32),
+    )
+
+
+def build_huffman(counts: np.ndarray, max_code_len: int = 40):
+    """Huffman tree over word frequencies (train_embed_algo.cpp:15-72).
+    Returns (paths [V, L] inner-node ids, signs [V, L] +-1, mask [V, L])."""
+    v = len(counts)
+    heap = [(int(c), i) for i, c in enumerate(counts)]
+    heapq.heapify(heap)
+    parent = {}
+    side = {}
+    next_id = v
+    while len(heap) > 1:
+        c1, n1 = heapq.heappop(heap)
+        c2, n2 = heapq.heappop(heap)
+        parent[n1], side[n1] = next_id, 1.0   # left = code 1 -> sigmoid(+x)
+        parent[n2], side[n2] = next_id, -1.0
+        heapq.heappush(heap, (c1 + c2, next_id))
+        next_id += 1
+    root = heap[0][1]
+    paths = np.zeros((v, max_code_len), np.int32)
+    signs = np.zeros((v, max_code_len), np.float32)
+    mask = np.zeros((v, max_code_len), np.float32)
+    for w in range(v):
+        node, p = w, []
+        while node != root:
+            p.append((parent[node] - v, side[node]))  # inner nodes 0..v-2
+            node = parent[node]
+        p = p[::-1][:max_code_len]
+        for j, (nid, s) in enumerate(p):
+            paths[w, j] = nid
+            signs[w, j] = s
+            mask[w, j] = 1.0
+    return paths, signs, mask
+
+
+def negative_table_logits(counts: np.ndarray) -> np.ndarray:
+    """log(unigram^0.75) sampling logits (train_embed_algo.h:175-200)."""
+    p = counts.astype(np.float64) ** 0.75
+    return np.log(p / p.sum()).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Model
+# ---------------------------------------------------------------------------
+
+class Word2VecParams(NamedTuple):
+    emb: jax.Array      # [V, D] input (context) embeddings
+    out: jax.Array      # [V, D] output embeddings (neg sampling) OR
+                        # [V-1 inner nodes padded to V, D] (hierarchical)
+
+
+def init(key: jax.Array, vocab: int, dim: int) -> Word2VecParams:
+    k1, _ = jax.random.split(key)
+    return Word2VecParams(
+        emb=(jax.random.uniform(k1, (vocab, dim)) - 0.5) / dim,  # w2v-style init
+        out=jnp.zeros((vocab, dim), jnp.float32),
+    )
+
+
+def _context_mean(emb, contexts, ctx_mask):
+    vecs = jnp.take(emb, contexts, axis=0)                   # [B, 2w, D]
+    s = jnp.sum(vecs * ctx_mask[..., None], axis=1)
+    return s / jnp.maximum(jnp.sum(ctx_mask, axis=1, keepdims=True), 1.0)
+
+
+def neg_sampling_loss(
+    params: Word2VecParams,
+    centers: jax.Array,       # [B]
+    contexts: jax.Array,      # [B, 2w]
+    ctx_mask: jax.Array,      # [B, 2w]
+    negatives: jax.Array,     # [B, K]
+) -> jax.Array:
+    h = _context_mean(params.emb, contexts, ctx_mask)         # [B, D]
+    u_pos = jnp.take(params.out, centers, axis=0)             # [B, D]
+    u_neg = jnp.take(params.out, negatives, axis=0)           # [B, K, D]
+    pos = jnp.sum(h * u_pos, axis=-1)
+    neg = jnp.einsum("bd,bkd->bk", h, u_neg)
+    return jnp.mean(
+        jax.nn.softplus(-pos) + jnp.sum(jax.nn.softplus(neg), axis=-1)
+    )
+
+
+def hierarchical_loss(
+    params: Word2VecParams,
+    centers: jax.Array,
+    contexts: jax.Array,
+    ctx_mask: jax.Array,
+    paths: jax.Array,   # [V, L]
+    signs: jax.Array,   # [V, L]
+    pmask: jax.Array,   # [V, L]
+) -> jax.Array:
+    h = _context_mean(params.emb, contexts, ctx_mask)          # [B, D]
+    node_ids = jnp.take(paths, centers, axis=0)                # [B, L]
+    s = jnp.take(signs, centers, axis=0)
+    m = jnp.take(pmask, centers, axis=0)
+    u = jnp.take(params.out, node_ids, axis=0)                 # [B, L, D]
+    logits = jnp.einsum("bd,bld->bl", h, u) * s
+    return jnp.mean(jnp.sum(jax.nn.softplus(-logits) * m, axis=-1))
+
+
+class Word2VecTrainer:
+    def __init__(
+        self,
+        vocab_cnt: int,
+        dim: int,
+        cfg: TrainConfig,
+        counts: np.ndarray,
+        mode: str = "negative",      # "negative" | "hierarchical"
+        n_negative: int = 5,
+    ):
+        if mode not in ("negative", "hierarchical"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.cfg = cfg
+        self.mode = mode
+        self.n_negative = n_negative
+        self.params = init(jax.random.PRNGKey(cfg.seed), vocab_cnt, dim)
+        self.tx = optim_lib.adagrad(cfg.learning_rate)
+        self.opt_state = self.tx.init(self.params)
+        self._neg_logits = jnp.asarray(negative_table_logits(counts))
+        if mode == "hierarchical":
+            p, s, m = build_huffman(counts)
+            self._paths, self._signs, self._pmask = (
+                jnp.asarray(p), jnp.asarray(s), jnp.asarray(m),
+            )
+        tx = self.tx
+        mode_ = mode
+
+        def step(params, opt_state, centers, contexts, ctx_mask, key):
+            if mode_ == "negative":
+                negs = jax.random.categorical(
+                    key, self._neg_logits, shape=(centers.shape[0], self.n_negative)
+                )
+                loss, grads = jax.value_and_grad(neg_sampling_loss)(
+                    params, centers, contexts, ctx_mask, negs
+                )
+            else:
+                loss, grads = jax.value_and_grad(hierarchical_loss)(
+                    params, centers, contexts, ctx_mask,
+                    self._paths, self._signs, self._pmask,
+                )
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optim_lib.apply_updates(params, updates), opt_state, loss
+
+        self._step = jax.jit(step)
+
+    def fit(
+        self,
+        centers: np.ndarray,
+        contexts: np.ndarray,
+        ctx_mask: np.ndarray,
+        epochs: int = 5,
+        batch_size: int = 256,
+        verbose: bool = False,
+    ) -> List[float]:
+        key = jax.random.PRNGKey(self.cfg.seed + 1)
+        n = len(centers)
+        if n == 0:
+            raise ValueError("no CBOW pairs to train on")
+        batch_size = min(batch_size, n)
+        history = []
+        for epoch in range(epochs):
+            order = np.random.default_rng(self.cfg.seed + epoch).permutation(n)
+            loss = None
+            for s in range(0, n - batch_size + 1, batch_size):
+                sel = order[s : s + batch_size]
+                key, sub = jax.random.split(key)
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state,
+                    jnp.asarray(centers[sel]), jnp.asarray(contexts[sel]),
+                    jnp.asarray(ctx_mask[sel]), sub,
+                )
+            history.append(float(loss))
+            if verbose:
+                print(f"epoch {epoch}: loss={float(loss):.5f}")
+        return history
+
+    def normalized_embeddings(self) -> np.ndarray:
+        """L2-normalized rows (train_embed_algo.cpp:208-230 export)."""
+        e = np.asarray(self.params.emb)
+        return e / np.maximum(np.linalg.norm(e, axis=1, keepdims=True), 1e-12)
+
+    def quantize(self, part_cnt: int = 10, cluster_cnt: int = 64):
+        """PQ codes of the embeddings (``Quantization()``, main.cpp:240-243)."""
+        from lightctr_tpu.ops import pq
+
+        emb = jnp.asarray(self.normalized_embeddings())
+        cb = pq.train(jax.random.PRNGKey(0), emb, part_cnt=part_cnt, cluster_cnt=cluster_cnt)
+        return cb, np.asarray(pq.encode(cb, emb))
+
+    def cluster(self, n_clusters: int = 20, epochs: int = 30):
+        """GMM clustering of embeddings (``EmbeddingCluster()``, main.cpp:244-248)."""
+        from lightctr_tpu.models import gmm
+
+        emb = self.normalized_embeddings()
+        params = gmm.init_from_data(jax.random.PRNGKey(0), n_clusters, emb)
+        params, _ = gmm.fit(params, emb, epochs=epochs)
+        return gmm.predict(params, emb)

@@ -1,0 +1,45 @@
+"""Model ensembling: voting and AdaBoost reweighting.
+
+Re-designs ``util/ensembling.h``: hard-vote / probability-average ``Voting``
+(ensembling.h:19-63) and ``AdaBoost`` sample reweighting + model weights
+(ensembling.h:65-107).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def vote_hard(predictions: jax.Array) -> jax.Array:
+    """[models, N] class predictions -> [N] majority vote (64-class cap)."""
+    one = jax.nn.one_hot(predictions, 64)
+    return jnp.argmax(jnp.sum(one, axis=0), axis=-1)
+
+
+@jax.jit
+def vote_soft(probs: jax.Array, weights: jax.Array | None = None) -> jax.Array:
+    """[models, N, classes] probabilities (optionally model-weighted) ->
+    [N] argmax of the averaged distribution."""
+    if weights is not None:
+        probs = probs * weights[:, None, None]
+    return jnp.argmax(jnp.mean(probs, axis=0), axis=-1)
+
+
+@jax.jit
+def adaboost_step(
+    sample_weights: jax.Array,  # [N]
+    pred_labels: jax.Array,     # [N]
+    true_labels: jax.Array,     # [N]
+) -> Tuple[jax.Array, jax.Array]:
+    """One AdaBoost round (ensembling.h:65-107): returns (new sample weights,
+    model weight alpha)."""
+    wrong = (pred_labels != true_labels).astype(jnp.float32)
+    err = jnp.clip(jnp.sum(sample_weights * wrong) / jnp.sum(sample_weights), 1e-7, 1 - 1e-7)
+    alpha = 0.5 * jnp.log((1.0 - err) / err)
+    scale = jnp.where(wrong == 1, jnp.exp(alpha), jnp.exp(-alpha))
+    new_w = sample_weights * scale
+    return new_w / jnp.sum(new_w), alpha

@@ -1,0 +1,80 @@
+"""PCA via Sanger's rule (Generalized Hebbian Algorithm) + principal-component
+removal.
+
+Re-designs ``util/pca.h``: the reference trains projection rows with Sanger's
+rule over streamed samples (PCA::Train, pca.h:34-61), offers
+``reduceDimension`` and ``remove_pc`` (subtract projections onto the top
+components — the SIF embedding postprocess, pca.h:71-82).
+
+TPU re-design: Sanger updates run batched under ``lax.scan``; an exact SVD
+path is provided as well (``fit_svd``) since at these sizes XLA's SVD is
+cheaper and exact — the GHA path exists for streaming parity.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init(key: jax.Array, feature_cnt: int, n_components: int) -> jax.Array:
+    w = jax.random.normal(key, (n_components, feature_cnt), jnp.float32) * 0.1
+    return w / jnp.linalg.norm(w, axis=1, keepdims=True)
+
+
+def sanger_step(w: jax.Array, x: jax.Array, lr: float) -> jax.Array:
+    """One batched Sanger update:  dW = lr * (y x^T - LT(y y^T) W),
+    y = W x  (pca.h:34-61, vectorized over the batch)."""
+    y = x @ w.T                                        # [B, C]
+    yyt = y.T @ y                                      # [C, C]
+    lower = jnp.tril(yyt)
+    return w + lr * (y.T @ x - lower @ w) / x.shape[0]
+
+
+def fit_gha(
+    key: jax.Array,
+    x: np.ndarray,
+    n_components: int,
+    epochs: int = 100,
+    lr: float = 0.01,
+    batch_size: int = 64,
+) -> jax.Array:
+    """Streaming GHA training; returns [C, D] component rows."""
+    xj = jnp.asarray(x - x.mean(axis=0, keepdims=True))
+    w = init(key, x.shape[1], n_components)
+    n = xj.shape[0]
+    batch_size = min(batch_size, n)
+    steps = n // batch_size
+
+    @jax.jit
+    def epoch(w, xs):
+        def body(w, b):
+            return sanger_step(w, b, lr), None
+
+        batches = xs[: steps * batch_size].reshape(steps, batch_size, -1)
+        w, _ = jax.lax.scan(body, w, batches)
+        return w
+
+    for _ in range(epochs):
+        w = epoch(w, xj)
+    return w / jnp.linalg.norm(w, axis=1, keepdims=True)
+
+
+def fit_svd(x: np.ndarray, n_components: int) -> jax.Array:
+    """Exact top components via SVD (the XLA-natural path)."""
+    xc = jnp.asarray(x - x.mean(axis=0, keepdims=True))
+    _, _, vt = jnp.linalg.svd(xc, full_matrices=False)
+    return vt[:n_components]
+
+
+def reduce_dimension(w: jax.Array, x: jax.Array) -> jax.Array:
+    """Project rows onto the learned components (pca.h reduceDimension)."""
+    return x @ w.T
+
+
+def remove_pc(w: jax.Array, x: jax.Array) -> jax.Array:
+    """Subtract projections onto the components (pca.h:71-82 remove_pc)."""
+    return x - (x @ w.T) @ w

@@ -1,0 +1,103 @@
+"""Product quantizer for embedding compression.
+
+Re-designs ``util/product_quantizer.h``: split D dims into ``part_cnt``
+sub-vectors, k-means each part to ``cluster_cnt`` centroids (E/M steps with
+empty-cluster re-seeding from the biggest cluster,
+product_quantizer.h:166-185), emit narrow integer codes
+(product_quantizer.h:63-111 train/kmeans).
+
+TPU re-design: all parts train simultaneously under one ``vmap`` of a batched
+k-means step (distance matrices are MXU matmuls); empty clusters are re-seeded
+from the largest cluster's centroid plus a small perturbation — the
+deterministic, shape-static version of the reference's split heuristic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PQCodebook(NamedTuple):
+    centroids: jax.Array  # [parts, clusters, sub_dim]
+
+
+def _pairwise_sq_dist(x: jax.Array, c: jax.Array) -> jax.Array:
+    """[N, d] x [K, d] -> [N, K] squared L2 (one matmul + norms)."""
+    return (
+        jnp.sum(x * x, axis=1)[:, None]
+        - 2.0 * x @ c.T
+        + jnp.sum(c * c, axis=1)[None, :]
+    )
+
+
+def _kmeans_step(x: jax.Array, centroids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One E+M step for one part; returns (new_centroids, assignments)."""
+    k = centroids.shape[0]
+    assign = jnp.argmin(_pairwise_sq_dist(x, centroids), axis=1)      # [N]
+    onehot = jax.nn.one_hot(assign, k, dtype=x.dtype)                 # [N, K]
+    counts = jnp.sum(onehot, axis=0)                                  # [K]
+    sums = onehot.T @ x                                               # [K, d]
+    new = sums / jnp.maximum(counts, 1.0)[:, None]
+    # empty-cluster re-seeding (product_quantizer.h:166-185): adopt the
+    # biggest cluster's centroid + deterministic perturbation
+    biggest = jnp.argmax(counts)
+    reseed = new[biggest][None, :] + 1e-3 * jnp.arange(k, dtype=x.dtype)[:, None]
+    new = jnp.where((counts > 0)[:, None], new, reseed)
+    return new, assign
+
+
+@partial(jax.jit, static_argnames=("part_cnt", "cluster_cnt", "iters"))
+def train(
+    key: jax.Array,
+    embeddings: jax.Array,  # [N, D]
+    part_cnt: int = 8,
+    cluster_cnt: int = 256,
+    iters: int = 20,
+) -> PQCodebook:
+    n, d = embeddings.shape
+    if d % part_cnt != 0:
+        raise ValueError(f"dim {d} not divisible by part_cnt {part_cnt}")
+    sub = d // part_cnt
+    parts = embeddings.reshape(n, part_cnt, sub).transpose(1, 0, 2)   # [P, N, sub]
+    init_idx = jax.random.choice(key, n, (cluster_cnt,), replace=n < cluster_cnt)
+    centroids = parts[:, init_idx, :]                                  # [P, K, sub]
+
+    def body(c, _):
+        c_new = jax.vmap(lambda xs, cs: _kmeans_step(xs, cs)[0])(parts, c)
+        return c_new, None
+
+    centroids, _ = jax.lax.scan(body, centroids, None, length=iters)
+    return PQCodebook(centroids=centroids)
+
+
+@jax.jit
+def encode(codebook: PQCodebook, embeddings: jax.Array) -> jax.Array:
+    """[N, D] -> [N, parts] integer codes."""
+    p, k, sub = codebook.centroids.shape
+    n = embeddings.shape[0]
+    parts = embeddings.reshape(n, p, sub).transpose(1, 0, 2)
+    assign = jax.vmap(lambda xs, cs: jnp.argmin(_pairwise_sq_dist(xs, cs), axis=1))(
+        parts, codebook.centroids
+    )                                                                  # [P, N]
+    dtype = jnp.uint8 if k <= 256 else jnp.int32
+    return assign.T.astype(dtype)
+
+
+@jax.jit
+def decode(codebook: PQCodebook, codes: jax.Array) -> jax.Array:
+    """[N, parts] codes -> [N, D] reconstruction."""
+    p = codebook.centroids.shape[0]
+    recon = jax.vmap(
+        lambda cs, idx: jnp.take(cs, idx, axis=0), in_axes=(0, 1)
+    )(codebook.centroids, codes.astype(jnp.int32))                     # [P, N, sub]
+    return recon.transpose(1, 0, 2).reshape(codes.shape[0], -1)
+
+
+def quantization_error(codebook: PQCodebook, embeddings: jax.Array) -> float:
+    rec = decode(codebook, encode(codebook, embeddings))
+    return float(jnp.mean(jnp.sum((embeddings - rec) ** 2, axis=1)))

@@ -1,0 +1,31 @@
+"""Statistical significance helpers.
+
+Re-designs ``util/significance.h``: erf approximation, standard/custom normal
+CDF, inverse CDF, z-value (significance.h:16-72).  The reference hand-rolls an
+erf polynomial and a binary-search inverse; jax.scipy provides exact kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def erf(x: jax.Array) -> jax.Array:
+    return jax.scipy.special.erf(x)
+
+
+def normal_cdf(x: jax.Array, mu: float = 0.0, sigma: float = 1.0) -> jax.Array:
+    """StandardNormalCDF / NormalCDF (significance.h:28-44)."""
+    return 0.5 * (1.0 + jax.scipy.special.erf((x - mu) / (sigma * jnp.sqrt(2.0))))
+
+
+def inverse_normal_cdf(p: jax.Array, mu: float = 0.0, sigma: float = 1.0) -> jax.Array:
+    """Inverse CDF — the reference binary-searches (significance.h:46-64);
+    ndtri is the closed-form equivalent."""
+    return mu + sigma * jax.scipy.special.ndtri(p)
+
+
+def z_value(confidence: float) -> float:
+    """Two-sided z for a confidence level (significance.h:66-72)."""
+    return float(inverse_normal_cdf(jnp.asarray(0.5 + confidence / 2.0)))

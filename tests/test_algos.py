@@ -1,0 +1,131 @@
+"""word2vec, PQ, quantile compress, PCA, ANN, ensembling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.models import ann, embedding
+from lightctr_tpu.ops import ensembling, pca, pq, quantize
+
+
+def make_corpus(rng, vocab=60, n_docs=80, doc_len=30):
+    """Synthetic corpus with two word communities that co-occur."""
+    docs = []
+    for d in range(n_docs):
+        base = 0 if d % 2 == 0 else vocab // 2
+        docs.append(
+            rng.integers(base, base + vocab // 2, size=doc_len).astype(np.int32)
+        )
+    counts = np.bincount(np.concatenate(docs), minlength=vocab) + 1
+    return docs, counts
+
+
+def test_word2vec_negative_sampling_learns_communities(rng):
+    docs, counts = make_corpus(rng)
+    centers, contexts, mask = embedding.cbow_pairs(docs, window=3)
+    cfg = TrainConfig(learning_rate=0.3, seed=0)
+    tr = embedding.Word2VecTrainer(60, 16, cfg, counts, mode="negative")
+    hist = tr.fit(centers, contexts, mask, epochs=4, batch_size=128)
+    assert hist[-1] < hist[0]
+    emb = tr.normalized_embeddings()
+    # words from the same community should be closer than cross-community
+    same = np.mean([emb[i] @ emb[j] for i in range(0, 10) for j in range(10, 20)])
+    cross = np.mean([emb[i] @ emb[j] for i in range(0, 10) for j in range(40, 50)])
+    assert same > cross, (same, cross)
+
+
+def test_word2vec_hierarchical_softmax(rng):
+    docs, counts = make_corpus(rng, n_docs=40)
+    centers, contexts, mask = embedding.cbow_pairs(docs, window=3)
+    cfg = TrainConfig(learning_rate=0.3, seed=0)
+    tr = embedding.Word2VecTrainer(60, 16, cfg, counts, mode="hierarchical")
+    hist = tr.fit(centers, contexts, mask, epochs=3, batch_size=128)
+    assert hist[-1] < hist[0]
+
+
+def test_huffman_paths_prefix_free():
+    counts = np.asarray([100, 50, 20, 10, 5])
+    paths, signs, mask = embedding.build_huffman(counts)
+    lens = mask.sum(axis=1)
+    # more frequent words get shorter codes
+    assert lens[0] <= lens[-1]
+    # codes (node, sign sequences) are unique
+    codes = set()
+    for w in range(5):
+        code = tuple((paths[w, j], signs[w, j]) for j in range(int(lens[w])))
+        assert code not in codes
+        codes.add(code)
+
+
+def test_pq_roundtrip_reduces_error(rng):
+    x = jnp.asarray(rng.normal(size=(200, 32)).astype(np.float32))
+    cb = pq.train(jax.random.PRNGKey(0), x, part_cnt=8, cluster_cnt=16, iters=15)
+    codes = pq.encode(cb, x)
+    assert codes.shape == (200, 8) and codes.dtype == jnp.uint8
+    rec = pq.decode(cb, codes)
+    err = float(jnp.mean(jnp.sum((x - rec) ** 2, axis=1)))
+    base = float(jnp.mean(jnp.sum(x * x, axis=1)))
+    assert err < base * 0.7, (err, base)
+
+
+def test_quantile_compress_roundtrip(rng):
+    x = jnp.asarray(rng.normal(size=(1000,)).astype(np.float32))
+    # uniform: bounded worst-case error (equal bins)
+    table = quantize.build_table(-4.0, 4.0, bits=8, mode="uniform")
+    codes = quantize.compress(table, x)
+    assert codes.dtype == jnp.uint8
+    rec = quantize.extract(table, codes)
+    assert float(jnp.max(jnp.abs(rec - jnp.clip(x, -4, 4)))) < 0.05
+    # normal: quantile-shaped table concentrates precision in the bulk —
+    # assert small MEAN error on gaussian data (tails are sparse by design)
+    tn = quantize.build_table(-4.0, 4.0, bits=8, mode="normal")
+    rec_n = quantize.extract(tn, quantize.compress(tn, x))
+    assert float(jnp.mean(jnp.abs(rec_n - x))) < 0.02
+
+
+def test_lowbit_quantize(rng):
+    x = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+    codes, dec = quantize.lowbit_quantize(x, bits=1)
+    assert set(np.unique(np.asarray(codes))) <= {0, 1}
+    assert np.all(np.sign(np.asarray(dec)) == np.sign(np.where(np.asarray(x) > 0, 1, -1)))
+
+
+def test_pca_gha_matches_svd(rng):
+    # anisotropic gaussian: top component should align with main axis
+    x = rng.normal(size=(500, 8)).astype(np.float32)
+    x[:, 0] *= 5.0
+    w_svd = np.asarray(pca.fit_svd(x, 2))
+    w_gha = np.asarray(pca.fit_gha(jax.random.PRNGKey(0), x, 2, epochs=60, lr=0.05))
+    # compare up to sign
+    align = abs(float(np.dot(w_svd[0], w_gha[0])))
+    assert align > 0.95, align
+    reduced = pca.reduce_dimension(jnp.asarray(w_svd), jnp.asarray(x))
+    assert reduced.shape == (500, 2)
+    removed = pca.remove_pc(jnp.asarray(w_svd[:1]), jnp.asarray(x))
+    # after removing pc1, variance along it ~ 0
+    assert float(np.abs(np.asarray(removed) @ w_svd[0]).max()) < 1e-2
+
+
+def test_ann_index_recall(rng):
+    corpus = rng.normal(size=(2000, 16)).astype(np.float32)
+    queries = rng.normal(size=(20, 16)).astype(np.float32)
+    exact_idx, _ = ann.brute_force_topk(queries, corpus, 10)
+    index = ann.ANNIndex(n_trees=10, leaf_size=32, seed=0).build(corpus)
+    recalls = []
+    for qi in range(20):
+        got, _ = index.query(queries[qi], 10, search_budget=400)
+        recalls.append(len(set(got.tolist()) & set(exact_idx[qi].tolist())) / 10)
+    assert np.mean(recalls) > 0.6, np.mean(recalls)
+
+
+def test_ensembling(rng):
+    preds = jnp.asarray([[0, 1, 1], [0, 1, 0], [1, 1, 0]])
+    out = np.asarray(ensembling.vote_hard(preds))
+    np.testing.assert_array_equal(out, [0, 1, 0])
+    w = jnp.full((4,), 0.25)
+    pred = jnp.asarray([0, 1, 0, 1])
+    true = jnp.asarray([0, 0, 0, 1])
+    new_w, alpha = ensembling.adaboost_step(w, pred, true)
+    assert float(alpha) > 0  # err = 0.25 < 0.5
+    assert float(new_w[1]) > float(new_w[0])  # misclassified upweighted

@@ -1,0 +1,117 @@
+"""GBDT (histogram split finding), GMM, PLSA."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from lightctr_tpu.models import gbm, gmm, plsa
+from lightctr_tpu.data import load_dense_csv
+from lightctr_tpu.ops.metrics import auc_exact
+
+REF_DENSE = "/root/reference/data/train_dense.csv"
+
+
+def test_gbm_binary_separable(rng):
+    n, f = 400, 10
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 3] > 0).astype(np.float32)
+    model = gbm.GBMModel(gbm.GBMConfig(n_trees=8, max_depth=4, n_bins=32))
+    hist = model.fit(x, y)
+    assert hist[-1] < hist[0]
+    acc = (model.predict(x) == y).mean()
+    assert acc > 0.9, acc
+    auc = auc_exact(model.predict_proba(x), y)
+    assert auc > 0.95, auc
+
+
+def test_gbm_l1_threshold_and_leaf_weight():
+    import jax.numpy as jnp
+
+    # leaf weight formula -TL1(G, l)/(H + l) (train_gbm_algo.h:94-103)
+    g = jnp.asarray([2.0, -2.0, 1e-6])
+    w = -gbm._threshold_l1(g, 1e-5) / (1.0 + 1e-5)
+    np.testing.assert_allclose(
+        np.asarray(w), [-1.99999 / 1.00001, 1.99999 / 1.00001, 0.0], rtol=1e-4
+    )
+
+
+def test_gbm_respects_subsampled_features(rng):
+    # with feature 0 masked out, the tree cannot split on it
+    import jax.numpy as jnp
+
+    n = 256
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    bins, _ = gbm.quantile_bins(x, 16)
+    feat_mask = jnp.asarray([0.0, 1.0, 1.0])
+    tree = gbm.build_tree(
+        jnp.asarray(bins), jnp.asarray(y - 0.5), jnp.full((n,), 0.25),
+        jnp.ones((n,)), feat_mask, 3, 16, 1e-5, 1.0,
+    )
+    used = set(np.asarray(tree.feature)[np.asarray(tree.feature) >= 0].tolist())
+    assert 0 not in used
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DENSE), reason="reference data not mounted")
+def test_gbm_multiclass_digits():
+    ds = load_dense_csv(REF_DENSE, max_rows=300)
+    model = gbm.GBMModel(
+        gbm.GBMConfig(n_trees=5, max_depth=5, n_bins=16, n_classes=10)
+    )
+    hist = model.fit(ds.features, ds.labels)
+    assert hist[-1] < hist[0]
+    acc = (model.predict(ds.features) == ds.labels).mean()
+    assert acc > 0.7, acc
+    leaves = model.leaf_indices(ds.features[:10])
+    assert leaves.shape == (10, 5 * 10)
+
+
+def test_gmm_recovers_clusters(rng):
+    centers = np.asarray([[-3.0, 0.0], [3.0, 0.0], [0.0, 4.0]], np.float32)
+    x = np.concatenate(
+        [rng.normal(size=(100, 2)).astype(np.float32) * 0.5 + c for c in centers]
+    )
+    params = gmm.init_from_data(jax.random.PRNGKey(0), 3, x)
+    params, hist = gmm.fit(params, x, epochs=60)
+    assert hist[-1] > hist[0]
+    labels = gmm.predict(params, x)
+    # cluster purity: each true blob maps to one dominant predicted cluster
+    purities = []
+    for i in range(3):
+        block = labels[i * 100 : (i + 1) * 100]
+        purities.append(np.bincount(block, minlength=3).max() / 100)
+    assert min(purities) > 0.9, purities
+    # learned means close to true centers (up to permutation)
+    mu = np.asarray(params.mu)
+    for c in centers:
+        assert np.min(np.linalg.norm(mu - c, axis=1)) < 0.5
+
+
+def test_gmm_sigma_floor(rng):
+    x = np.zeros((50, 2), np.float32)  # degenerate data
+    params = gmm.init(jax.random.PRNGKey(0), 2, 2)
+    params, _ = gmm.fit(params, x, epochs=5)
+    assert np.all(np.asarray(params.sigma) >= gmm.SIGMA_FLOOR - 1e-6)
+
+
+def test_plsa_recovers_topics(rng):
+    # two disjoint vocabularies -> two topics
+    d, w = 40, 20
+    counts = np.zeros((d, w), np.float32)
+    for i in range(d):
+        if i % 2 == 0:
+            counts[i, :10] = rng.integers(5, 20, size=10)
+        else:
+            counts[i, 10:] = rng.integers(5, 20, size=10)
+    params = plsa.init(jax.random.PRNGKey(0), d, 2, w)
+    params, hist = plsa.fit(params, counts, epochs=100)
+    assert hist[-1] > hist[0]
+    pwt = np.asarray(params.p_word_topic)
+    # each topic should concentrate on one half of the vocabulary
+    frac0 = pwt[:, :10].sum(axis=1)
+    assert (frac0.max() > 0.95) and (frac0.min() < 0.05), frac0
+    vocab = [f"w{i}" for i in range(w)]
+    kw = plsa.topic_keywords(params, vocab, top_k=5)
+    assert len(kw) == 2 and len(kw[0]) == 5
