@@ -1,0 +1,3 @@
+from lightctr_tpu.ckpt.checkpoint import save, restore, latest_step, Checkpointer
+
+__all__ = ["save", "restore", "latest_step", "Checkpointer"]

@@ -1,0 +1,200 @@
+"""Command-line entry point.
+
+Replaces the reference's compile-time ``-D`` role/model selection
+(``main.cpp:80-255``, ``Makefile:20-41``) with one binary and flags — the
+recommended configs from ``main.cpp:56-62`` are the per-model defaults.
+
+Examples
+--------
+    python -m lightctr_tpu.cli fm    --data train_sparse.csv --epochs 200
+    python -m lightctr_tpu.cli ffm   --data train_sparse.csv --factor 4
+    python -m lightctr_tpu.cli nfm   --data train_sparse.csv --hidden 32
+    python -m lightctr_tpu.cli widedeep --data train_sparse.csv
+    python -m lightctr_tpu.cli cnn   --data train_dense.csv --epochs 8
+    python -m lightctr_tpu.cli rnn   --data train_dense.csv
+    python -m lightctr_tpu.cli vae   --data train_dense.csv
+    python -m lightctr_tpu.cli gbm   --data train_dense.csv --n-classes 10
+    python -m lightctr_tpu.cli gmm   --data train_cluster.csv --clusters 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="lightctr_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="model", required=True)
+
+    def common(sp, lr, batch):
+        sp.add_argument("--data", required=True)
+        sp.add_argument("--eval-data")
+        sp.add_argument("--epochs", type=int, default=10)
+        sp.add_argument("--lr", type=float, default=lr)
+        sp.add_argument("--batch-size", type=int, default=batch)
+        sp.add_argument("--seed", type=int, default=0)
+        sp.add_argument("--ckpt-dir")
+        return sp
+
+    for name in ("fm", "ffm", "nfm", "widedeep"):
+        sp = common(sub.add_parser(name), lr=0.1, batch=50)  # main.cpp:56-59
+        sp.add_argument("--factor", type=int, default=8)
+        sp.add_argument("--l2", type=float, default=0.001)
+        if name == "nfm":
+            sp.add_argument("--hidden", type=int, default=32)
+        if name == "widedeep":
+            sp.add_argument("--hidden", type=int, default=50)
+        sp.add_argument("--full-batch", action="store_true",
+                        help="train full-batch per epoch (the reference FM mode)")
+
+    sp = common(sub.add_parser("cnn"), lr=0.1, batch=10)     # main.cpp:60
+    sp.add_argument("--hidden", type=int, default=200)
+    sp.add_argument("--n-classes", type=int, default=10)
+    sp.add_argument("--optimizer", default="rmsprop")
+    sp = common(sub.add_parser("rnn"), lr=0.03, batch=10)    # main.cpp:61
+    sp.add_argument("--hidden", type=int, default=50)
+    sp.add_argument("--n-classes", type=int, default=10)
+    sp.add_argument("--optimizer", default="adagrad")
+    sp = common(sub.add_parser("vae"), lr=0.1, batch=10)     # main.cpp:58
+    sp.add_argument("--hidden", type=int, default=60)
+    sp.add_argument("--gauss", type=int, default=20)
+
+    sp = common(sub.add_parser("gbm"), lr=0.6, batch=0)
+    sp.add_argument("--n-trees", type=int, default=10)
+    sp.add_argument("--max-depth", type=int, default=6)
+    sp.add_argument("--n-classes", type=int, default=1)
+
+    sp = common(sub.add_parser("gmm"), lr=0.0, batch=0)
+    sp.add_argument("--clusters", type=int, default=10)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import jax
+
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.data import load_dense_csv, load_libffm
+
+    cfg = TrainConfig(
+        learning_rate=args.lr,
+        minibatch_size=max(1, getattr(args, "batch_size", 1) or 1),
+        lambda_l2=getattr(args, "l2", 0.0),
+        seed=args.seed,
+    )
+    report = {"model": args.model}
+
+    if args.model in ("fm", "ffm", "nfm", "widedeep"):
+        from lightctr_tpu.models import fm, ffm, nfm, widedeep
+        from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+        ds = load_libffm(args.data)
+        key = jax.random.PRNGKey(args.seed)
+        if args.model == "fm":
+            params, logits, l2 = fm.init(key, ds.feature_cnt, args.factor), fm.logits, fm.l2_penalty
+        elif args.model == "ffm":
+            params, logits, l2 = (
+                ffm.init(key, ds.feature_cnt, ds.field_cnt, args.factor), ffm.logits, ffm.l2_penalty,
+            )
+        elif args.model == "nfm":
+            params, logits, l2 = (
+                nfm.init(key, ds.feature_cnt, args.factor, args.hidden), nfm.logits, nfm.l2_penalty,
+            )
+        else:
+            params, logits, l2 = (
+                widedeep.init(key, ds.feature_cnt, ds.field_cnt, args.factor, args.hidden),
+                widedeep.logits, None,
+            )
+        batch = ds.batch_dict()
+        if args.model == "widedeep":
+            rep, rep_mask = widedeep.field_representatives(ds.fids, ds.fields, ds.mask, ds.field_cnt)
+            batch = widedeep.make_batch(ds, rep, rep_mask)
+        tr = CTRTrainer(params, logits, cfg, l2_fn=l2)
+        hist = tr.fit(
+            batch,
+            epochs=args.epochs,
+            batch_size=None if args.full_batch else cfg.minibatch_size,
+        )
+        report["train"] = tr.evaluate(batch)
+        report["final_loss"] = hist["loss"][-1]
+        report["wall_time_s"] = round(hist["wall_time_s"], 3)
+        if args.eval_data:
+            ev = load_libffm(args.eval_data, feature_cnt=ds.feature_cnt, field_cnt=ds.field_cnt)
+            evb = ev.batch_dict()
+            if args.model == "widedeep":
+                rep, rep_mask = widedeep.field_representatives(ev.fids, ev.fields, ev.mask, ds.field_cnt)
+                evb = widedeep.make_batch(ev, rep, rep_mask)
+            report["eval"] = tr.evaluate(evb)
+        if args.ckpt_dir:
+            from lightctr_tpu import ckpt
+
+            report["checkpoint"] = ckpt.save(args.ckpt_dir, args.epochs, {
+                "params": tr.params, "opt_state": tr.opt_state,
+            })
+
+    elif args.model in ("cnn", "rnn"):
+        from lightctr_tpu import optim
+        from lightctr_tpu.models import cnn, rnn
+        from lightctr_tpu.models.dl_trainer import ClassifierTrainer
+
+        ds = load_dense_csv(args.data)
+        key = jax.random.PRNGKey(args.seed)
+        if args.model == "cnn":
+            params, logits = cnn.init(key, hidden=args.hidden, n_classes=args.n_classes), cnn.logits
+        else:
+            params, logits = rnn.init(key, hidden=args.hidden, n_classes=args.n_classes), rnn.logits
+        opt = optim.get(args.optimizer, learning_rate=args.lr)
+        tr = ClassifierTrainer(params, logits, cfg, n_classes=args.n_classes, optimizer=opt)
+        hist = tr.fit(ds.features, ds.labels, epochs=args.epochs, batch_size=cfg.minibatch_size)
+        report["train"] = tr.evaluate(ds.features, ds.labels)
+        report["final_loss"] = hist["loss"][-1]
+        report["wall_time_s"] = round(hist["wall_time_s"], 3)
+
+    elif args.model == "vae":
+        from lightctr_tpu.models import vae
+
+        ds = load_dense_csv(args.data)
+        params = vae.init(jax.random.PRNGKey(args.seed), ds.features.shape[1],
+                          hidden=args.hidden, gauss_cnt=args.gauss)
+        tr = vae.VAETrainer(params, cfg)
+        hist = tr.fit(ds.features, epochs=args.epochs, batch_size=cfg.minibatch_size)
+        report["final_loss"] = hist["loss"][-1]
+        report["wall_time_s"] = round(hist["wall_time_s"], 3)
+
+    elif args.model == "gbm":
+        from lightctr_tpu.models import gbm
+        from lightctr_tpu.ops.metrics import auc_exact
+
+        ds = load_dense_csv(args.data)
+        model = gbm.GBMModel(gbm.GBMConfig(
+            n_trees=args.n_trees, max_depth=args.max_depth,
+            n_classes=args.n_classes, seed=args.seed,
+        ))
+        y = ds.labels if args.n_classes > 1 else (ds.labels > 0).astype(np.float32)
+        hist = model.fit(ds.features, y)
+        report["final_loss"] = hist[-1]
+        report["train_accuracy"] = float((model.predict(ds.features) == y).mean())
+        if args.n_classes <= 1:
+            report["train_auc"] = auc_exact(model.predict_proba(ds.features), y)
+
+    elif args.model == "gmm":
+        from lightctr_tpu.models import gmm
+
+        raw = np.loadtxt(args.data, delimiter=",", dtype=np.float32)
+        params = gmm.init_from_data(jax.random.PRNGKey(args.seed), args.clusters, raw)
+        params, hist = gmm.fit(params, raw, epochs=args.epochs)
+        report["final_loglik"] = hist[-1]
+        report["cluster_sizes"] = np.bincount(
+            gmm.predict(params, raw), minlength=args.clusters
+        ).tolist()
+
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

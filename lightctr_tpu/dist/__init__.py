@@ -3,5 +3,12 @@ from lightctr_tpu.dist.collectives import (
     ring_broadcast,
     psum_all_reduce,
 )
+from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, initialize_multihost
 
-__all__ = ["ring_all_reduce", "ring_broadcast", "psum_all_reduce"]
+__all__ = [
+    "ring_all_reduce",
+    "ring_broadcast",
+    "psum_all_reduce",
+    "HeartbeatMonitor",
+    "initialize_multihost",
+]

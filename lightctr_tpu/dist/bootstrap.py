@@ -1,0 +1,113 @@
+"""Multi-host bootstrap + liveness — the control plane.
+
+The reference runs its own control plane: a Master process assigns node ids,
+broadcasts the topology, runs a 5s-period heartbeat with exponential backoff
+(10s stale -> re-ping, 20s -> declared dead and unrouted, master.h:202-262),
+and coordinates FIN shutdown barriers.  On TPU pods that entire role is played
+by the JAX distributed runtime: ``jax.distributed.initialize`` connects every
+host to the coordinator (the Master's handshake, master.h:66-120), device/mesh
+discovery replaces topology broadcast, and the runtime's own failure detection
+replaces heartbeats — a host that dies takes the collective down rather than
+being silently unrouted, which is the correct semantic for synchronous SPMD.
+
+``HeartbeatMonitor`` remains for the *host-side* async components (the
+AsyncParamServer workers, data-feeder threads): reference-equivalent liveness
+bookkeeping with backoff and a dead-callback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+HEARTBEAT_PERIOD_S = 5.0   # master.h:202 (5 s period)
+STALE_AFTER_S = 10.0       # master.h: 10 s -> immediate re-ping
+DEAD_AFTER_S = 20.0        # master.h: 20 s -> declared dead
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the pod (no-op for single-process runs).  Environment-driven when
+    args are None, like jax.distributed.initialize itself."""
+    import jax
+
+    if num_processes is not None and num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class HeartbeatMonitor:
+    """Liveness ledger for host-side workers (master.h:202-262 semantics):
+    ``beat(worker)`` marks liveness; a monitor thread declares workers stale
+    at 10s and dead at 20s, invoking ``on_dead`` once per death."""
+
+    def __init__(
+        self,
+        on_dead: Optional[Callable[[str], None]] = None,
+        stale_after_s: float = STALE_AFTER_S,
+        dead_after_s: float = DEAD_AFTER_S,
+        period_s: float = HEARTBEAT_PERIOD_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._last: Dict[str, float] = {}
+        self._dead: set = set()
+        self._on_dead = on_dead
+        self.stale_after_s = stale_after_s
+        self.dead_after_s = dead_after_s
+        self.period_s = period_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self, worker: str) -> None:
+        with self._lock:
+            self._last[worker] = self._clock()
+            if worker in self._dead:
+                # re-registration of a returning node is tolerated
+                # (master.h:80-82)
+                self._dead.discard(worker)
+
+    def check(self) -> Dict[str, str]:
+        """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
+        now = self._clock()
+        out = {}
+        with self._lock:
+            for w, t in self._last.items():
+                age = now - t
+                if age >= self.dead_after_s:
+                    out[w] = "dead"
+                    if w not in self._dead:
+                        self._dead.add(w)
+                        if self._on_dead:
+                            self._on_dead(w)
+                elif age >= self.stale_after_s:
+                    out[w] = "stale"
+                else:
+                    out[w] = "alive"
+        return out
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.period_s):
+                self.check()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.period_s)
+            self._thread = None

@@ -1,0 +1,21 @@
+from lightctr_tpu.graph.dag import (
+    Graph,
+    source,
+    trainable,
+    add,
+    multiply,
+    matmul,
+    activation,
+    logistic_loss_node,
+)
+
+__all__ = [
+    "Graph",
+    "source",
+    "trainable",
+    "add",
+    "multiply",
+    "matmul",
+    "activation",
+    "logistic_loss_node",
+]

@@ -1,0 +1,162 @@
+"""Thin autograd DAG builder — API parity with ``LightCTR/dag``.
+
+The reference hand-builds a dataflow graph of nodes with futures/promises,
+CAS-guarded single execution, and hand-written VJPs per op
+(``dag/node_abst.h:57-231``, ``dag/operator/*.h``), executed on its thread
+pool.  On TPU every piece of that machinery is subsumed by XLA: the graph IS
+the jaxpr, scheduling IS XLA's, and VJPs come from ``jax.grad``.
+
+What remains worth keeping is the *builder API*: declare sources, trainables,
+and op nodes; get a compiled forward function and a training step.  This
+module provides that surface (dag_pipeline.h:28-37 ``addDirectedFlow`` /
+``addAutogradFlow`` equivalents) as a tiny graph description that compiles to
+one jitted function — the demo graph sigma(w*x+b) with logistic loss from
+``main.cpp:80-116`` is the doctest below.
+
+Example (the reference's -DDAG unit test):
+
+    g = Graph()
+    x = g.add_node(source("x"))
+    w = g.add_node(trainable("w", init=jnp.ones((4,))))
+    b = g.add_node(trainable("b", init=jnp.zeros(())))
+    wx = g.add_node(matmul(w, x))
+    z = g.add_node(add(wx, b))
+    p = g.add_node(activation(z, "sigmoid"))
+    loss = g.add_node(logistic_loss_node(p, label_name="y"))
+    step = g.compile_train_step(loss, optim.sgd(0.1))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.ops import activations as act_lib
+from lightctr_tpu.ops import losses as loss_lib
+
+
+@dataclasses.dataclass
+class Node:
+    kind: str                       # source | trainable | op
+    name: str
+    inputs: List[int]
+    fn: Optional[Callable] = None   # op nodes: fn(*input_values) -> value
+    init: Optional[jax.Array] = None  # trainable nodes
+
+
+def source(name: str) -> Node:
+    """Graph input (SourceNode, dag/source_node.h)."""
+    return Node(kind="source", name=name, inputs=[])
+
+
+def trainable(name: str, init: jax.Array) -> Node:
+    """Learnable leaf with its own updater state
+    (TrainableNode, dag/source_node.h:63-77)."""
+    return Node(kind="trainable", name=name, inputs=[], init=jnp.asarray(init))
+
+
+def add(a: int, b: int) -> Node:
+    """AddOp (dag/operator/add_op.h)."""
+    return Node(kind="op", name="add", inputs=[a, b], fn=lambda x, y: x + y)
+
+
+def multiply(a: int, b: int) -> Node:
+    """MultiplyOp — elementwise."""
+    return Node(kind="op", name="multiply", inputs=[a, b], fn=lambda x, y: x * y)
+
+
+def matmul(a: int, b: int) -> Node:
+    """MatmulOp (dag/operator/matmul_op.h — a dot product in the reference)."""
+    return Node(kind="op", name="matmul", inputs=[a, b], fn=lambda x, y: x @ y)
+
+
+def activation(a: int, name: str) -> Node:
+    """ActivationsOp<Act> (dag/operator/activations_op.h)."""
+    fn = act_lib.get(name)
+    return Node(kind="op", name=f"act:{name}", inputs=[a], fn=fn)
+
+
+def logistic_loss_node(pred: int, label_name: str = "label") -> Node:
+    """LossOp<Logistic> terminus (dag/operator/loss_op.h:29-50).  The node's
+    input is a *probability* (like the reference's sigmoid -> loss pairing);
+    the loss is the clamped BCE."""
+    node = Node(kind="op", name="loss:logistic", inputs=[pred], fn=None)
+    node.fn = ("__loss__", label_name)  # type: ignore[assignment]
+    return node
+
+
+class Graph:
+    """Builds a node list; compiles to jitted forward / train-step functions
+    (the runFlow equivalents, terminus_node.h:23-26 / source_node.h:24)."""
+
+    def __init__(self):
+        self.nodes: List[Node] = []
+
+    def add_node(self, node: Node) -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    # -- compilation -------------------------------------------------------
+
+    def init_params(self) -> Dict[str, jax.Array]:
+        return {
+            n.name: n.init for n in self.nodes if n.kind == "trainable"
+        }
+
+    def _eval(self, out_id: int, params, feeds):
+        values: Dict[int, jax.Array] = {}
+
+        def ev(i: int):
+            if i in values:
+                return values[i]  # cached single execution (node_abst.h:66)
+            n = self.nodes[i]
+            if n.kind == "source":
+                v = feeds[n.name]
+            elif n.kind == "trainable":
+                v = params[n.name]
+            else:
+                if isinstance(n.fn, tuple) and n.fn[0] == "__loss__":
+                    pred = ev(n.inputs[0])
+                    v = loss_lib.bce_on_probs(pred, feeds[n.fn[1]], reduction="mean")
+                else:
+                    v = n.fn(*[ev(j) for j in n.inputs])
+            values[i] = v
+            return v
+
+        return ev(out_id)
+
+    def compile_forward(self, out_id: int) -> Callable:
+        """jitted (params, feeds) -> value of node ``out_id``."""
+
+        @jax.jit
+        def forward(params, feeds):
+            return self._eval(out_id, params, feeds)
+
+        return forward
+
+    def compile_train_step(
+        self, loss_id: int, optimizer: Optional[optax.GradientTransformation] = None
+    ):
+        """Returns (step, opt_state0): step(params, opt_state, feeds) ->
+        (params, opt_state, loss) — forward + backward + per-trainable update
+        in one compiled program (replacing the promise/future dance of
+        node_abst.h:57-231)."""
+        tx = optimizer or optim_lib.sgd(0.1)
+        params0 = self.init_params()
+        opt_state0 = tx.init(params0)
+
+        @jax.jit
+        def step(params, opt_state, feeds):
+            def loss_fn(p):
+                return self._eval(loss_id, p, feeds)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state, params)
+            return optim_lib.apply_updates(params, updates), opt_state2, loss
+
+        return step, opt_state0

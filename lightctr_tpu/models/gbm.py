@@ -188,8 +188,10 @@ def build_tree(
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
-def tree_predict(tree: Tree, bins: jax.Array, max_depth: int) -> jax.Array:
-    """Route all rows down the array-encoded tree: max_depth gather steps."""
+def tree_route(tree: Tree, bins: jax.Array, max_depth: int) -> jax.Array:
+    """Route all rows down the array-encoded tree: max_depth gather steps.
+    Returns the resting node index per row (the reference's
+    nextLevel/locAtLeafWeight walk, gbm_algo_abst.h:127-151)."""
     n, f = bins.shape
     idx = jnp.zeros((n,), jnp.int32)
     for _ in range(max_depth):
@@ -199,7 +201,12 @@ def tree_predict(tree: Tree, bins: jax.Array, max_depth: int) -> jax.Array:
         internal = feat >= 0
         child = jnp.where(b <= thr, 2 * idx + 1, 2 * idx + 2)
         idx = jnp.where(internal, child, idx)
-    return jnp.take(tree.weight, idx)
+    return idx
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def tree_predict(tree: Tree, bins: jax.Array, max_depth: int) -> jax.Array:
+    return jnp.take(tree.weight, tree_route(tree, bins, max_depth))
 
 
 class GBMModel:
@@ -303,15 +310,7 @@ class GBMModel:
         """Per-tree leaf index for each row — the GBM->LR stacking feature
         (BASELINE.json config 5: 'GBM leaf-index -> FTRL_LR stacked model')."""
         bins = jnp.asarray(self._bin(x))
-        cols = []
-        for tree in self.trees:
-            idx = jnp.zeros((x.shape[0],), jnp.int32)
-            f = bins.shape[1]
-            for _ in range(self.cfg.max_depth):
-                feat = jnp.take(tree.feature, idx)
-                thr = jnp.take(tree.threshold, idx)
-                b = jnp.take_along_axis(bins, jnp.clip(feat, 0, f - 1)[:, None], axis=1)[:, 0]
-                child = jnp.where(b <= thr, 2 * idx + 1, 2 * idx + 2)
-                idx = jnp.where(feat >= 0, child, idx)
-            cols.append(np.asarray(idx))
-        return np.stack(cols, axis=1)
+        return np.stack(
+            [np.asarray(tree_route(t, bins, self.cfg.max_depth)) for t in self.trees],
+            axis=1,
+        )

@@ -82,12 +82,21 @@ def e_step(params: GMMParams, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 @jax.jit
 def m_step(params: GMMParams, x: jax.Array, resp: jax.Array) -> GMMParams:
     soft_cnt = jnp.sum(resp, axis=0)                               # [K]
-    mu = (resp.T @ x) / soft_cnt[:, None]                          # [K, D]
+    # starved clusters (zero responsibility mass) keep their previous
+    # parameters instead of dividing by zero and NaN-ing the whole model
+    safe_cnt = jnp.maximum(soft_cnt, 1e-12)[:, None]
+    alive = (soft_cnt > 0)[:, None]
+    mu = jnp.where(alive, (resp.T @ x) / safe_cnt, params.mu)      # [K, D]
     # reference computes sigma against the PREVIOUS mu (train_gmm_algo.cpp:101-106)
     diff = x[:, None, :] - params.mu[None, :, :]
-    sigma = jnp.einsum("nk,nkd->kd", resp, diff * diff) / soft_cnt[:, None]
+    sigma = jnp.where(
+        alive,
+        jnp.einsum("nk,nkd->kd", resp, diff * diff) / safe_cnt,
+        params.sigma,
+    )
     sigma = jnp.maximum(sigma, SIGMA_FLOOR)
-    return GMMParams(mu=mu, sigma=sigma, weight=soft_cnt / x.shape[0])
+    weight = jnp.maximum(soft_cnt / x.shape[0], 1e-12)  # keep log(weight) finite
+    return GMMParams(mu=mu, sigma=sigma, weight=weight)
 
 
 def fit(

@@ -1,0 +1,15 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference's entire runtime is C++; the TPU framework keeps native code
+where it still pays: data ingest (libffm_parser.cpp) and the persistent
+shared-memory KV store (shm_kv.cpp).  Bindings are ctypes (no pybind11 in the
+image).  ``lib()`` compiles once per source change and caches the .so.
+"""
+
+from lightctr_tpu.native.bindings import (
+    available,
+    parse_libffm_native,
+    ShmKV,
+)
+
+__all__ = ["available", "parse_libffm_native", "ShmKV"]

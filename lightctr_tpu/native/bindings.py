@@ -1,0 +1,222 @@
+"""ctypes bindings + on-demand build of the native components."""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp"]
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_BUILD_ERROR: Optional[str] = None
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _BUILD_ERROR
+    so_path = os.path.join(_DIR, f"_lightctr_native_{_source_digest()}.so")
+    if not os.path.exists(so_path):
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+            *[os.path.join(_DIR, s) for s in _SOURCES],
+            "-o", so_path,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            _BUILD_ERROR = getattr(e, "stderr", str(e)) or str(e)
+            return None
+    lib = ctypes.CDLL(so_path)
+    # signatures
+    lib.ffm_scan.restype = ctypes.c_int
+    lib.ffm_scan.argtypes = [ctypes.c_char_p] + [ctypes.POINTER(ctypes.c_long)] * 5
+    lib.ffm_parse.restype = ctypes.c_int
+    lib.ffm_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.shmkv_create.restype = ctypes.c_void_p
+    lib.shmkv_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+    lib.shmkv_open.restype = ctypes.c_void_p
+    lib.shmkv_open.argtypes = [ctypes.c_char_p]
+    for name in ("shmkv_capacity", "shmkv_dim", "shmkv_used"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_uint64
+        fn.argtypes = [ctypes.c_void_p]
+    lib.shmkv_get.restype = ctypes.c_int
+    lib.shmkv_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float)]
+    lib.shmkv_set.restype = ctypes.c_int
+    lib.shmkv_set.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float)]
+    lib.shmkv_add.restype = ctypes.c_int
+    lib.shmkv_add.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float)]
+    lib.shmkv_get_batch.restype = ctypes.c_int
+    lib.shmkv_get_batch.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.shmkv_sync.restype = ctypes.c_int
+    lib.shmkv_sync.argtypes = [ctypes.c_void_p]
+    lib.shmkv_close.restype = None
+    lib.shmkv_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    with _LOCK:
+        if _LIB is None and _BUILD_ERROR is None:
+            _LIB = _build()
+        return _LIB
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _iptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+
+
+def parse_libffm_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Two-pass native parse -> (fields, fids, vals, mask, labels) padded
+    arrays.  Raises on parse errors with the offending line number."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    n_rows = ctypes.c_long()
+    max_nnz = ctypes.c_long()
+    max_fid = ctypes.c_long()
+    max_field = ctypes.c_long()
+    err_line = ctypes.c_long()
+    rc = l_.ffm_scan(
+        path.encode(), ctypes.byref(n_rows), ctypes.byref(max_nnz),
+        ctypes.byref(max_fid), ctypes.byref(max_field), ctypes.byref(err_line),
+    )
+    if rc == -1:
+        raise FileNotFoundError(path)
+    if rc == -2:
+        raise ValueError(f"{path}:{err_line.value}: bad libFFM token (expected field:fid:val)")
+    n, p = n_rows.value, max_nnz.value
+    fields = np.zeros((n, p), np.int32)
+    fids = np.zeros((n, p), np.int32)
+    vals = np.zeros((n, p), np.float32)
+    mask = np.zeros((n, p), np.float32)
+    labels = np.zeros((n,), np.float32)
+    if n > 0 and p > 0:
+        rc = l_.ffm_parse(
+            path.encode(), n, p, _iptr(fields), _iptr(fids), _fptr(vals),
+            _fptr(mask), _fptr(labels),
+        )
+        if rc != 0:
+            raise ValueError(f"{path}: parse failed (rc={rc})")
+    return fields, fids, vals, mask, labels
+
+
+class ShmKV:
+    """Persistent shared-memory KV of float rows (ShmHashTable +
+    PersistentBuffer parity; see shm_kv.cpp)."""
+
+    def __init__(self, handle, dim: int):
+        self._h = handle
+        self.dim = dim
+
+    @classmethod
+    def create(cls, path: str, capacity: int, dim: int) -> "ShmKV":
+        l_ = lib()
+        if l_ is None:
+            raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+        h = l_.shmkv_create(path.encode(), capacity, dim)
+        if not h:
+            raise OSError(f"cannot create store at {path}")
+        return cls(h, dim)
+
+    @classmethod
+    def open(cls, path: str) -> "ShmKV":
+        l_ = lib()
+        if l_ is None:
+            raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+        h = l_.shmkv_open(path.encode())
+        if not h:
+            raise OSError(f"cannot open store at {path}")
+        return cls(h, lib().shmkv_dim(h))
+
+    @property
+    def capacity(self) -> int:
+        return lib().shmkv_capacity(self._h)
+
+    @property
+    def used(self) -> int:
+        return lib().shmkv_used(self._h)
+
+    def get(self, key: int) -> Optional[np.ndarray]:
+        out = np.zeros(self.dim, np.float32)
+        rc = lib().shmkv_get(self._h, key, _fptr(out))
+        return out if rc == 0 else None
+
+    _SENTINEL = (1 << 64) - 1  # EMPTY slot marker in shm_kv.cpp
+
+    def _check_key(self, key: int) -> None:
+        if not (0 <= key < self._SENTINEL):
+            raise ValueError(f"key {key} out of range [0, 2^64-1)")
+
+    def set(self, key: int, value: np.ndarray) -> None:
+        self._check_key(key)
+        v = np.ascontiguousarray(value, np.float32)
+        if v.shape != (self.dim,):
+            raise ValueError(f"value shape {v.shape} != ({self.dim},)")
+        rc = lib().shmkv_set(self._h, key, _fptr(v))
+        if rc == -2:
+            raise RuntimeError("store full")
+
+    def add(self, key: int, delta: np.ndarray) -> None:
+        self._check_key(key)
+        v = np.ascontiguousarray(delta, np.float32)
+        if v.shape != (self.dim,):
+            raise ValueError(f"delta shape {v.shape} != ({self.dim},)")
+        rc = lib().shmkv_add(self._h, key, _fptr(v))
+        if rc == -2:
+            raise RuntimeError("store full")
+
+    def get_batch(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        ks = np.ascontiguousarray(keys, np.uint64)
+        out = np.zeros((len(ks), self.dim), np.float32)
+        found = np.zeros(len(ks), np.uint8)
+        lib().shmkv_get_batch(
+            self._h, ks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(ks), _fptr(out), found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out, found.astype(bool)
+
+    def sync(self) -> None:
+        lib().shmkv_sync(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            lib().shmkv_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,126 @@
+// Fast libFFM parser — native data-loader component.
+//
+// Role parity: FM_Algo_Abst::loadDataRow (fm_algo_abst.h:70-107) is the
+// reference's C++ CSV/libFFM ingest; the TPU framework keeps ingest native
+// too (Python parsing dominates end-to-end time on CTR-scale files).
+// Two-pass design: scan for dimensions, then fill caller-allocated arrays —
+// the padded static-shape layout lightctr_tpu.data.sparse.SparseDataset uses.
+//
+// C ABI, consumed via ctypes (no pybind11 in the image).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cerrno>
+
+namespace {
+
+// Parse "field:fid:val" starting at p; advances p past the token.
+// Returns true on success.
+inline bool parse_token(const char*& p, long& field, long& fid, double& val) {
+    char* end = nullptr;
+    field = strtol(p, &end, 10);
+    if (end == p || *end != ':') return false;
+    p = end + 1;
+    fid = strtol(p, &end, 10);
+    if (end == p || *end != ':') return false;
+    p = end + 1;
+    val = strtod(p, &end);
+    if (end == p) return false;
+    p = end;
+    return true;
+}
+
+inline void skip_ws(const char*& p) {
+    while (*p == ' ' || *p == '\t' || *p == '\r') ++p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: dimensions. Returns 0 ok, -1 io error, -2 parse error (line no in
+// *err_line).
+int ffm_scan(const char* path, long* n_rows, long* max_nnz, long* max_fid,
+             long* max_field, long* err_line) {
+    FILE* f = fopen(path, "r");
+    if (!f) return -1;
+    char* line = nullptr;
+    size_t cap = 0;
+    long rows = 0, mnnz = 0, mfid = -1, mfield = -1, lineno = 0;
+    ssize_t len;
+    while ((len = getline(&line, &cap, f)) != -1) {
+        ++lineno;
+        const char* p = line;
+        skip_ws(p);
+        if (*p == '\n' || *p == '\0') continue;
+        char* end = nullptr;
+        strtod(p, &end);  // label
+        if (end == p) { free(line); fclose(f); *err_line = lineno; return -2; }
+        p = end;
+        long nnz = 0;
+        while (true) {
+            skip_ws(p);
+            if (*p == '\n' || *p == '\0') break;
+            long field, fid; double val;
+            if (!parse_token(p, field, fid, val)) {
+                free(line); fclose(f); *err_line = lineno; return -2;
+            }
+            ++nnz;
+            if (fid > mfid) mfid = fid;
+            if (field > mfield) mfield = field;
+        }
+        if (nnz > mnnz) mnnz = nnz;
+        ++rows;
+    }
+    free(line);
+    fclose(f);
+    *n_rows = rows;
+    *max_nnz = mnnz;
+    *max_fid = mfid;
+    *max_field = mfield;
+    return 0;
+}
+
+// Pass 2: fill caller-allocated [n_rows, max_nnz] arrays (zero-padded) and
+// [n_rows] labels. mask gets 1.0 on real entries.
+int ffm_parse(const char* path, long n_rows, long max_nnz, int* fields,
+              int* fids, float* vals, float* mask, float* labels) {
+    FILE* f = fopen(path, "r");
+    if (!f) return -1;
+    char* line = nullptr;
+    size_t cap = 0;
+    long r = 0;
+    ssize_t len;
+    memset(fields, 0, sizeof(int) * n_rows * max_nnz);
+    memset(fids, 0, sizeof(int) * n_rows * max_nnz);
+    memset(vals, 0, sizeof(float) * n_rows * max_nnz);
+    memset(mask, 0, sizeof(float) * n_rows * max_nnz);
+    while ((len = getline(&line, &cap, f)) != -1 && r < n_rows) {
+        const char* p = line;
+        skip_ws(p);
+        if (*p == '\n' || *p == '\0') continue;
+        char* end = nullptr;
+        labels[r] = (float)strtod(p, &end);
+        p = end;
+        long j = 0;
+        while (j < max_nnz) {
+            skip_ws(p);
+            if (*p == '\n' || *p == '\0') break;
+            long field, fid; double val;
+            if (!parse_token(p, field, fid, val)) { free(line); fclose(f); return -2; }
+            const long o = r * max_nnz + j;
+            fields[o] = (int)field;
+            fids[o] = (int)fid;
+            vals[o] = (float)val;
+            mask[o] = 1.0f;
+            ++j;
+        }
+        ++r;
+    }
+    free(line);
+    fclose(f);
+    return 0;
+}
+
+}  // extern "C"

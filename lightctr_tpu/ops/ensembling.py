@@ -13,10 +13,15 @@ import jax
 import jax.numpy as jnp
 
 
-@jax.jit
-def vote_hard(predictions: jax.Array) -> jax.Array:
-    """[models, N] class predictions -> [N] majority vote (64-class cap)."""
-    one = jax.nn.one_hot(predictions, 64)
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def vote_hard(predictions: jax.Array, num_classes: int = 64) -> jax.Array:
+    """[models, N] class predictions -> [N] majority vote.  ``num_classes``
+    must cover every id — out-of-range ids one-hot to zero rows and would
+    silently vote for class 0."""
+    one = jax.nn.one_hot(predictions, num_classes)
     return jnp.argmax(jnp.sum(one, axis=0), axis=-1)
 
 
