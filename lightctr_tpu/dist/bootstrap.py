@@ -31,11 +31,19 @@ def initialize_multihost(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Join the pod (no-op for single-process runs).  Environment-driven when
-    args are None, like jax.distributed.initialize itself."""
+    """Join the pod.  No-op when there is no coordinator to join: either
+    ``num_processes <= 1``, or no address given and none in the environment
+    (single-process runs must not crash here)."""
+    import os
+
     import jax
 
     if num_processes is not None and num_processes <= 1:
+        return
+    if coordinator_address is None and not (
+        os.environ.get("JAX_COORDINATOR_ADDRESS")
+        or os.environ.get("COORDINATOR_ADDRESS")
+    ):
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
@@ -80,6 +88,7 @@ class HeartbeatMonitor:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
         now = self._clock()
         out = {}
+        newly_dead = []
         with self._lock:
             for w, t in self._last.items():
                 age = now - t
@@ -87,12 +96,16 @@ class HeartbeatMonitor:
                     out[w] = "dead"
                     if w not in self._dead:
                         self._dead.add(w)
-                        if self._on_dead:
-                            self._on_dead(w)
+                        newly_dead.append(w)
                 elif age >= self.stale_after_s:
                     out[w] = "stale"
                 else:
                     out[w] = "alive"
+        # callbacks run OUTSIDE the lock: on_dead may legitimately call
+        # beat()/check() (the lock is not reentrant)
+        if self._on_dead:
+            for w in newly_dead:
+                self._on_dead(w)
         return out
 
     def start(self) -> None:

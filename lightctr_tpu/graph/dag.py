@@ -16,10 +16,10 @@ one jitted function — the demo graph sigma(w*x+b) with logistic loss from
 Example (the reference's -DDAG unit test):
 
     g = Graph()
-    x = g.add_node(source("x"))
+    x = g.add_node(source("x"))                       # feeds [batch, 4]
     w = g.add_node(trainable("w", init=jnp.ones((4,))))
     b = g.add_node(trainable("b", init=jnp.zeros(())))
-    wx = g.add_node(matmul(w, x))
+    wx = g.add_node(matmul(x, w))                     # [batch, 4] @ [4] -> [batch]
     z = g.add_node(add(wx, b))
     p = g.add_node(activation(z, "sigmoid"))
     loss = g.add_node(logistic_loss_node(p, label_name="y"))

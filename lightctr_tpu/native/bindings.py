@@ -30,15 +30,21 @@ def _build() -> Optional[ctypes.CDLL]:
     global _BUILD_ERROR
     so_path = os.path.join(_DIR, f"_lightctr_native_{_source_digest()}.so")
     if not os.path.exists(so_path):
+        # compile to a per-process temp path, then atomically rename: two
+        # fresh processes may race here and must never dlopen a half-written so
+        tmp_path = f"{so_path}.tmp.{os.getpid()}"
         cmd = [
             "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
             *[os.path.join(_DIR, s) for s in _SOURCES],
-            "-o", so_path,
+            "-o", tmp_path,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
-        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            os.replace(tmp_path, so_path)
+        except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
             _BUILD_ERROR = getattr(e, "stderr", str(e)) or str(e)
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
             return None
     lib = ctypes.CDLL(so_path)
     # signatures

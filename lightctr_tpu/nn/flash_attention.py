@@ -1,0 +1,108 @@
+"""Blockwise (flash) attention — Pallas TPU kernel.
+
+The single-chip counterpart of :mod:`lightctr_tpu.nn.ring_attention`: exact
+attention computed block-by-block with an online softmax, never materializing
+the [T, T] score matrix.  Q blocks stream through VMEM on a (batch*heads,
+q-blocks) grid; the inner loop walks K/V blocks with running (max, denom,
+accumulator) statistics — the same math the ring version distributes across
+chips, here tiled for one core's VMEM.
+
+Used for long sequences where XLA's fused attention would spill; for the
+reference-parity models (T = 28) plain ``full_attention`` is fine.  Tested in
+interpreter mode on CPU (tests/), compiled for real on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, causal: bool, block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[:]                                   # [BQ, D]
+    t = k_ref.shape[0]
+    n_k = t // block_k
+
+    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q.shape[0],), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kblk = k_ref[pl.ds(j * block_k, block_k), :]           # [BK, D]
+        vblk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p.astype(vblk.dtype), vblk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(
+            f"block sizes ({block_q}, {block_k}) must divide T={t}"
+        )
+    scale = 1.0 / (d ** 0.5)
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    grid = (b * h, t // block_q)
+    out = pl.pallas_call(
+        partial(
+            _flash_kernel,
+            block_k=block_k,
+            scale=scale,
+            causal=causal,
+            block_q=block_q,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

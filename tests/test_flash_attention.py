@@ -1,0 +1,33 @@
+"""Pallas flash attention vs full attention oracle (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu.nn.flash_attention import flash_attention
+from lightctr_tpu.nn.ring_attention import full_attention
+
+
+def qkv(rng, b=2, t=64, h=2, d=16):
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)).astype(np.float32))  # noqa: E731
+    return mk(), mk(), mk()
+
+
+def test_flash_matches_full(rng):
+    q, k, v = qkv(rng)
+    got = flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    want = full_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_causal_matches_full(rng):
+    q, k, v = qkv(rng, t=32)
+    got = flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True)
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_rejects_bad_blocks(rng):
+    q, k, v = qkv(rng, t=30)
+    with pytest.raises(ValueError, match="must divide"):
+        flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
