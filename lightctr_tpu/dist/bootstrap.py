@@ -31,25 +31,29 @@ def initialize_multihost(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
 ) -> None:
-    """Join the pod.  No-op when there is no coordinator to join: either
-    ``num_processes <= 1``, or no address given and none in the environment
-    (single-process runs must not crash here)."""
-    import os
-
+    """Join the pod.  ``jax.distributed.initialize`` keeps its cluster
+    auto-detection (Cloud TPU / SLURM); only the bare single-host call — no
+    args, no detectable cluster — degrades to a no-op instead of raising."""
     import jax
 
     if num_processes is not None and num_processes <= 1:
         return
-    if coordinator_address is None and not (
-        os.environ.get("JAX_COORDINATOR_ADDRESS")
-        or os.environ.get("COORDINATOR_ADDRESS")
-    ):
-        return
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # ValueError: no coordinator and no detectable cluster environment;
+        # RuntimeError: backend already initialized (single-process session)
+        if (
+            coordinator_address is None
+            and num_processes is None
+            and process_id is None
+        ):
+            return  # bare call on a single host: no-op
+        raise  # explicit multi-process args that failed must fail loudly
 
 
 class HeartbeatMonitor:
@@ -101,11 +105,15 @@ class HeartbeatMonitor:
                     out[w] = "stale"
                 else:
                     out[w] = "alive"
-        # callbacks run OUTSIDE the lock: on_dead may legitimately call
-        # beat()/check() (the lock is not reentrant)
+        # callbacks run OUTSIDE the lock (on_dead may call beat()/check();
+        # the lock is not reentrant) — but a worker that re-registered in the
+        # window between the sweep and here is alive again, so re-check
         if self._on_dead:
             for w in newly_dead:
-                self._on_dead(w)
+                with self._lock:
+                    still_dead = w in self._dead
+                if still_dead:
+                    self._on_dead(w)
         return out
 
     def start(self) -> None:

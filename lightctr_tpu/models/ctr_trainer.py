@@ -16,6 +16,7 @@ reproduces that, an integer gives minibatch SGD (the DL-family default).
 from __future__ import annotations
 
 import time
+from functools import partial
 from typing import Callable, Dict, Optional
 
 import jax
@@ -61,13 +62,17 @@ class CTRTrainer:
         self.l2_fn = l2_fn
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh
-        self.params = params
-        self.opt_state = self.tx.init(params)
+        # own copy: steps donate their input buffers, so the caller's tree
+        # must stay untouched (it may seed several trainers)
+        self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
+        self.opt_state = self.tx.init(self.params)
         if mesh is not None:
             rep = replicated(mesh)
             self.params = jax.device_put(self.params, rep)
             self.opt_state = jax.device_put(self.opt_state, rep)
-        self._step = jax.jit(self._make_step())
+        # donate (params, opt_state): the old trees are dead after each step,
+        # letting XLA update in place instead of copying the tables
+        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
         self._logits_j = jax.jit(self.logits_fn)
         self._scan_cache: Dict[int, Callable] = {}
 
@@ -175,7 +180,7 @@ class CTRTrainer:
 
                 return body
 
-            @jax.jit
+            @partial(jax.jit, donate_argnums=(0, 1))
             def run(params, opt_state, batch):
                 (params, opt_state), losses = jax.lax.scan(
                     body_fn(batch), (params, opt_state), None, length=epochs

@@ -1,0 +1,106 @@
+"""Text-format model export/import — reference file-format parity.
+
+The reference saves models as text files: FM's ``model_epoch_N.txt`` with a
+sparse ``fid:w`` line then per-fid factor lines (fm_algo_abst.h:109-135),
+word embeddings as ``word vec...`` lines (train_embed_algo.cpp:208-230), GMM
+parameters (train_gmm_algo.cpp:153-174).  These writers/readers keep that
+interchange format so models can move between the two frameworks; for
+framework-internal persistence prefer :mod:`lightctr_tpu.ckpt` (binary,
+sharded, includes optimizer state).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_fm_text(path: str, params: Dict[str, jnp.ndarray]) -> None:
+    """FM/NFM params {'w': [F], 'v': [F, k]} -> the reference's text layout:
+    line 1: sparse ``fid:w`` pairs (non-zero only, fm_algo_abst.h:118-123);
+    then per-fid ``fid:v0 v1 ...`` factor lines (fm_algo_abst.h:125-133)."""
+    w = np.asarray(params["w"])
+    v = np.asarray(params["v"])
+    if v.ndim != 2:
+        raise ValueError("save_fm_text expects v of shape [F, k] (FM layout)")
+    with open(path, "w") as f:
+        f.write(" ".join(f"{fid}:{w[fid]:.6g}" for fid in np.nonzero(w)[0]))
+        f.write("\n")
+        for fid in range(v.shape[0]):
+            f.write(f"{fid}:" + " ".join(f"{x:.6g}" for x in v[fid]) + "\n")
+
+
+def load_fm_text(path: str) -> Dict[str, jnp.ndarray]:
+    with open(path) as f:
+        lines = f.read().splitlines()
+    v_rows = []
+    for line in lines[1:]:
+        if not line.strip():
+            continue
+        _, vec = line.split(":", 1)
+        v_rows.append([float(x) for x in vec.split()])
+    v = np.asarray(v_rows, np.float32)
+    w = np.zeros((v.shape[0],), np.float32)
+    for tok in lines[0].split():
+        fid, val = tok.split(":")
+        w[int(fid)] = float(val)
+    return {"w": jnp.asarray(w), "v": jnp.asarray(v)}
+
+
+def save_embeddings_text(path: str, words: List[str], emb: np.ndarray) -> None:
+    """``word v0 v1 ...`` lines (train_embed_algo.cpp:208-230)."""
+    emb = np.asarray(emb)
+    with open(path, "w") as f:
+        for word, vec in zip(words, emb):
+            f.write(word + " " + " ".join(f"{x:.6g}" for x in vec) + "\n")
+
+
+def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
+    """Reads the format above (loadPretrainFile, train_embed_algo.h:76-98)."""
+    words, rows = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            words.append(parts[0])
+            rows.append([float(x) for x in parts[1:]])
+    return words, np.asarray(rows, np.float32)
+
+
+def save_gmm_text(path: str, params) -> None:
+    """Per-cluster ``weight | mu... | sigma...`` lines
+    (train_gmm_algo.cpp:153-174)."""
+    mu = np.asarray(params.mu)
+    sigma = np.asarray(params.sigma)
+    weight = np.asarray(params.weight)
+    with open(path, "w") as f:
+        for k in range(mu.shape[0]):
+            f.write(
+                f"{weight[k]:.6g} | "
+                + " ".join(f"{x:.6g}" for x in mu[k])
+                + " | "
+                + " ".join(f"{x:.6g}" for x in sigma[k])
+                + "\n"
+            )
+
+
+def load_gmm_text(path: str):
+    from lightctr_tpu.models.gmm import GMMParams
+
+    ws, mus, sigmas = [], [], []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            w_part, mu_part, sg_part = line.split("|")
+            ws.append(float(w_part))
+            mus.append([float(x) for x in mu_part.split()])
+            sigmas.append([float(x) for x in sg_part.split()])
+    return GMMParams(
+        mu=jnp.asarray(mus, jnp.float32),
+        sigma=jnp.asarray(sigmas, jnp.float32),
+        weight=jnp.asarray(ws, jnp.float32),
+    )

@@ -28,6 +28,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, cau
     q = q_ref[:]                                   # [BQ, D]
     t = k_ref.shape[0]
     n_k = t // block_k
+    if causal:
+        # K blocks entirely above the diagonal contribute nothing — skip them
+        # (standard flash bound; halves causal FLOPs at long T)
+        n_k_eff = jnp.minimum(
+            n_k, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        n_k_eff = n_k
 
     m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
     l0 = jnp.zeros((q.shape[0],), jnp.float32)
@@ -55,7 +63,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, cau
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, n_k_eff, body, (m0, l0, acc0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 

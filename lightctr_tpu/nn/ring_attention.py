@@ -39,23 +39,33 @@ def _ring_attention_local(
     causal: bool,
 ) -> jax.Array:
     b, tb, h, d = q.shape
-    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     my = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n_ring) for j in range(n_ring)]
     q_pos = my * tb + jnp.arange(tb)                      # global query positions
 
-    # mark the fresh accumulators as varying over the ring axis so the scan
-    # carry types match (outputs depend on axis_index)
-    m0 = jax.lax.pvary(jnp.full((b, h, tb), NEG_INF, q.dtype), (axis_name,))
-    l0 = jax.lax.pvary(jnp.zeros((b, h, tb), q.dtype), (axis_name,))
-    o0 = jax.lax.pvary(jnp.zeros((b, h, tb, d), q.dtype), (axis_name,))
+    # online-softmax statistics accumulate in float32 regardless of the input
+    # dtype (bf16 denominators round away terms after a few hundred adds);
+    # mark them varying over the ring axis so the scan carry types match
+    def _vary(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    m0 = _vary(jnp.full((b, h, tb), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, tb), jnp.float32))
+    o0 = _vary(jnp.zeros((b, h, tb, d), jnp.float32))
 
     def step(i, carry):
         k_cur, v_cur, m, l, o = carry
         # the block currently held arrived from rank (my - i) mod n
         src = (my - i) % n_ring
         k_pos = src * tb + jnp.arange(tb)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        s = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_cur,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]        # [Tq, Tk]
             s = jnp.where(mask[None, None], s, NEG_INF)
@@ -63,7 +73,10 @@ def _ring_attention_local(
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l_new = l * corr + jnp.sum(p, axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+            preferred_element_type=jnp.float32,
+        )
 
         def rotate(kv):
             return (
@@ -79,8 +92,8 @@ def _ring_attention_local(
 
     _, _, m, l, o = jax.lax.fori_loop(0, n_ring, step, (k, v, m0, l0, o0))
     # fully-masked rows (causal, position 0 block boundaries) have l == 0
-    out = o / jnp.maximum(l, 1e-30)[..., None]             # [B, H, Tq, D]
-    return jnp.einsum("bhqd->bqhd", out)
+    out = o / jnp.maximum(l, 1e-30)[..., None]             # [B, H, Tq, D] f32
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
 
 def ring_self_attention(

@@ -1,0 +1,41 @@
+"""Text model export/import roundtrips (reference file-format parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu.models import export, fm, gmm
+
+
+def test_fm_text_roundtrip(tmp_path, rng):
+    params = fm.init(jax.random.PRNGKey(0), 30, 4)
+    params["w"] = params["w"].at[np.asarray([2, 7])].set(jnp.asarray([1.5, -0.25]))
+    path = str(tmp_path / "model.txt")
+    export.save_fm_text(path, params)
+    out = export.load_fm_text(path)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(params["w"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["v"]), np.asarray(params["v"]), rtol=1e-4, atol=1e-6)
+    # first line is the reference's sparse fid:w format
+    first = open(path).readline().split()
+    assert first == ["2:1.5", "7:-0.25"]
+
+
+def test_embeddings_text_roundtrip(tmp_path, rng):
+    words = ["alpha", "beta", "gamma"]
+    emb = rng.normal(size=(3, 5)).astype(np.float32)
+    path = str(tmp_path / "emb.txt")
+    export.save_embeddings_text(path, words, emb)
+    w2, e2 = export.load_embeddings_text(path)
+    assert w2 == words
+    np.testing.assert_allclose(e2, emb, rtol=1e-4, atol=1e-6)
+
+
+def test_gmm_text_roundtrip(tmp_path, rng):
+    x = rng.normal(size=(60, 3)).astype(np.float32)
+    params = gmm.init_from_data(jax.random.PRNGKey(0), 4, x)
+    path = str(tmp_path / "gmm.txt")
+    export.save_gmm_text(path, params)
+    out = export.load_gmm_text(path)
+    np.testing.assert_allclose(np.asarray(out.mu), np.asarray(params.mu), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.sigma), np.asarray(params.sigma), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out.weight), np.asarray(params.weight), rtol=1e-4)
