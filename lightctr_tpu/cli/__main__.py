@@ -70,6 +70,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = common(sub.add_parser("gmm"), lr=0.0, batch=0)
     sp.add_argument("--clusters", type=int, default=10)
+
+    # topic model on raw text, one document per line (TEST_TM)
+    sp = common(sub.add_parser("plsa"), lr=0.0, batch=0)
+    sp.add_argument("--topics", type=int, default=8)
+    sp.add_argument("--vocab-size", type=int, default=5000)
+    sp.add_argument("--top-words", type=int, default=10)
+
+    # word2vec on raw text (TEST_EMB pipeline: train -> quantize -> cluster)
+    sp = common(sub.add_parser("embed"), lr=0.3, batch=256)
+    sp.add_argument("--dim", type=int, default=100)
+    sp.add_argument("--window", type=int, default=6)
+    sp.add_argument("--vocab-size", type=int, default=5000)
+    sp.add_argument("--mode", choices=["negative", "hierarchical"], default="negative")
+    sp.add_argument("--out")
+    sp.add_argument("--quantize", action="store_true")
+    sp.add_argument("--cluster", type=int, default=0)
     return p
 
 
@@ -191,6 +207,44 @@ def main(argv=None) -> int:
         report["cluster_sizes"] = np.bincount(
             gmm.predict(params, raw), minlength=args.clusters
         ).tolist()
+
+    elif args.model == "plsa":
+        from lightctr_tpu.data import text as text_lib
+        from lightctr_tpu.models import plsa
+
+        with open(args.data) as f:
+            docs = [text_lib.tokenize(line) for line in f if line.strip()]
+        words, counts, w2i = text_lib.build_vocab(docs, max_size=args.vocab_size)
+        m = text_lib.doc_term_matrix(docs, w2i)
+        params = plsa.init(jax.random.PRNGKey(args.seed), m.shape[0], args.topics, m.shape[1])
+        params, hist = plsa.fit(params, m, epochs=args.epochs)
+        report["final_loglik"] = hist[-1]
+        report["topics"] = plsa.topic_keywords(params, words, top_k=args.top_words)
+
+    elif args.model == "embed":
+        from lightctr_tpu.data import text as text_lib
+        from lightctr_tpu.models import embedding, export
+
+        with open(args.data) as f:
+            docs_tok = [text_lib.tokenize(line) for line in f if line.strip()]
+        words, counts, w2i = text_lib.build_vocab(docs_tok, max_size=args.vocab_size)
+        docs = text_lib.docs_to_ids(docs_tok, w2i)
+        centers, contexts, mask = embedding.cbow_pairs(docs, args.window, counts=counts,
+                                                       seed=args.seed)
+        tr = embedding.Word2VecTrainer(len(words), args.dim, cfg, counts, mode=args.mode)
+        hist = tr.fit(centers, contexts, mask, epochs=args.epochs,
+                      batch_size=cfg.minibatch_size)
+        report["final_loss"] = hist[-1]
+        report["n_pairs"] = int(len(centers))
+        if args.out:
+            export.save_embeddings_text(args.out, words, tr.normalized_embeddings())
+            report["embeddings"] = args.out
+        if args.quantize:
+            _, codes = tr.quantize()
+            report["pq_codes_shape"] = list(codes.shape)
+        if args.cluster:
+            clusters = tr.cluster(n_clusters=args.cluster)
+            report["cluster_sizes"] = np.bincount(clusters, minlength=args.cluster).tolist()
 
     print(json.dumps(report))
     return 0

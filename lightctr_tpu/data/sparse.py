@@ -14,6 +14,27 @@ import dataclasses
 import numpy as np
 
 
+def parse_libffm_line(line: str, path: str = "<str>", lineno: int = 0):
+    """One ``label field:fid:val ...`` row -> (label, [(field, fid, val)]),
+    or None for blank lines.  THE row parser: the eager Python fallback and
+    the streaming reader both use it, so format semantics cannot drift
+    (the native C++ parser is oracle-tested against it)."""
+    parts = line.split()
+    if not parts:
+        return None
+    label = float(parts[0])
+    row = []
+    for tok in parts[1:]:
+        pieces = tok.split(":")
+        if len(pieces) != 3:
+            raise ValueError(
+                f"{path}:{lineno}: bad libFFM token {tok!r} "
+                "(expected field:fid:val)"
+            )
+        row.append((int(pieces[0]), int(pieces[1]), float(pieces[2])))
+    return label, row
+
+
 @dataclasses.dataclass
 class SparseDataset:
     """Padded CSR-like batch layout.
@@ -131,20 +152,11 @@ def load_libffm(
     labels = []
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
-            parts = line.split()
-            if not parts:
+            parsed = parse_libffm_line(line, path, lineno)
+            if parsed is None:
                 continue
-            labels.append(float(parts[0]))
-            row = []
-            for tok in parts[1:]:
-                pieces = tok.split(":")
-                if len(pieces) != 3:
-                    raise ValueError(
-                        f"{path}:{lineno}: bad libFFM token {tok!r} "
-                        "(expected field:fid:val)"
-                    )
-                field, fid, val = pieces
-                row.append((int(field), int(fid), float(val)))
+            label, row = parsed
+            labels.append(label)
             rows.append(row)
 
     n = len(rows)

@@ -1,0 +1,71 @@
+"""Streaming libFFM reader — Criteo-scale ingest.
+
+The in-memory loader (:func:`lightctr_tpu.data.load_libffm`) materializes the
+whole padded dataset; at Criteo-1TB scale (BASELINE.json north star) ingest
+must stream.  ``iter_libffm_batches`` yields fixed-shape padded batch dicts
+straight from the file with bounded memory: rows longer than ``max_nnz`` are
+truncated, ids are folded into the given vocabulary (the hashing trick the
+eager loader applies), and the final partial batch is either dropped or
+zero-padded with a row mask.
+
+Host-side row parsing at streaming time deliberately stays Python: the
+consumer overlap (device step N while parsing batch N+1) hides it; a native
+chunk parser is the round-2 upgrade if profiling says otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def iter_libffm_batches(
+    path: str,
+    batch_size: int,
+    max_nnz: int,
+    feature_cnt: Optional[int] = None,
+    field_cnt: Optional[int] = None,
+    drop_remainder: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield batch dicts with keys fids/fields/vals/mask/labels (+``row_mask``
+    flagging real rows when the tail batch is padded)."""
+
+    def new_buffers():
+        return {
+            "fids": np.zeros((batch_size, max_nnz), np.int32),
+            "fields": np.zeros((batch_size, max_nnz), np.int32),
+            "vals": np.zeros((batch_size, max_nnz), np.float32),
+            "mask": np.zeros((batch_size, max_nnz), np.float32),
+            "labels": np.zeros((batch_size,), np.float32),
+            "row_mask": np.zeros((batch_size,), np.float32),
+        }
+
+    from lightctr_tpu.data.sparse import parse_libffm_line
+
+    buf = new_buffers()
+    fill = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            parsed = parse_libffm_line(line, path, lineno)
+            if parsed is None:
+                continue
+            label, row = parsed
+            buf["labels"][fill] = label
+            buf["row_mask"][fill] = 1.0
+            for j, (field, fid, val) in enumerate(row[:max_nnz]):
+                if feature_cnt is not None:
+                    fid %= feature_cnt
+                if field_cnt is not None:
+                    field %= field_cnt
+                buf["fids"][fill, j] = fid
+                buf["fields"][fill, j] = field
+                buf["vals"][fill, j] = val
+                buf["mask"][fill, j] = 1.0
+            fill += 1
+            if fill == batch_size:
+                yield buf
+                buf = new_buffers()
+                fill = 0
+    if fill and not drop_remainder:
+        yield buf

@@ -44,16 +44,24 @@ def initialize_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (ValueError, RuntimeError):
-        # ValueError: no coordinator and no detectable cluster environment;
-        # RuntimeError: backend already initialized (single-process session)
-        if (
+    except (ValueError, RuntimeError) as e:
+        # Swallow ONLY the two known single-host conditions; anything else —
+        # notably XlaRuntimeError from a failed coordinator connect on a real
+        # pod — must fail loudly (silent per-host forks train divergent
+        # models and clobber checkpoints).
+        bare_call = (
             coordinator_address is None
             and num_processes is None
             and process_id is None
-        ):
-            return  # bare call on a single host: no-op
-        raise  # explicit multi-process args that failed must fail loudly
+        )
+        msg = str(e)
+        single_host = (
+            "coordinator_address should be defined" in msg
+            or "must be called before" in msg
+        )
+        if bare_call and single_host:
+            return  # no cluster configured / backend already up: no-op
+        raise
 
 
 class HeartbeatMonitor:

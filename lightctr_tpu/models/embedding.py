@@ -268,11 +268,16 @@ class Word2VecTrainer:
         e = np.asarray(self.params.emb)
         return e / np.maximum(np.linalg.norm(e, axis=1, keepdims=True), 1e-12)
 
-    def quantize(self, part_cnt: int = 10, cluster_cnt: int = 64):
-        """PQ codes of the embeddings (``Quantization()``, main.cpp:240-243)."""
+    def quantize(self, part_cnt: Optional[int] = None, cluster_cnt: int = 64):
+        """PQ codes of the embeddings (``Quantization()``, main.cpp:240-243).
+        ``part_cnt`` defaults to the largest divisor of the embedding dim
+        that is <= 10 (the reference's part count needs dim % parts == 0)."""
         from lightctr_tpu.ops import pq
 
         emb = jnp.asarray(self.normalized_embeddings())
+        dim = emb.shape[1]
+        if part_cnt is None:
+            part_cnt = next(p for p in range(min(10, dim), 0, -1) if dim % p == 0)
         cb = pq.train(jax.random.PRNGKey(0), emb, part_cnt=part_cnt, cluster_cnt=cluster_cnt)
         return cb, np.asarray(pq.encode(cb, emb))
 

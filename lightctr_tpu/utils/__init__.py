@@ -1,0 +1,3 @@
+from lightctr_tpu.utils.system import host_memory_usage, device_memory_stats
+
+__all__ = ["host_memory_usage", "device_memory_stats"]

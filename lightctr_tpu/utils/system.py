@@ -1,0 +1,38 @@
+"""System observability helpers.
+
+Parity with ``common/system.h``: ``SystemMemoryUsage`` reads /proc/meminfo
+(system.h:63-98); the device-side counterpart reads the accelerator's memory
+stats, which the reference (CPU-only) never had.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def host_memory_usage() -> Dict[str, int]:
+    """kB values from /proc/meminfo (MemTotal/MemFree/MemAvailable)."""
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, rest = line.split(":", 1)
+                if key in ("MemTotal", "MemFree", "MemAvailable", "Cached"):
+                    out[key] = int(rest.split()[0])
+    except OSError:
+        pass
+    return out
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """Per-device memory stats when the backend exposes them (TPU does)."""
+    import jax
+
+    d = device or jax.devices()[0]
+    stats = getattr(d, "memory_stats", None)
+    if stats is None:
+        return None
+    try:
+        return dict(stats())
+    except Exception:
+        return None

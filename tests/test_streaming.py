@@ -1,0 +1,78 @@
+"""Streaming libFFM reader + system utils + CLI text subcommands."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from lightctr_tpu.data import load_libffm
+from lightctr_tpu.data.streaming import iter_libffm_batches
+from lightctr_tpu.utils import host_memory_usage
+
+REF_SPARSE = "/root/reference/data/train_sparse.csv"
+
+
+def test_streaming_matches_eager():
+    ds = load_libffm(REF_SPARSE)
+    batches = list(
+        iter_libffm_batches(REF_SPARSE, batch_size=128, max_nnz=ds.max_nnz)
+    )
+    assert len(batches) == 1000 // 128
+    first = batches[0]
+    np.testing.assert_array_equal(first["fids"], ds.fids[:128])
+    np.testing.assert_array_equal(first["fields"], ds.fields[:128])
+    np.testing.assert_allclose(first["vals"], ds.vals[:128])
+    np.testing.assert_allclose(first["labels"], ds.labels[:128])
+    assert first["row_mask"].sum() == 128
+
+
+def test_streaming_truncation_and_tail():
+    batches = list(
+        iter_libffm_batches(
+            REF_SPARSE, batch_size=300, max_nnz=10, drop_remainder=False
+        )
+    )
+    assert len(batches) == 4  # 3 full + padded tail of 100
+    assert batches[0]["fids"].shape == (300, 10)
+    tail = batches[-1]
+    assert tail["row_mask"].sum() == 100
+    assert np.all(tail["mask"][100:] == 0)
+
+
+def test_streaming_vocab_folding():
+    b = next(iter_libffm_batches(REF_SPARSE, batch_size=16, max_nnz=50, feature_cnt=1000))
+    assert b["fids"].max() < 1000
+
+
+def test_host_memory_usage():
+    m = host_memory_usage()
+    assert m.get("MemTotal", 0) > 0
+
+
+def test_cli_plsa_and_embed(tmp_path):
+    text_path = str(tmp_path / "corpus.txt")
+    with open(text_path, "w") as f:
+        for i in range(30):
+            f.write(("apple banana cherry date " if i % 2 else "wolf bear fox lynx ") * 5 + "\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable, "-m", "lightctr_tpu.cli", "plsa", "--data", text_path,
+         "--topics", "2", "--epochs", "40", "--top-words", "3"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert len(rep["topics"]) == 2 and len(rep["topics"][0]) == 3
+
+    emb_path = str(tmp_path / "emb.txt")
+    out = subprocess.run(
+        [sys.executable, "-m", "lightctr_tpu.cli", "embed", "--data", text_path,
+         "--dim", "8", "--epochs", "2", "--window", "2", "--batch-size", "64",
+         "--out", emb_path],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert os.path.exists(emb_path) and rep["n_pairs"] > 0
