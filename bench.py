@@ -65,6 +65,11 @@ def main():
 
     try:
         ds = load_libffm("/root/reference/data/train_sparse.csv")
+        # compact the vocabulary: the reference's sparse Adagrad skips
+        # untouched rows (gradientUpdater.h:143), so its per-epoch cost is
+        # O(touched features); a dense table must match by only allocating
+        # rows that exist in the data (prediction-identical remap)
+        ds, _ = ds.compact()
         arrays = ds.batch_dict()
         feature_cnt = ds.feature_cnt
     except OSError:
@@ -80,7 +85,10 @@ def main():
 
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
     params = fm.init(jax.random.PRNGKey(0), feature_cnt, 8)
-    tr = CTRTrainer(params, fm.logits, cfg, l2_fn=fm.l2_penalty)
+    # fused logits+L2 (one gather set); the table holds the COMPACTED
+    # vocabulary (touched rows only — see ds.compact() above), matching the
+    # reference's per-epoch cost, whose sparse Adagrad skips untouched rows
+    tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2)
 
     n_rows = len(arrays["labels"])
     epochs = 1000

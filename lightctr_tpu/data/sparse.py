@@ -83,6 +83,32 @@ class SparseDataset:
             field_cnt=self.field_cnt,
         )
 
+    def compact(self) -> tuple:
+        """Remap feature ids to a dense [0, n_unique) vocabulary.
+
+        The reference's sparse updaters never pay for untouched rows
+        (``g == 0`` skip, gradientUpdater.h:143); dense JAX tables do — so
+        compaction (table rows = features actually present) is the parity
+        move for single-dataset training.  Returns (dataset, mapping) where
+        ``mapping[new_id] = original_fid`` for translating back."""
+        if self.fids.size == 0:
+            return self, np.zeros((0,), np.int32)
+        uniq = np.unique(self.fids[self.mask > 0])
+        remap = np.zeros(max(self.feature_cnt, int(self.fids.max()) + 1), np.int32)
+        remap[uniq] = np.arange(len(uniq), dtype=np.int32)
+        return (
+            SparseDataset(
+                fids=remap[self.fids],
+                fields=self.fields,
+                vals=self.vals,
+                mask=self.mask,
+                labels=self.labels,
+                feature_cnt=len(uniq),
+                field_cnt=self.field_cnt,
+            ),
+            uniq,
+        )
+
     def pad_rows(self, multiple: int) -> "SparseDataset":
         """Pad row count to a multiple (for even device sharding); padded rows
         have zero mask and label 0 and must be excluded from metrics."""

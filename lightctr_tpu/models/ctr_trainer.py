@@ -42,6 +42,9 @@ class CTRTrainer:
     logits_fn: (params, batch) -> [B] raw scores (pre-sigmoid).
     l2_fn: optional (params, batch) -> scalar penalty (already summed; it is
         divided by batch size alongside the mean loss).
+    fused_fn: optional (params, batch) -> (logits, l2) computing both from
+        one set of gathers (e.g. fm.logits_with_l2); takes precedence over
+        (logits_fn-for-training, l2_fn).
     optimizer: any optax transform; defaults to Adagrad at cfg.learning_rate
         (the reference FM family's workhorse, gradientUpdater.h:127-154).
     mesh: optional Mesh for data-parallel execution; batches are sharded over
@@ -56,10 +59,12 @@ class CTRTrainer:
         l2_fn: Optional[Callable] = None,
         optimizer: Optional[optax.GradientTransformation] = None,
         mesh=None,
+        fused_fn: Optional[Callable] = None,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
         self.l2_fn = l2_fn
+        self.fused_fn = fused_fn
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh
         # own copy: steps donate their input buffers, so the caller's tree
@@ -80,14 +85,19 @@ class CTRTrainer:
         lambda_l2 = self.cfg.lambda_l2
         l2_fn = self.l2_fn
         logits_fn = self.logits_fn
+        fused_fn = self.fused_fn
         tx = self.tx
 
         def loss_fn(params, batch):
-            z = logits_fn(params, batch)
+            if fused_fn is not None:
+                z, l2 = fused_fn(params, batch)
+            else:
+                z = logits_fn(params, batch)
+                l2 = l2_fn(params, batch) if l2_fn is not None else 0.0
             n = z.shape[0]
             loss = losses_lib.logistic_loss(z, batch["labels"], reduction="sum")
-            if l2_fn is not None and lambda_l2 > 0.0:
-                loss = loss + lambda_l2 * l2_fn(params, batch)
+            if lambda_l2 > 0.0:
+                loss = loss + lambda_l2 * l2
             return loss / n
 
         def step(params, opt_state, batch):

@@ -38,7 +38,15 @@ def init(key: jax.Array, feature_cnt: int, factor_cnt: int) -> Dict[str, jax.Arr
 
 def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
     """Batched sumVX forward (train_fm_algo.cpp:63-88)."""
+    return logits_with_l2(params, batch)[0]
+
+
+def logits_with_l2(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]):
+    """Forward plus the touched-row L2 penalty from the SAME gathers —
+    computing the penalty separately would re-read W and V rows (25% of the
+    step on a bandwidth-bound backend)."""
     vals = batch["vals"] * batch["mask"]          # [B, P]; padding already 0
+    mask = batch["mask"]
     w = jnp.take(params["w"], batch["fids"], axis=0)            # [B, P]
     linear = jnp.sum(w * vals, axis=-1)                          # [B]
     v = jnp.take(params["v"], batch["fids"], axis=0)             # [B, P, k]
@@ -47,7 +55,8 @@ def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Arr
     second = 0.5 * (
         jnp.sum(sumvx * sumvx, axis=-1) - jnp.sum(vx * vx, axis=(1, 2))
     )
-    return linear + second
+    l2 = 0.5 * (jnp.sum(w * w * mask) + jnp.sum(v * v * mask[..., None]))
+    return linear + second, l2
 
 
 def l2_penalty(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
