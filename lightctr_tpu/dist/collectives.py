@@ -36,42 +36,93 @@ def _ring_perm(n: int):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
-def _ring_all_reduce_local(flat: jax.Array, axis_name: str, n: int, average: bool) -> jax.Array:
+def _ring_all_reduce_local(
+    flat: jax.Array,
+    axis_name: str,
+    n: int,
+    average: bool,
+    compress_bits: int | None = None,
+    compress_range: float = 1.0,
+) -> jax.Array:
     """Runs per-device under shard_map.  ``flat`` is this device's full-length
     gradient vector, pre-padded to a multiple of n."""
     idx = jax.lax.axis_index(axis_name)
     perm = _ring_perm(n)
     segs = flat.reshape(n, -1)
 
+    if compress_bits is not None:
+        from lightctr_tpu.ops import quantize
+
+        table = quantize.build_table(
+            -compress_range, compress_range, bits=compress_bits, mode="uniform"
+        )
+
+        def wire(buf):
+            # codec applied to every transmitted segment — the reference
+            # runs its fp16/int8 codec on every ring Buffer the same way
+            return quantize.extract(table, quantize.compress(table, buf))
+
+        if average:
+            # pre-divide by n so every partial sum in the reduce phase is a
+            # partial MEAN, bounded by max|g| — otherwise mid-ring sums grow
+            # toward n*max|g| and saturate the table (systematic clipping,
+            # not noise).  The final /n below is skipped in this mode.
+            segs = segs / n
+    else:
+        def wire(buf):
+            return buf
+
     def rs_step(i, segs):
         send_idx = (idx - i) % n
-        buf = jnp.take(segs, send_idx, axis=0)
+        buf = wire(jnp.take(segs, send_idx, axis=0))
         recv = jax.lax.ppermute(buf, axis_name, perm)
         return segs.at[(idx - i - 1) % n].add(recv)
 
     segs = jax.lax.fori_loop(0, n - 1, rs_step, segs)  # reduce-scatter
-    # rank idx now owns fully-reduced segment (idx + 1) % n
+    # rank idx now owns fully-reduced segment (idx + 1) % n.
+    # Code the owned segment BEFORE broadcasting and keep the coded copy
+    # locally too — otherwise the owner's replica (raw) differs from every
+    # receiver's (coded) and the "all-reduced" params diverge across devices.
+    own = (idx + 1) % n
+    segs = segs.at[own].set(wire(jnp.take(segs, own, axis=0)))
 
     def ag_step(i, segs):
         send_idx = (idx + 1 - i) % n
-        buf = jnp.take(segs, send_idx, axis=0)
+        buf = jnp.take(segs, send_idx, axis=0)  # already wire-coded
         recv = jax.lax.ppermute(buf, axis_name, perm)
         return segs.at[(idx - i) % n].set(recv)
 
     segs = jax.lax.fori_loop(0, n - 1, ag_step, segs)  # all-gather
     out = segs.reshape(-1)
-    if average:
+    if average and compress_bits is None:
         out = out / n  # ring_collect.h:61-68 divides by ring size
     return out
 
 
-def ring_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):
+def ring_all_reduce(
+    mesh: Mesh,
+    stacked_tree,
+    axis: str = "data",
+    average: bool = True,
+    compress_bits: int | None = None,
+    compress_range: float = 1.0,
+):
     """Explicit ring all-reduce of per-device gradient pytrees.
 
     ``stacked_tree``: pytree whose leaves have a leading device dimension of
     size ``mesh.shape[axis]`` (one slice per ring member — the per-worker
     gradients).  Returns the same structure where every slice holds the
     reduced (mean by default) values.
+
+    ``compress_bits``: when set (8 or 16), every transmitted segment is
+    quantile-compressed to that width before the hop and decoded after — the
+    reference compresses ALL its ring wire traffic the same way (fp16 codec
+    on every Buffer, ring_collect.h + buffer.h:140-149; int8 via its
+    QuantileCompress).  Quantization noise accumulates once per reduce hop.
+    In ``average`` mode inputs are pre-divided by the ring size so partial
+    sums stay within ``compress_range`` as long as it bounds a single
+    gradient's magnitude; in ``average=False`` (sum) mode ``compress_range``
+    must bound the FULL n-way sum or values clip.
     """
     n = mesh.shape[axis]
     leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
@@ -87,11 +138,17 @@ def ring_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool 
         stacked_flat = jnp.pad(stacked_flat, ((0, 0), (0, padded - length)))
 
     fn = shard_map(
-        partial(_ring_all_reduce_local, axis_name=axis, n=n, average=average),
+        partial(
+            _ring_all_reduce_local,
+            axis_name=axis,
+            n=n,
+            average=average,
+            compress_bits=compress_bits,
+            compress_range=compress_range,
+        ),
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(axis),
-        
     )
     # shard_map splits the leading dim: each device gets its [padded] vector
     out = fn(stacked_flat.reshape(n * padded))

@@ -203,17 +203,50 @@ class CTRTrainer:
     def predict_proba(self, arrays: Dict[str, np.ndarray]) -> np.ndarray:
         return np.asarray(sigmoid(self._logits_j(self.params, self._put(arrays))))
 
-    def evaluate(self, arrays: Dict[str, np.ndarray]) -> Dict[str, float]:
+    def evaluate(
+        self, arrays: Dict[str, np.ndarray], batch_size: Optional[int] = None
+    ) -> Dict[str, float]:
         """Logloss / accuracy / AUC report, matching FM_Predict
-        (fm_predict.cpp:56-77)."""
-        probs = self.predict_proba(arrays)
-        labels = arrays["labels"]
-        probs_j = jnp.asarray(probs)
-        labels_j = jnp.asarray(labels)
+        (fm_predict.cpp:56-77).  With ``batch_size``, evaluation streams in
+        fixed-size chunks with running sums + streaming AUC histograms —
+        memory-bounded for epoch-scale sets (the histogram AUC's purpose)."""
+        labels_all = arrays["labels"]
+        n = len(labels_all)
+        if batch_size is None or batch_size >= n:
+            probs = self.predict_proba(arrays)
+            probs_j = jnp.asarray(probs)
+            labels_j = jnp.asarray(labels_all)
+            return {
+                "logloss": float(metrics_lib.logloss(probs_j, labels_j)),
+                "accuracy": float(
+                    metrics_lib.accuracy(
+                        (probs_j > 0.5).astype(jnp.int32), labels_j.astype(jnp.int32)
+                    )
+                ),
+                "auc": float(
+                    metrics_lib.auc_histogram(probs_j, labels_j.astype(jnp.int32))
+                ),
+            }
+        ph = nh = None
+        loss_sum = 0.0
+        correct = 0.0
+        seen = 0
+        for s in range(0, n, batch_size):  # includes the tail remainder
+            chunk = {k: v[s : s + batch_size] for k, v in arrays.items()}
+            m = len(chunk["labels"])
+            # stay on device: logits -> sigmoid -> metrics without a host trip
+            probs_j = sigmoid(self._logits_j(self.params, self._put(chunk)))
+            labels_j = jnp.asarray(chunk["labels"])
+            loss_sum += float(metrics_lib.logloss(probs_j, labels_j)) * m
+            correct += float(
+                jnp.sum((probs_j > 0.5).astype(jnp.int32) == labels_j.astype(jnp.int32))
+            )
+            ph, nh = metrics_lib.auc_histogram_update(
+                probs_j, labels_j.astype(jnp.int32), ph, nh
+            )
+            seen += m
         return {
-            "logloss": float(metrics_lib.logloss(probs_j, labels_j)),
-            "accuracy": float(
-                metrics_lib.accuracy((probs_j > 0.5).astype(jnp.int32), labels_j.astype(jnp.int32))
-            ),
-            "auc": float(metrics_lib.auc_histogram(probs_j, labels_j.astype(jnp.int32))),
+            "logloss": loss_sum / seen,
+            "accuracy": correct / seen,
+            "auc": float(metrics_lib.auc_from_histogram(ph, nh)),
         }
