@@ -40,8 +40,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--ckpt-dir")
         return sp
 
+    def scoreable(sp):
+        # only models with per-row scores get the flag — elsewhere it would
+        # be silently meaningless
+        sp.add_argument("--dump-scores", help="write per-row pCTR scores to this file"
+                        " (FM_Predict's optional score dump)")
+        return sp
+
     for name in ("fm", "ffm", "nfm", "widedeep"):
-        sp = common(sub.add_parser(name), lr=0.1, batch=50)  # main.cpp:56-59
+        sp = scoreable(common(sub.add_parser(name), lr=0.1, batch=50))  # main.cpp:56-59
         sp.add_argument("--factor", type=int, default=8)
         sp.add_argument("--l2", type=float, default=0.001)
         if name == "nfm":
@@ -63,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hidden", type=int, default=60)
     sp.add_argument("--gauss", type=int, default=20)
 
-    sp = common(sub.add_parser("gbm"), lr=0.6, batch=0)
+    sp = scoreable(common(sub.add_parser("gbm"), lr=0.6, batch=0))
     sp.add_argument("--n-trees", type=int, default=10)
     sp.add_argument("--max-depth", type=int, default=6)
     sp.add_argument("--n-classes", type=int, default=1)
@@ -151,6 +158,10 @@ def main(argv=None) -> int:
             report["checkpoint"] = ckpt.save(args.ckpt_dir, args.epochs, {
                 "params": tr.params, "opt_state": tr.opt_state,
             })
+        if getattr(args, "dump_scores", None):
+            target = evb if args.eval_data else batch
+            np.savetxt(args.dump_scores, tr.predict_proba(target), fmt="%.6g")
+            report["scores"] = args.dump_scores
 
     elif args.model in ("cnn", "rnn"):
         from lightctr_tpu import optim
@@ -183,7 +194,6 @@ def main(argv=None) -> int:
 
     elif args.model == "gbm":
         from lightctr_tpu.models import gbm
-        from lightctr_tpu.ops.metrics import auc_exact
 
         ds = load_dense_csv(args.data)
         model = gbm.GBMModel(gbm.GBMConfig(
@@ -193,9 +203,11 @@ def main(argv=None) -> int:
         y = ds.labels if args.n_classes > 1 else (ds.labels > 0).astype(np.float32)
         hist = model.fit(ds.features, y)
         report["final_loss"] = hist[-1]
-        report["train_accuracy"] = float((model.predict(ds.features) == y).mean())
-        if args.n_classes <= 1:
-            report["train_auc"] = auc_exact(model.predict_proba(ds.features), y)
+        report["train"] = model.evaluate(ds.features, y)
+        if getattr(args, "dump_scores", None):
+            probs = model.predict_proba(ds.features)
+            np.savetxt(args.dump_scores, probs, fmt="%.6g")
+            report["scores"] = args.dump_scores
 
     elif args.model == "gmm":
         from lightctr_tpu.models import gmm

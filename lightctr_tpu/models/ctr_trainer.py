@@ -48,7 +48,11 @@ class CTRTrainer:
     optimizer: any optax transform; defaults to Adagrad at cfg.learning_rate
         (the reference FM family's workhorse, gradientUpdater.h:127-154).
     mesh: optional Mesh for data-parallel execution; batches are sharded over
-        the ``data`` axis, params replicated.
+        the ``data`` axis, params replicated unless ``param_shardings`` says
+        otherwise.
+    param_shardings: optional pytree of NamedSharding matching ``params`` —
+        e.g. embedding tables row-sharded over the ``embed`` axis (the PS
+        layout); optimizer state inherits the same shardings.
     """
 
     def __init__(
@@ -60,6 +64,7 @@ class CTRTrainer:
         optimizer: Optional[optax.GradientTransformation] = None,
         mesh=None,
         fused_fn: Optional[Callable] = None,
+        param_shardings=None,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -69,12 +74,13 @@ class CTRTrainer:
         self.mesh = mesh
         # own copy: steps donate their input buffers, so the caller's tree
         # must stay untouched (it may seed several trainers)
+        if param_shardings is not None and mesh is None:
+            raise ValueError("param_shardings requires a mesh")
         self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
-        self.opt_state = self.tx.init(self.params)
         if mesh is not None:
-            rep = replicated(mesh)
-            self.params = jax.device_put(self.params, rep)
-            self.opt_state = jax.device_put(self.opt_state, rep)
+            sh = param_shardings if param_shardings is not None else replicated(mesh)
+            self.params = jax.device_put(self.params, sh)
+        self.opt_state = self.tx.init(self.params)  # inherits params' shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
         self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
