@@ -40,11 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--ckpt-dir")
         return sp
 
-    def scoreable(sp):
+    def scoreable(sp, predictor="FM_Predict"):
         # only models with per-row scores get the flag — elsewhere it would
         # be silently meaningless
-        sp.add_argument("--dump-scores", help="write per-row pCTR scores to this file"
-                        " (FM_Predict's optional score dump)")
+        sp.add_argument("--dump-scores", help="write per-row pCTR scores to this"
+                        f" file ({predictor}'s optional score dump)")
         return sp
 
     for name in ("fm", "ffm", "nfm", "widedeep"):
@@ -70,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hidden", type=int, default=60)
     sp.add_argument("--gauss", type=int, default=20)
 
-    sp = scoreable(common(sub.add_parser("gbm"), lr=0.6, batch=0))
+    sp = scoreable(common(sub.add_parser("gbm"), lr=0.6, batch=0), predictor="GBM_Predict")
     sp.add_argument("--n-trees", type=int, default=10)
     sp.add_argument("--max-depth", type=int, default=6)
     sp.add_argument("--n-classes", type=int, default=1)
@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--quantize", action="store_true")
     sp.add_argument("--cluster", type=int, default=0)
     return p
+
+
+def _dump_scores(path: str, probs, report: dict) -> None:
+    np.savetxt(path, probs, fmt="%.6g")
+    report["scores"] = path
 
 
 def main(argv=None) -> int:
@@ -160,8 +165,7 @@ def main(argv=None) -> int:
             })
         if getattr(args, "dump_scores", None):
             target = evb if args.eval_data else batch
-            np.savetxt(args.dump_scores, tr.predict_proba(target), fmt="%.6g")
-            report["scores"] = args.dump_scores
+            _dump_scores(args.dump_scores, tr.predict_proba(target), report)
 
     elif args.model in ("cnn", "rnn"):
         from lightctr_tpu import optim
@@ -205,9 +209,7 @@ def main(argv=None) -> int:
         report["final_loss"] = hist[-1]
         report["train"] = model.evaluate(ds.features, y)
         if getattr(args, "dump_scores", None):
-            probs = model.predict_proba(ds.features)
-            np.savetxt(args.dump_scores, probs, fmt="%.6g")
-            report["scores"] = args.dump_scores
+            _dump_scores(args.dump_scores, model.predict_proba(ds.features), report)
 
     elif args.model == "gmm":
         from lightctr_tpu.models import gmm

@@ -72,10 +72,10 @@ class CTRTrainer:
         self.fused_fn = fused_fn
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh
-        # own copy: steps donate their input buffers, so the caller's tree
-        # must stay untouched (it may seed several trainers)
         if param_shardings is not None and mesh is None:
             raise ValueError("param_shardings requires a mesh")
+        # own copy: steps donate their input buffers, so the caller's tree
+        # must stay untouched (it may seed several trainers)
         self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
         if mesh is not None:
             sh = param_shardings if param_shardings is not None else replicated(mesh)

@@ -310,27 +310,26 @@ class GBMModel:
         """Logloss / accuracy / AUC report (GBM_Predict parity,
         gbm_predict.cpp:22-44 incl. multiclass vote).  One
         decision_function pass feeds every metric."""
+        from lightctr_tpu.ops import losses as losses_lib
         from lightctr_tpu.ops.activations import sigmoid
-        from lightctr_tpu.ops.metrics import auc_exact
+        from lightctr_tpu.ops.metrics import auc_exact, logloss
 
         y = np.asarray(y)
         z = self.decision_function(x)
         if self.cfg.n_classes <= 1:
             probs = np.asarray(sigmoid(jnp.asarray(z[:, 0])))
             pred = (z[:, 0] > 0).astype(y.dtype)
-            p = np.clip(probs, 1e-7, 1 - 1e-7)
             return {
                 "accuracy": float((pred == y).mean()),
-                "logloss": float(-np.mean(y * np.log(p) + (1 - y) * np.log1p(-p))),
+                "logloss": float(logloss(jnp.asarray(probs), jnp.asarray(y))),
                 "auc": auc_exact(probs, y),
             }
-        probs = np.asarray(jax.nn.softmax(jnp.asarray(z), axis=-1))
         pred = np.argmax(z, axis=1)
-        onehot = np.eye(probs.shape[1])[y.astype(int)]
+        onehot = jnp.asarray(np.eye(z.shape[1], dtype=np.float32)[y.astype(int)])
         return {
             "accuracy": float((pred == y).mean()),
             "logloss": float(
-                -np.mean(np.sum(onehot * np.log(np.clip(probs, 1e-12, 1)), axis=1))
+                losses_lib.softmax_cross_entropy(jnp.asarray(z), onehot, reduction="mean")
             ),
         }
 

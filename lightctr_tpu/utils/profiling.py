@@ -1,0 +1,65 @@
+"""Profiling hooks.
+
+Parity-plus for SURVEY.md §5 "tracing/profiling": the reference has wall-clock
+counters (``clock_start``/``clock_cycles``, time.h:81-99) and DEBUG printf
+tracing; on TPU the right tool is ``jax.profiler`` traces viewed in
+Perfetto/TensorBoard.
+
+``trace(dir)`` wraps a region; ``wall_clock()`` reproduces the reference's
+train-wall-clock counter pair.
+
+Caveat (environment note): under the experimental ``axon`` remote-TPU
+platform the profiler hangs — use on CPU or directly-attached TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """jax.profiler trace around a region; view in TensorBoard/Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class wall_clock:
+    """clock_start/clock_cycles parity (time.h:81-99): seconds since start.
+    As a context manager, the elapsed time freezes at block exit so a later
+    ``cycles()`` reports the timed region, not everything since."""
+
+    def __init__(self):
+        self._t0: Optional[float] = None
+        self._t1: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self._t1 = None
+
+    def cycles(self) -> float:
+        if self._t0 is None:
+            raise RuntimeError("start() first")
+        end = self._t1 if self._t1 is not None else time.perf_counter()
+        return end - self._t0
+
+    def __enter__(self) -> "wall_clock":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._t1 = time.perf_counter()
+
+
+def annotate(name: str):
+    """Named sub-region for traces (shows as a block in the timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

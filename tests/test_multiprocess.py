@@ -7,6 +7,9 @@ import socket
 import subprocess
 import sys
 import textwrap
+from pathlib import Path
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
 
 WORKER = textwrap.dedent(
     """
@@ -45,14 +48,14 @@ def test_two_process_psum(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
-    env["PYTHONPATH"] = "/root/repo" + (
+    env["PYTHONPATH"] = REPO_ROOT + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
     )
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd="/root/repo",
+            env=env, cwd=REPO_ROOT,
         )
         for i in range(2)
     ]
