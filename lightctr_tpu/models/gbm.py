@@ -56,26 +56,33 @@ class GBMConfig:
 
 
 class Tree(NamedTuple):
-    feature: jax.Array    # [nodes] int32, -1 for leaf
-    threshold: jax.Array  # [nodes] int32 bin threshold (go left if bin <= thr)
-    weight: jax.Array     # [nodes] f32 leaf weight
+    feature: jax.Array       # [nodes] int32, -1 for leaf
+    threshold: jax.Array     # [nodes] int32: real bins 1..thr go left
+    weight: jax.Array        # [nodes] f32 leaf weight
+    default_left: jax.Array  # [nodes] bool: where missing (bin 0) goes
+                             # (learned per split, train_gbm_algo.cpp:224-322)
 
 
 def apply_bins(edges: np.ndarray, x: np.ndarray) -> np.ndarray:
     """Encode features against per-feature quantile edges.  One definition
-    for train AND predict time so the missing-value convention (NaN -> bin 0)
-    and search side can never desynchronize."""
-    xx = np.nan_to_num(x, nan=-np.inf)
+    for train AND predict time so the missing-value convention and search
+    side can never desynchronize.
+
+    Bin 0 is RESERVED for missing values (NaN); real values land in bins
+    [1, n_bins] — the tree learns a default direction for bin 0 per split
+    (the reference's NaN-direction scan, train_gbm_algo.cpp:224-322)."""
     bins = np.zeros(x.shape, np.int32)
+    nan_mask = np.isnan(x)
+    xx = np.nan_to_num(x, nan=0.0)
     for f in range(x.shape[1]):
-        bins[:, f] = np.searchsorted(edges[:, f], xx[:, f], side="left")
+        bins[:, f] = np.searchsorted(edges[:, f], xx[:, f], side="left") + 1
+    bins[nan_mask] = 0
     return bins.astype(np.int32)
 
 
 def quantile_bins(x: np.ndarray, n_bins: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Host-side one-time binning: per-feature quantile edges -> codes.
-    NaNs map to bin 0 (the reference learns a default direction per split;
-    at histogram granularity missing values share the lowest bin)."""
+    """Host-side one-time binning: per-feature quantile edges -> codes in
+    [0, n_bins] where 0 = missing (see apply_bins)."""
     qs = np.linspace(0, 100, n_bins + 1)[1:-1]
     edges = np.nanpercentile(x, qs, axis=0)            # [n_bins-1, F]
     return apply_bins(edges, x), edges
@@ -99,10 +106,12 @@ def build_tree(
     min_leaf_hess: float,
 ) -> Tree:
     n, f = bins.shape
+    n_slots = n_bins + 1  # slot 0 = missing, 1..n_bins = real bins
     n_nodes = (1 << (max_depth + 1)) - 1
     feature = jnp.full((n_nodes,), -1, jnp.int32)
     threshold = jnp.zeros((n_nodes,), jnp.int32)
     weight = jnp.zeros((n_nodes,), jnp.float32)
+    default_left = jnp.zeros((n_nodes,), jnp.bool_)
     # rows start at node 0; inactive (unsampled) rows get node -1
     node_of_row = jnp.where(row_mask > 0, 0, -1)
 
@@ -116,8 +125,8 @@ def build_tree(
         active = (local >= 0) & (local < level_size)
         # (node, feature, bin) histograms via one segment_sum per statistic
         flat = (
-            jnp.where(active, local, 0)[:, None] * (f * n_bins)
-            + jnp.arange(f)[None, :] * n_bins
+            jnp.where(active, local, 0)[:, None] * (f * n_slots)
+            + jnp.arange(f)[None, :] * n_slots
             + bins
         )                                                       # [N, F]
         seg = flat.reshape(-1)
@@ -125,51 +134,68 @@ def build_tree(
         g_rep = jnp.broadcast_to(g[:, None] * amask, (n, f)).reshape(-1)
         h_rep = jnp.broadcast_to(h[:, None] * amask, (n, f)).reshape(-1)
         hist_g = jax.ops.segment_sum(
-            g_rep, seg, num_segments=level_size * f * n_bins
-        ).reshape(level_size, f, n_bins)
+            g_rep, seg, num_segments=level_size * f * n_slots
+        ).reshape(level_size, f, n_slots)
         hist_h = jax.ops.segment_sum(
-            h_rep, seg, num_segments=level_size * f * n_bins
-        ).reshape(level_size, f, n_bins)
+            h_rep, seg, num_segments=level_size * f * n_slots
+        ).reshape(level_size, f, n_slots)
 
-        gl = jnp.cumsum(hist_g, axis=-1)                        # [L, F, B]
-        hl = jnp.cumsum(hist_h, axis=-1)
-        gtot = gl[..., -1:]
-        htot = hl[..., -1:]
-        gr = gtot - gl
-        hr = htot - hl
-
-        gain_l = _threshold_l1(gl, lambda_) ** 2 / (hl + lambda_)
-        gain_r = _threshold_l1(gr, lambda_) ** 2 / (hr + lambda_)
+        miss_g = hist_g[..., :1]                                # [L, F, 1]
+        miss_h = hist_h[..., :1]
+        gl = jnp.cumsum(hist_g[..., 1:], axis=-1)               # [L, F, B] real bins
+        hl = jnp.cumsum(hist_h[..., 1:], axis=-1)
+        gtot = gl[..., -1:] + miss_g                            # node totals incl missing
+        htot = hl[..., -1:] + miss_h
         gain_parent = _threshold_l1(gtot, lambda_) ** 2 / (htot + lambda_)
-        split_gain = gain_l + gain_r - gain_parent              # [L, F, B]
-        ok = (hl >= min_leaf_hess) & (hr >= min_leaf_hess) & (feat_mask[None, :, None] > 0)
-        split_gain = jnp.where(ok, split_gain, -jnp.inf)
 
-        flat_gain = split_gain.reshape(level_size, f * n_bins)
+        def split_gain_for(gl_side, hl_side):
+            gr = gtot - gl_side
+            hr = htot - hl_side
+            gain = (
+                _threshold_l1(gl_side, lambda_) ** 2 / (hl_side + lambda_)
+                + _threshold_l1(gr, lambda_) ** 2 / (hr + lambda_)
+                - gain_parent
+            )
+            ok = (
+                (hl_side >= min_leaf_hess)
+                & (htot - hl_side >= min_leaf_hess)
+                & (feat_mask[None, :, None] > 0)
+            )
+            return jnp.where(ok, gain, -jnp.inf)
+
+        # sparsity-aware candidates: missing mass routed left OR right
+        gain_ml = split_gain_for(gl + miss_g, hl + miss_h)      # [L, F, B]
+        gain_mr = split_gain_for(gl, hl)
+        split_gain = jnp.stack([gain_mr, gain_ml], axis=-1)     # [L, F, B, 2]
+
+        flat_gain = split_gain.reshape(level_size, f * n_bins * 2)
         best = jnp.argmax(flat_gain, axis=-1)                   # [L]
         best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=-1)[:, 0]
-        best_f = (best // n_bins).astype(jnp.int32)
-        best_b = (best % n_bins).astype(jnp.int32)
+        best_f = (best // (n_bins * 2)).astype(jnp.int32)
+        best_b = ((best // 2) % n_bins).astype(jnp.int32) + 1   # real-bin threshold
+        best_ml = (best % 2).astype(jnp.bool_)                  # missing-left?
         do_split = best_gain > 1e-12                            # children beat parent
 
         node_ids = offset + jnp.arange(level_size)
         feature = feature.at[node_ids].set(jnp.where(do_split, best_f, -1))
         threshold = threshold.at[node_ids].set(best_b)
+        default_left = default_left.at[node_ids].set(do_split & best_ml)
         # leaf weight for nodes that stop here (-TL1(G)/(H+l), train_gbm_algo.h:94-96);
         # per-node totals are feature-independent, take feature 0's
-        g_node = gl[:, 0, -1]
-        h_node = hl[:, 0, -1]
+        g_node = gtot[:, 0, 0]
+        h_node = htot[:, 0, 0]
         wleaf = -_threshold_l1(g_node, lambda_) / (h_node + lambda_)
         weight = weight.at[node_ids].set(jnp.where(do_split, 0.0, wleaf))
 
-        # route rows: bin <= thr -> left child
+        # route rows: real bin <= thr -> left; missing -> default direction
         row_f = jnp.take(feature, jnp.clip(node_of_row, 0, n_nodes - 1))
         row_t = jnp.take(threshold, jnp.clip(node_of_row, 0, n_nodes - 1))
+        row_dl = jnp.take(default_left, jnp.clip(node_of_row, 0, n_nodes - 1))
         row_bin = jnp.take_along_axis(
             bins, jnp.clip(row_f, 0, f - 1)[:, None], axis=1
         )[:, 0]
         is_internal = active & (row_f >= 0)
-        left = row_bin <= row_t
+        left = jnp.where(row_bin == 0, row_dl, row_bin <= row_t)
         child = jnp.where(left, 2 * node_of_row + 1, 2 * node_of_row + 2)
         node_of_row = jnp.where(is_internal, child, node_of_row)
 
@@ -184,7 +210,9 @@ def build_tree(
     node_ids = offset + jnp.arange(level_size)
     wleaf = -_threshold_l1(gsum, lambda_) / (hsum + lambda_)
     weight = weight.at[node_ids].set(wleaf)
-    return Tree(feature=feature, threshold=threshold, weight=weight)
+    return Tree(
+        feature=feature, threshold=threshold, weight=weight, default_left=default_left
+    )
 
 
 @partial(jax.jit, static_argnames=("max_depth",))
@@ -197,9 +225,11 @@ def tree_route(tree: Tree, bins: jax.Array, max_depth: int) -> jax.Array:
     for _ in range(max_depth):
         feat = jnp.take(tree.feature, idx)
         thr = jnp.take(tree.threshold, idx)
+        dl = jnp.take(tree.default_left, idx)
         b = jnp.take_along_axis(bins, jnp.clip(feat, 0, f - 1)[:, None], axis=1)[:, 0]
         internal = feat >= 0
-        child = jnp.where(b <= thr, 2 * idx + 1, 2 * idx + 2)
+        left = jnp.where(b == 0, dl, b <= thr)  # missing -> learned direction
+        child = jnp.where(left, 2 * idx + 1, 2 * idx + 2)
         idx = jnp.where(internal, child, idx)
     return idx
 
