@@ -122,26 +122,30 @@ def main(argv=None) -> int:
 
         ds = load_libffm(args.data)
         key = jax.random.PRNGKey(args.seed)
+        fused = None
         if args.model == "fm":
-            params, logits, l2 = fm.init(key, ds.feature_cnt, args.factor), fm.logits, fm.l2_penalty
+            params, logits = fm.init(key, ds.feature_cnt, args.factor), fm.logits
+            fused = fm.logits_with_l2
         elif args.model == "ffm":
-            params, logits, l2 = (
-                ffm.init(key, ds.feature_cnt, ds.field_cnt, args.factor), ffm.logits, ffm.l2_penalty,
+            params, logits = (
+                ffm.init(key, ds.feature_cnt, ds.field_cnt, args.factor), ffm.logits,
             )
+            fused = ffm.logits_with_l2
         elif args.model == "nfm":
-            params, logits, l2 = (
-                nfm.init(key, ds.feature_cnt, args.factor, args.hidden), nfm.logits, nfm.l2_penalty,
+            params, logits = (
+                nfm.init(key, ds.feature_cnt, args.factor, args.hidden), nfm.logits,
             )
+            fused = nfm.logits_with_l2
         else:
-            params, logits, l2 = (
+            params, logits = (
                 widedeep.init(key, ds.feature_cnt, ds.field_cnt, args.factor, args.hidden),
-                widedeep.logits, None,
+                widedeep.logits,
             )
         batch = ds.batch_dict()
         if args.model == "widedeep":
             rep, rep_mask = widedeep.field_representatives(ds.fids, ds.fields, ds.mask, ds.field_cnt)
             batch = widedeep.make_batch(ds, rep, rep_mask)
-        tr = CTRTrainer(params, logits, cfg, l2_fn=l2)
+        tr = CTRTrainer(params, logits, cfg, fused_fn=fused)
         hist = tr.fit(
             batch,
             epochs=args.epochs,

@@ -43,7 +43,14 @@ def init(key: jax.Array, feature_cnt: int, field_cnt: int, factor_cnt: int) -> D
 
 
 def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+    return logits_with_l2(params, batch)[0]
+
+
+def logits_with_l2(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]):
+    """Forward plus the touched-row L2 from the SAME gathers (the separate
+    penalty would re-read the big [P, Fl, k] gather)."""
     vals = batch["vals"] * batch["mask"]                      # [B, P]
+    mask = batch["mask"]
     fids = batch["fids"]                                      # [B, P]
     fields = batch["fields"]                                  # [B, P]
     field_cnt = params["v"].shape[1]
@@ -60,7 +67,10 @@ def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Arr
     # self-pair correction: x_i^2 * |V[fid_i, field_i, :]|^2
     v_self = jnp.take_along_axis(vg, fields[..., None, None], axis=2)[..., 0, :]  # [B, P, k]
     diag = jnp.sum((v_self * vals[..., None]) ** 2, axis=(1, 2))
-    return linear + 0.5 * (cross - diag)
+    l2 = 0.5 * (
+        jnp.sum(w * w * mask) + jnp.sum(vg * vg * mask[..., None, None])
+    )
+    return linear + 0.5 * (cross - diag), l2
 
 
 def l2_penalty(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:

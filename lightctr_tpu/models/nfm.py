@@ -39,22 +39,36 @@ def init(
     }
 
 
-def bi_interaction(params, batch) -> jax.Array:
-    """0.5[(sum vx)^2 - sum (vx)^2] in R^k — the NFM pooling vector."""
-    vals = batch["vals"] * batch["mask"]
-    v = jnp.take(params["v"], batch["fids"], axis=0)          # [B, P, k]
-    vx = v * vals[..., None]
+def _bi_pool(vx: jax.Array) -> jax.Array:
+    """0.5[(sum vx)^2 - sum (vx)^2] over the nnz axis — THE pooling formula
+    (one definition; both the public API and the fused path use it)."""
     sumvx = jnp.sum(vx, axis=1)                                # [B, k]
     return 0.5 * (sumvx * sumvx - jnp.sum(vx * vx, axis=1))
 
 
-def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+def bi_interaction(params, batch) -> jax.Array:
+    """NFM pooling vector in R^k."""
     vals = batch["vals"] * batch["mask"]
+    v = jnp.take(params["v"], batch["fids"], axis=0)          # [B, P, k]
+    return _bi_pool(v * vals[..., None])
+
+
+def logits(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax.Array:
+    return logits_with_l2(params, batch)[0]
+
+
+def logits_with_l2(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]):
+    """Forward plus touched-row L2 from the same gathers."""
+    vals = batch["vals"] * batch["mask"]
+    mask = batch["mask"]
     w = jnp.take(params["w"], batch["fids"], axis=0)
     wide = jnp.sum(w * vals, axis=-1)                          # [B]
-    h = dense.apply(params["fc1"], bi_interaction(params, batch), activation=sigmoid)
+    v = jnp.take(params["v"], batch["fids"], axis=0)           # [B, P, k]
+    bi = _bi_pool(v * vals[..., None])
+    h = dense.apply(params["fc1"], bi, activation=sigmoid)
     deep = dense.apply(params["fc2"], h, activation=sigmoid)[:, 0]
-    return wide + deep
+    l2 = 0.5 * (jnp.sum(w * w * mask) + jnp.sum(v * v * mask[..., None]))
+    return wide + deep, l2
 
 
 # same touched-row L2 semantics over the same ('w' [F], 'v' [F,k]) params

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""TPU step-latency bisect — run this when the axon relay recovers.
+
+Round-1 mystery (docs/STATUS_r1.md): a chained FM full-batch step cost ~14 ms
+on the v5e while every component microbenchmarked <0.1 ms unchained.
+Unchained timings on axon are untrustworthy (pipelining/caching), so every
+variant here runs as an on-device lax.scan and reports warm ms/step.
+
+Usage:  python tools/tpu_bisect.py [scan_len]
+Prints one line per variant; compare to attribute the per-step cost.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from lightctr_tpu import optim  # noqa: E402
+from lightctr_tpu.data import load_libffm  # noqa: E402
+from lightctr_tpu.models import fm  # noqa: E402
+from lightctr_tpu.ops import losses as L  # noqa: E402
+
+
+def scan_time(body, carry, label, length):
+    @jax.jit
+    def run(c):
+        return jax.lax.scan(body, c, None, length=length)[0]
+
+    t0 = time.perf_counter()
+    r = run(carry)
+    jax.block_until_ready(r)
+    t_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = run(carry)
+    jax.block_until_ready(r)
+    dt = time.perf_counter() - t0
+    print(
+        f"{label:32s} compile {t_compile:6.1f}s  warm {dt / length * 1000:8.2f} ms/step",
+        flush=True,
+    )
+
+
+def main():
+    length = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    ds, _ = load_libffm("/root/reference/data/train_sparse.csv").compact()
+    b = {k: jnp.asarray(v) for k, v in ds.batch_dict().items()}
+    params = fm.init(jax.random.PRNGKey(0), ds.feature_cnt, 8)
+    tx = optim.adagrad(0.1)
+    state = tx.init(params)
+    print(f"devices: {jax.devices()}  F={ds.feature_cnt}  scan={length}", flush=True)
+
+    def lossf(p):
+        z, l2 = fm.logits_with_l2(p, b)
+        return L.logistic_loss(z, b["labels"], reduction="mean") + 0.001 * l2 / 1000
+
+    # A: forward only
+    def body_a(c, _):
+        p, acc = c
+        return (p, acc + lossf(p)), None
+
+    scan_time(body_a, (params, jnp.zeros(())), "A forward-only", length)
+
+    # B: grad + sgd
+    def body_b(c, _):
+        (p,) = c
+        g = jax.grad(lossf)(p)
+        return (jax.tree_util.tree_map(lambda w, x: w - 0.01 * x, p, g),), None
+
+    scan_time(body_b, (params,), "B grad+sgd", length)
+
+    # C: adagrad on constant grads (no autodiff)
+    gconst = jax.tree_util.tree_map(lambda a: jnp.ones_like(a) * 1e-3, params)
+
+    def body_c(c, _):
+        p, s = c
+        u, s = tx.update(gconst, s, p)
+        return (jax.tree_util.tree_map(lambda w, x: w + x, p, u), s), None
+
+    scan_time(body_c, (params, state), "C adagrad-dense-only", length)
+
+    # D: full step
+    def body_d(c, _):
+        p, s = c
+        g = jax.grad(lossf)(p)
+        u, s = tx.update(g, s, p)
+        return (jax.tree_util.tree_map(lambda w, x: w + x, p, u), s), None
+
+    scan_time(body_d, (params, state), "D full step", length)
+
+    # E: full step in bf16 compute
+    b16 = {
+        k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+        for k, v in b.items()
+    }
+
+    def lossf16(p):
+        p16 = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), p)
+        z, l2 = fm.logits_with_l2(p16, b16)
+        return (
+            L.logistic_loss(z.astype(jnp.float32), b["labels"], reduction="mean")
+            + 0.001 * l2.astype(jnp.float32) / 1000
+        )
+
+    def body_e(c, _):
+        p, s = c
+        g = jax.grad(lossf16)(p)
+        u, s = tx.update(g, s, p)
+        return (jax.tree_util.tree_map(lambda w, x: w + x, p, u), s), None
+
+    scan_time(body_e, (params, state), "E full step bf16", length)
+
+
+if __name__ == "__main__":
+    main()
