@@ -117,11 +117,31 @@ class ClassifierTrainer:
         z = self._logits_j(self.params, jnp.asarray(features))
         return np.asarray(jnp.argmax(z, axis=-1))
 
-    def evaluate(self, features: np.ndarray, labels: np.ndarray) -> Dict[str, float]:
+    def evaluate(
+        self, features: np.ndarray, labels: np.ndarray, batch_size: Optional[int] = None
+    ) -> Dict[str, float]:
         """Loss + accuracy report (dl_algo_abst.h:132-177 validate); the loss
-        reported is the trainer's own objective so history and eval compare."""
-        z = self._logits_j(self.params, jnp.asarray(features))
-        onehot = jax.nn.one_hot(jnp.asarray(labels), self.n_classes)
-        loss = float(_classification_loss(self.loss_name, z, onehot))
-        acc = float(jnp.mean((jnp.argmax(z, -1) == jnp.asarray(labels)).astype(jnp.float32)))
-        return {"loss": loss, "accuracy": acc}
+        reported is the trainer's own objective so history and eval compare.
+        ``batch_size`` streams in chunks (memory-bounded big-set eval)."""
+        n = len(features)
+        if batch_size is not None and batch_size < 1:
+            raise ValueError(f"batch_size must be positive, got {batch_size}")
+        if batch_size is None or batch_size >= n:
+            z = self._logits_j(self.params, jnp.asarray(features))
+            onehot = jax.nn.one_hot(jnp.asarray(labels), self.n_classes)
+            loss = float(_classification_loss(self.loss_name, z, onehot))
+            acc = float(
+                jnp.mean((jnp.argmax(z, -1) == jnp.asarray(labels)).astype(jnp.float32))
+            )
+            return {"loss": loss, "accuracy": acc}
+        loss_sum = 0.0
+        correct = 0.0
+        for s in range(0, n, batch_size):  # includes the tail remainder
+            fx = jnp.asarray(features[s : s + batch_size])
+            ly = jnp.asarray(labels[s : s + batch_size])
+            m = fx.shape[0]
+            z = self._logits_j(self.params, fx)
+            onehot = jax.nn.one_hot(ly, self.n_classes)
+            loss_sum += float(_classification_loss(self.loss_name, z, onehot)) * m
+            correct += float(jnp.sum((jnp.argmax(z, -1) == ly).astype(jnp.float32)))
+        return {"loss": loss_sum / n, "accuracy": correct / n}

@@ -113,6 +113,55 @@ def main():
 
     scan_time(body_e, (params, state), "E full step bf16", length)
 
+    # F: full step with a HOST-precomputed sorted backward for the table
+    # gradients (ids are batch-constant full-batch, so the sort is free) —
+    # segment_sum(indices_are_sorted=True) instead of XLA's scatter-add.
+    # CPU result: slower than the default scatter; measure on TPU.
+    flat_ids = np.asarray(ds.fids).reshape(-1)
+    order = np.argsort(flat_ids, kind="stable")
+    sorted_ids = jnp.asarray(flat_ids[order])
+    order_j = jnp.asarray(order)
+    n_rows_tbl = ds.feature_cnt
+
+    @jax.custom_vjp
+    def lookup_ps(table, ids):
+        return jnp.take(table, ids, axis=0)
+
+    def _fwd(table, ids):
+        return jnp.take(table, ids, axis=0), ids.shape
+
+    def _bwd(shape, gr):
+        flat_g = gr.reshape((-1,) + gr.shape[len(shape):])
+        dt = jax.ops.segment_sum(
+            flat_g[order_j], sorted_ids,
+            num_segments=n_rows_tbl, indices_are_sorted=True,
+        )
+        return dt, None
+
+    lookup_ps.defvjp(_fwd, _bwd)
+
+    def lossf_ps(p):
+        # same objective as variant D (incl. the L2 term through the same
+        # gathers) so F-vs-D isolates ONLY the backward scatter strategy
+        vals = b["vals"] * b["mask"]
+        mask = b["mask"]
+        w = lookup_ps(p["w"], b["fids"])
+        lin = jnp.sum(w * vals, -1)
+        v = lookup_ps(p["v"], b["fids"])
+        vx = v * vals[..., None]
+        s2 = jnp.sum(vx, 1)
+        z = lin + 0.5 * (jnp.sum(s2 * s2, -1) - jnp.sum(vx * vx, (1, 2)))
+        l2 = 0.5 * (jnp.sum(w * w * mask) + jnp.sum(v * v * mask[..., None]))
+        return L.logistic_loss(z, b["labels"], reduction="mean") + 0.001 * l2 / 1000
+
+    def body_f(c, _):
+        p, s = c
+        g = jax.grad(lossf_ps)(p)
+        u, s = tx.update(g, s, p)
+        return (jax.tree_util.tree_map(lambda w, x: w + x, p, u), s), None
+
+    scan_time(body_f, (params, state), "F presorted-segment backward", length)
+
 
 if __name__ == "__main__":
     main()
