@@ -26,3 +26,22 @@ def split_tree(key: jax.Array, tree):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     keys = jax.random.split(key, len(leaves))
     return jax.tree_util.tree_unflatten(treedef, list(keys))
+
+
+def shuffle_select_k(key: jax.Array, n: int, k: int) -> jax.Array:
+    """k distinct indices drawn from [0, n) — ``shuffleSelectK``
+    (random.h:97-114) as a partial Fisher-Yates; here simply a permutation
+    prefix (identical distribution, no n/2 >= k restriction)."""
+    if k > n:
+        raise ValueError(f"k={k} > n={n}")
+    return jax.random.permutation(key, n)[:k]
+
+
+def sub_sample_size(alpha: float = 0.05, error_bound: float = 0.05) -> int:
+    """Statistical sample size for a given confidence level and error bound —
+    ``subSampleSize`` (random.h:86-95): n = z^2/4 / e^2 with z the two-sided
+    normal quantile (worst-case p = 1/2)."""
+    from lightctr_tpu.ops.significance import z_value
+
+    z = z_value(1.0 - alpha)
+    return int((z * z / 4.0) / (error_bound * error_bound))

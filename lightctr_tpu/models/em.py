@@ -1,0 +1,39 @@
+"""Generic EM driver — ``EM_Algo_Abst`` parity.
+
+The reference's EM template method (em_algo_abst.h:33-48) runs
+``Train_EStep`` -> ``Train_MStep`` until the ELOB stops improving; GMM and
+PLSA subclass it.  Here the same template is one function over pure
+(params, data) step functions — :mod:`lightctr_tpu.models.gmm` and
+:mod:`lightctr_tpu.models.plsa` both drive their jitted steps through it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+def fit_em(
+    params,
+    step: Callable,  # (params, data) -> (params, loglik)
+    data,
+    epochs: int = 50,
+    tol: float = 1e-3,
+    verbose: bool = False,
+    name: str = "EM",
+) -> Tuple[object, List[float]]:
+    """Iterate ``step`` until the log-likelihood's relative improvement drops
+    below ``tol`` (em_algo_abst.h:33-48 convergence semantics)."""
+    history: List[float] = []
+    prev = -np.inf
+    for it in range(epochs):
+        params, ll = step(params, data)
+        ll = float(ll)
+        history.append(ll)
+        if verbose:
+            print(f"{name} iter {it}: loglik={ll:.4f}")
+        if np.isfinite(prev) and abs(ll - prev) < tol * abs(prev):
+            break
+        prev = ll
+    return params, history

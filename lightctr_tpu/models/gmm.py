@@ -99,6 +99,11 @@ def m_step(params: GMMParams, x: jax.Array, resp: jax.Array) -> GMMParams:
     return GMMParams(mu=mu, sigma=sigma, weight=weight)
 
 
+def _em_step(params: GMMParams, x: jax.Array):
+    resp, ll_rows = e_step(params, x)
+    return m_step(params, x, resp), jnp.sum(ll_rows)
+
+
 def fit(
     params: GMMParams,
     x: np.ndarray,
@@ -106,21 +111,11 @@ def fit(
     tol: float = 1e-3,
     verbose: bool = False,
 ) -> Tuple[GMMParams, list]:
-    """EM until ELOB convergence (em_algo_abst.h:33-48 threshold semantics)."""
-    xj = jnp.asarray(x)
-    history = []
-    prev = -np.inf
-    for it in range(epochs):
-        resp, ll_rows = e_step(params, xj)
-        params = m_step(params, xj, resp)
-        ll = float(jnp.sum(ll_rows))
-        history.append(ll)
-        if verbose:
-            print(f"EM iter {it}: loglik={ll:.4f}")
-        if abs(ll - prev) < tol * abs(prev):
-            break
-        prev = ll
-    return params, history
+    """EM until ELOB convergence, via the shared template
+    (models/em.py = em_algo_abst.h:33-48)."""
+    from lightctr_tpu.models.em import fit_em
+
+    return fit_em(params, _em_step, jnp.asarray(x), epochs, tol, verbose, name="GMM")
 
 
 def predict(params: GMMParams, x: np.ndarray) -> np.ndarray:

@@ -64,19 +64,12 @@ def fit(
     tol: float = 1e-4,
     verbose: bool = False,
 ) -> Tuple[PLSAParams, list]:
-    cj = jnp.asarray(counts, jnp.float32)
-    history: list = []
-    prev = -np.inf
-    for it in range(epochs):
-        params, ll = em_step(params, cj)
-        ll = float(ll)
-        history.append(ll)
-        if verbose:
-            print(f"PLSA iter {it}: loglik={ll:.2f}")
-        if np.isfinite(prev) and abs(ll - prev) < tol * abs(prev):
-            break
-        prev = ll
-    return params, history
+    from lightctr_tpu.models.em import fit_em
+
+    return fit_em(
+        params, em_step, jnp.asarray(counts, jnp.float32),
+        epochs, tol, verbose, name="PLSA",
+    )
 
 
 def topic_keywords(

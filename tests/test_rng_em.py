@@ -1,0 +1,39 @@
+"""random.h sampling utilities + the shared EM template."""
+
+import jax
+import numpy as np
+
+from lightctr_tpu.core import rng as rng_lib
+from lightctr_tpu.models.em import fit_em
+
+
+def test_shuffle_select_k():
+    idx = np.asarray(rng_lib.shuffle_select_k(jax.random.PRNGKey(0), 100, 10))
+    assert len(idx) == 10 and len(set(idx.tolist())) == 10
+    assert idx.min() >= 0 and idx.max() < 100
+    try:
+        rng_lib.shuffle_select_k(jax.random.PRNGKey(0), 5, 6)
+        assert False
+    except ValueError:
+        pass
+
+
+def test_sub_sample_size():
+    # z(0.975) ~= 1.96 -> n = 1.96^2/4 / 0.05^2 ~= 384 (random.h:86-95)
+    n = rng_lib.sub_sample_size(0.05, 0.05)
+    assert 380 <= n <= 390, n
+    assert rng_lib.sub_sample_size(0.05, 0.01) > n  # tighter bound, more samples
+
+
+def test_fit_em_converges_and_stops():
+    calls = []
+
+    def step(p, d):
+        calls.append(1)
+        # loglik -> -1 with geometrically shrinking improvements, so the
+        # RELATIVE criterion |dll| < tol*|ll| eventually fires
+        return p * 0.5, -1.0 - p
+
+    p, hist = fit_em(8.0, step, None, epochs=100, tol=1e-2)
+    assert len(hist) < 100  # stopped early on convergence
+    assert hist[0] < hist[-1] <= -1.0
