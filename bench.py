@@ -10,51 +10,19 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 import json
 import os
-import signal
-import sys
 import time
 
 BASELINE_EXAMPLES_PER_SEC = 1000 * 1000 / 9.32  # vs_libfm.png, k=8
 
-# Watchdog: the axon TPU relay can wedge (backend init hangs forever in native
-# code, so SIGALRM alone can't save us).  Probe the device from a forked child
-# with a hard timeout; on failure fall back to the CPU platform in-process so
-# the driver always gets its JSON line.
-_DEVICE_TIMEOUT_S = 180
+# Watchdog: a wedged accelerator relay must never hang the benchmark — probe
+# from a forked child with a hard timeout, fall back to CPU in-process.
+# LIGHTCTR_BENCH_CPU=1 forces the CPU path without probing.
+from lightctr_tpu.utils.devicecheck import ensure_live_backend  # noqa: E402
 
-
-def _device_alive() -> bool:
-    pid = os.fork()
-    if pid == 0:  # child: backend init either returns or hangs
-        try:
-            import jax
-
-            jax.devices()
-            os._exit(0)
-        except Exception:
-            os._exit(1)
-    deadline = time.time() + _DEVICE_TIMEOUT_S
-    while time.time() < deadline:
-        done, status = os.waitpid(pid, os.WNOHANG)
-        if done:
-            # a probe killed by a signal (e.g. SIGSEGV in backend init) is a
-            # dead device, not a healthy one
-            return os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0
-        time.sleep(1.0)
-    os.kill(pid, signal.SIGKILL)
-    os.waitpid(pid, 0)
-    return False
-
-
-if not os.environ.get("LIGHTCTR_BENCH_CPU") and not _device_alive():
-    sys.stderr.write("bench: device init timed out; falling back to CPU\n")
-    os.environ["LIGHTCTR_BENCH_CPU"] = "1"
+ensure_live_backend(force_cpu=bool(os.environ.get("LIGHTCTR_BENCH_CPU")))
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
-
-if os.environ.get("LIGHTCTR_BENCH_CPU"):
-    jax.config.update("jax_platforms", "cpu")
 
 
 def main():

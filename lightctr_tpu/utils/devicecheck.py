@@ -1,0 +1,92 @@
+"""Accelerator liveness probe with CPU fallback.
+
+The experimental axon remote-TPU relay can wedge such that the FIRST backend
+initialization (jax.devices(), any computation) blocks forever in native code
+— even with JAX_PLATFORMS=cpu, because the registered axon plugin still gets
+initialized.  Signal handlers can't interrupt it, so the probe runs in a
+forked child with a hard timeout; on failure the parent disables the axon
+plugin path (PALLAS_AXON_POOL_IPS) and pins the CPU platform BEFORE its own
+first backend use.
+
+Call :func:`ensure_live_backend` before the first jax computation in any
+entry point that must never hang (bench.py, __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+DEFAULT_TIMEOUT_S = 180
+
+# per-process cache (deliberately NOT an env var: children must re-probe —
+# the relay may wedge between a parent's probe and a child's first jax use)
+_checked: Optional[bool] = None
+
+
+def _probe_in_child() -> bool:
+    pid = os.fork()
+    if pid == 0:
+        # child: every exit path must end in os._exit — escaping the fork
+        # branch would run the caller's module body in a second process
+        code = 1
+        try:
+            import jax
+
+            jax.devices()
+            code = 0
+        except BaseException:
+            code = 1
+        finally:
+            os._exit(code)
+    deadline = time.time() + float(
+        os.environ.get("LIGHTCTR_DEVICE_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+    )
+    while time.time() < deadline:
+        done, status = os.waitpid(pid, os.WNOHANG)
+        if done:
+            return os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0
+        time.sleep(1.0)
+    os.kill(pid, signal.SIGKILL)
+    os.waitpid(pid, 0)
+    return False
+
+
+def _force_cpu() -> None:
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_live_backend(announce: bool = True, force_cpu: bool = False) -> bool:
+    """Returns True when the configured backend answers; otherwise falls back
+    to CPU in-process and returns False.  ``force_cpu`` skips the probe and
+    applies the fallback directly.  Idempotent per process."""
+    global _checked
+    if force_cpu:
+        _force_cpu()
+        _checked = False
+        return False
+    if _checked is not None:
+        return _checked
+    if os.environ.get("JAX_PLATFORMS") == "cpu" and not os.environ.get(
+        "PALLAS_AXON_POOL_IPS"
+    ):
+        # axon plugin disabled and CPU pinned: nothing can wedge — skip the
+        # fork + cold jax import (halves startup of CPU-pinned runs)
+        _checked = True
+        return True
+    alive = _probe_in_child()
+    _checked = alive
+    if not alive:
+        if announce:
+            sys.stderr.write(
+                "lightctr_tpu: accelerator init timed out; falling back to CPU\n"
+            )
+        _force_cpu()
+    return alive
