@@ -1,0 +1,123 @@
+"""Self-attention sequence CTR model.
+
+The sequence-CTR capability (BASELINE.json config 4: "Embedding +
+Self-Attention RNN ... sequence CTR path"): a user's behavior sequence of item
+ids is embedded, encoded by pre-norm self-attention blocks, masked-mean
+pooled, and scored.  This is the transformer-era upgrade of the reference's
+LSTM + additive attention pipeline (train_rnn_algo.h) applied to CTR.
+
+Design notes
+------------
+- Attention here is :func:`lightctr_tpu.nn.ring_attention.full_attention`
+  with key-padding masks (behavior sequences are short, T <= a few hundred,
+  so the [T, T] matrix is cheap).  For long contexts the same [B, T, H, D]
+  layout fits :func:`lightctr_tpu.nn.flash_attention.flash_attention`
+  (single chip) or ``ring_self_attention`` (seq-sharded), BUT neither
+  supports key-padding masks yet — a swap requires adding that first (or
+  using fixed-length unpadded sequences).
+- RMSNorm + residual blocks; GELU FFN; learned position embeddings.
+- Trains through CTRTrainer: ``batch = {"seq_ids": [B, T] int32,
+  "seq_mask": [B, T] f32, "labels": [B]}``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from lightctr_tpu.nn import dense
+from lightctr_tpu.nn.ring_attention import full_attention
+
+
+def init(
+    key: jax.Array,
+    vocab: int,
+    dim: int = 32,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    max_len: int = 128,
+    ffn_mult: int = 2,
+) -> Dict:
+    if dim % n_heads:
+        raise ValueError(f"dim {dim} not divisible by n_heads {n_heads}")
+    keys = jax.random.split(key, 2 + 4 * n_layers + 1)
+    params: Dict = {
+        "embed": jax.random.normal(keys[0], (vocab, dim)) / jnp.sqrt(float(dim)),
+        "pos": jax.random.normal(keys[1], (max_len, dim)) * 0.02,
+        "blocks": [],
+        "head": dense.init(keys[-1], dim, 1, scale="fan_in"),
+    }
+    for i in range(n_layers):
+        k = keys[2 + 4 * i : 6 + 4 * i]
+        params["blocks"].append(
+            {
+                "qkv": dense.init(k[0], dim, 3 * dim, scale="fan_in"),
+                "out": dense.init(k[1], dim, dim, scale="fan_in"),
+                "ffn1": dense.init(k[2], dim, ffn_mult * dim, scale="fan_in"),
+                "ffn2": dense.init(k[3], ffn_mult * dim, dim, scale="fan_in"),
+                "ln1": jnp.ones((dim,)),
+                "ln2": jnp.ones((dim,)),
+            }
+        )
+    return params
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    return x * jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6) * scale
+
+
+def _mha(block: Dict, x: jax.Array, key_mask: jax.Array, n_heads: int) -> jax.Array:
+    b, t, d = x.shape
+    hd = d // n_heads
+    qkv = dense.apply(block["qkv"], x)                       # [B, T, 3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda z: z.reshape(b, t, n_heads, hd)        # noqa: E731
+    ctx = full_attention(
+        to_heads(q), to_heads(k), to_heads(v), key_mask=key_mask
+    ).reshape(b, t, d)
+    return dense.apply(block["out"], ctx)
+
+
+def make_logits(n_heads: int):
+    """Returns a ``logits(params, batch)`` closure with the static head count
+    (kept out of the params pytree so optimizers never see it)."""
+
+    def logits(params: Dict, batch: Dict[str, jax.Array]) -> jax.Array:
+        ids = batch["seq_ids"]                               # [B, T]
+        mask = batch["seq_mask"]                             # [B, T]
+        t = ids.shape[1]
+        max_len = params["pos"].shape[0]
+        if t > max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds the model's max_len {max_len}"
+            )
+        x = jnp.take(params["embed"], ids, axis=0) + params["pos"][None, :t]
+        x = x * mask[..., None]
+        for block in params["blocks"]:
+            x = x + _mha(block, _rms_norm(x, block["ln1"]), mask, n_heads)
+            h = _rms_norm(x, block["ln2"])
+            x = x + dense.apply(
+                block["ffn2"], jax.nn.gelu(dense.apply(block["ffn1"], h))
+            )
+        # masked mean pool over real positions
+        denom = jnp.maximum(jnp.sum(mask, axis=1, keepdims=True), 1.0)
+        pooled = jnp.sum(x * mask[..., None], axis=1) / denom  # [B, D]
+        return dense.apply(params["head"], pooled)[:, 0]
+
+    return logits
+
+
+def build(
+    key: jax.Array,
+    vocab: int,
+    dim: int = 32,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    max_len: int = 128,
+    ffn_mult: int = 2,
+):
+    """(params, logits_fn) pair ready for CTRTrainer."""
+    params = init(key, vocab, dim, n_heads, n_layers, max_len, ffn_mult)
+    return params, make_logits(n_heads)

@@ -119,14 +119,21 @@ def ring_self_attention(
 
 
 def full_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    key_mask: jax.Array | None = None,
 ) -> jax.Array:
-    """Single-device reference implementation (the test oracle)."""
+    """Single-device exact attention (the test oracle and the short-sequence
+    production core).  ``key_mask`` [B, T] zeroes attention TO padded keys."""
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
     if causal:
         t = q.shape[1]
         mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :] > 0, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
