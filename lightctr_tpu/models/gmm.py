@@ -48,14 +48,26 @@ def init(key: jax.Array, cluster_cnt: int, feature_cnt: int) -> GMMParams:
 
 
 def init_from_data(key: jax.Array, cluster_cnt: int, x: np.ndarray) -> GMMParams:
-    """Means seeded from random data rows (k-means-style), sigma from the
-    data variance — the robust default."""
-    n = x.shape[0]
-    idx = jax.random.choice(key, n, (cluster_cnt,), replace=cluster_cnt > n)
-    xj = jnp.asarray(x)
+    """Means seeded k-means++-style (each new center drawn proportional to
+    squared distance from the chosen set — avoids two seeds landing in one
+    blob), sigma from the data variance.  The robust default."""
+    xj = jnp.asarray(x, jnp.float32)
+    n = xj.shape[0]
+    keys = jax.random.split(key, cluster_cnt)
+    first = jax.random.randint(keys[0], (), 0, n)
+    centers = [xj[first]]
+    d2 = jnp.sum((xj - centers[0]) ** 2, axis=1)
+    for k in keys[1:]:
+        total = jnp.sum(d2)
+        # all-zero d2 (fewer distinct rows than clusters) -> uniform fallback,
+        # else every surplus seed would collapse onto row 0 and stay dead
+        probs = jnp.where(total > 1e-12, d2 / jnp.maximum(total, 1e-12), 1.0 / n)
+        idx = jax.random.choice(k, n, p=probs)
+        centers.append(xj[idx])
+        d2 = jnp.minimum(d2, jnp.sum((xj - centers[-1]) ** 2, axis=1))
     var = jnp.maximum(jnp.var(xj, axis=0), SIGMA_FLOOR)
     return GMMParams(
-        mu=xj[idx],
+        mu=jnp.stack(centers),
         sigma=jnp.broadcast_to(var, (cluster_cnt, x.shape[1])).copy(),
         weight=jnp.full((cluster_cnt,), 1.0 / cluster_cnt, jnp.float32),
     )
