@@ -181,6 +181,30 @@ def ring_broadcast(mesh: Mesh, stacked_tree, axis: str = "data"):
     return jax.tree_util.tree_map(lambda leaf: fn(leaf.reshape((-1,) + leaf.shape[2:])).reshape(leaf.shape), stacked_tree)
 
 
+def all_to_all_exchange(mesh: Mesh, stacked: jax.Array, axis: str = "data") -> jax.Array:
+    """All-to-all block exchange — the collective under sharded-embedding
+    push/pull (SURVEY.md §2.7: the reference's DHT-routed per-PS key batches
+    become ``all_to_all`` on a mesh axis).
+
+    ``stacked``: [n, n, ...] where slice [i, j] is the block device i holds
+    FOR device j (e.g. the lookup requests i wants shard j to serve).
+    Returns [n, n, ...] where slice [j, i] on device j is what i sent it —
+    i.e. the transpose of the first two axes, moved over the interconnect.
+    """
+    n = mesh.shape[axis]
+    if stacked.ndim < 2 or stacked.shape[0] != n or stacked.shape[1] != n:
+        raise ValueError(
+            f"expected leading dims [{n}, {n}, ...], got {stacked.shape}"
+        )
+
+    def local(x):  # x: [1, n, ...] this device's outgoing blocks
+        # concat on the same axis keeps the received blocks sender-indexed
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=1)
+
+    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    return fn(stacked)
+
+
 def psum_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):
     """The production path: XLA's own all-reduce (lowers to the ICI ring).
     One shard_map over the whole pytree so XLA fuses the reductions."""
