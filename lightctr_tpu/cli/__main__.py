@@ -84,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--vocab-size", type=int, default=5000)
     sp.add_argument("--top-words", type=int, default=10)
 
+    # sequence CTR: lines of "label id id id ..." (behavior sequences)
+    sp = common(sub.add_parser("seqctr"), lr=0.01, batch=64)
+    sp.add_argument("--dim", type=int, default=32)
+    sp.add_argument("--heads", type=int, default=4)
+    sp.add_argument("--layers", type=int, default=2)
+    sp.add_argument("--max-len", type=int, default=128)
+
     # word2vec on raw text (TEST_EMB pipeline: train -> quantize -> cluster)
     sp = common(sub.add_parser("embed"), lr=0.3, batch=256)
     sp.add_argument("--dim", type=int, default=100)
@@ -225,6 +232,65 @@ def main(argv=None) -> int:
         report["cluster_sizes"] = np.bincount(
             gmm.predict(params, raw), minlength=args.clusters
         ).tolist()
+
+    elif args.model == "seqctr":
+        from lightctr_tpu import optim
+        from lightctr_tpu.models import attention_ctr
+        from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+        def parse_seq_file(path, t=None):
+            labels, seqs = [], []
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    labels.append(float(parts[0]))
+                    row = [int(tok) for tok in parts[1:]]
+                    if any(i < 0 for i in row):
+                        raise ValueError(
+                            f"{path}:{lineno}: negative token id "
+                            "(ids must be >= 0)"
+                        )
+                    seqs.append(row)
+            if not seqs:
+                raise ValueError(f"{path}: no sequence rows")
+            if t is None:
+                t = min(args.max_len, max(len(s) for s in seqs))
+            n = len(seqs)
+            ids = np.zeros((n, t), np.int32)
+            seq_mask = np.zeros((n, t), np.float32)
+            for i, s in enumerate(seqs):
+                s = s[:t]
+                ids[i, : len(s)] = s
+                seq_mask[i, : len(s)] = 1.0
+            return {"seq_ids": ids, "seq_mask": seq_mask,
+                    "labels": np.asarray(labels, np.float32)}, t
+
+        batch, t = parse_seq_file(args.data)
+        vocab = int(batch["seq_ids"].max()) + 1
+        params, logits = attention_ctr.build(
+            jax.random.PRNGKey(args.seed), vocab, dim=args.dim,
+            n_heads=args.heads, n_layers=args.layers, max_len=t,
+        )
+        tr = CTRTrainer(params, logits, cfg, optimizer=optim.adam(args.lr))
+        hist = tr.fit(batch, epochs=args.epochs, batch_size=cfg.minibatch_size)
+        report["train"] = tr.evaluate(batch)
+        report["final_loss"] = hist["loss"][-1]
+        report["wall_time_s"] = round(hist["wall_time_s"], 3)
+        report["vocab"] = vocab
+        if args.eval_data:
+            evb, _ = parse_seq_file(args.eval_data, t)
+            # fold held-out ids into the trained vocabulary (hashing trick,
+            # same policy as the libFFM loader)
+            evb["seq_ids"] = (evb["seq_ids"] % vocab).astype(np.int32)
+            report["eval"] = tr.evaluate(evb)
+        if args.ckpt_dir:
+            from lightctr_tpu import ckpt
+
+            report["checkpoint"] = ckpt.save(args.ckpt_dir, args.epochs, {
+                "params": tr.params, "opt_state": tr.opt_state,
+            })
 
     elif args.model == "plsa":
         from lightctr_tpu.data import text as text_lib

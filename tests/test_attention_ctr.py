@@ -47,6 +47,36 @@ def test_learns_sequence_signal(rng):
     assert ev["auc"] > 0.9, ev
 
 
+def test_seqctr_cli(tmp_path):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "seq.txt")
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for _ in range(150):
+            t = rng.integers(5, 15)
+            ids = rng.integers(10, 60, size=t)
+            y = int(rng.random() < 0.5)
+            if y:
+                ids[rng.integers(0, t, 2)] = rng.integers(1, 10, 2)
+            f.write(f"{y} " + " ".join(map(str, ids)) + "\n")
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable, "-m", "lightctr_tpu.cli", "seqctr", "--data", path,
+         "--epochs", "10", "--dim", "16", "--heads", "2", "--batch-size", "32"],
+        capture_output=True, text=True, env=env, timeout=300, cwd=repo_root,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["train"]["auc"] > 0.8, rep
+
+
 def test_rejects_bad_head_count():
     import pytest
 
