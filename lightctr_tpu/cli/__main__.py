@@ -85,11 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--top-words", type=int, default=10)
 
     # sequence CTR: lines of "label id id id ..." (behavior sequences)
-    sp = common(sub.add_parser("seqctr"), lr=0.01, batch=64)
+    sp = scoreable(common(sub.add_parser("seqctr"), lr=0.01, batch=64))
     sp.add_argument("--dim", type=int, default=32)
     sp.add_argument("--heads", type=int, default=4)
     sp.add_argument("--layers", type=int, default=2)
     sp.add_argument("--max-len", type=int, default=128)
+    sp.add_argument("--full-batch", action="store_true")
 
     # word2vec on raw text (TEST_EMB pipeline: train -> quantize -> cluster)
     sp = common(sub.add_parser("embed"), lr=0.3, batch=256)
@@ -245,8 +246,11 @@ def main(argv=None) -> int:
                     parts = line.split()
                     if not parts:
                         continue
-                    labels.append(float(parts[0]))
-                    row = [int(tok) for tok in parts[1:]]
+                    try:
+                        labels.append(float(parts[0]))
+                        row = [int(tok) for tok in parts[1:]]
+                    except ValueError as e:
+                        raise ValueError(f"{path}:{lineno}: {e}") from None
                     if any(i < 0 for i in row):
                         raise ValueError(
                             f"{path}:{lineno}: negative token id "
@@ -257,6 +261,10 @@ def main(argv=None) -> int:
                 raise ValueError(f"{path}: no sequence rows")
             if t is None:
                 t = min(args.max_len, max(len(s) for s in seqs))
+                if t == 0:
+                    raise ValueError(
+                        f"{path}: every row is a bare label (no token ids)"
+                    )
             n = len(seqs)
             ids = np.zeros((n, t), np.int32)
             seq_mask = np.zeros((n, t), np.float32)
@@ -274,11 +282,16 @@ def main(argv=None) -> int:
             n_heads=args.heads, n_layers=args.layers, max_len=t,
         )
         tr = CTRTrainer(params, logits, cfg, optimizer=optim.adam(args.lr))
-        hist = tr.fit(batch, epochs=args.epochs, batch_size=cfg.minibatch_size)
+        hist = tr.fit(
+            batch, epochs=args.epochs,
+            batch_size=None if args.full_batch else cfg.minibatch_size,
+        )
         report["train"] = tr.evaluate(batch)
         report["final_loss"] = hist["loss"][-1]
         report["wall_time_s"] = round(hist["wall_time_s"], 3)
         report["vocab"] = vocab
+        if getattr(args, "dump_scores", None):
+            _dump_scores(args.dump_scores, tr.predict_proba(batch), report)
         if args.eval_data:
             evb, _ = parse_seq_file(args.eval_data, t)
             # fold held-out ids into the trained vocabulary (hashing trick,
