@@ -1,0 +1,28 @@
+#!/bin/bash
+# Run this the moment the TPU answers (docs/STATUS_r1.md priority list).
+# Order: latency bisect -> real-TPU bench -> flash-attention real compile.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/3 step-latency bisect (variants A-F) =="
+python tools/tpu_bisect.py 50 || echo "bisect FAILED"
+
+echo "== 2/3 real-TPU benchmark =="
+python bench.py || echo "bench FAILED"
+
+echo "== 3/3 flash-attention real compile (interpret=False) =="
+python - <<'EOF' || echo "flash compile FAILED"
+import jax, jax.numpy as jnp, numpy as np, time
+from lightctr_tpu.nn.flash_attention import flash_attention
+from lightctr_tpu.nn.ring_attention import full_attention
+rng = np.random.default_rng(0)
+mk = lambda: jnp.asarray(rng.normal(size=(2, 1024, 4, 64)).astype(np.float32))
+q, k, v = mk(), mk(), mk()
+t0 = time.perf_counter()
+out = flash_attention(q, k, v, causal=True)
+jax.block_until_ready(out)
+print(f"flash compile+run: {time.perf_counter()-t0:.1f}s")
+ref = full_attention(q, k, v, causal=True)
+print("max err vs full:", float(jnp.abs(out - ref).max()))
+EOF
+echo "== done =="
