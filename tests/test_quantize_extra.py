@@ -1,0 +1,59 @@
+"""Coverage for untested codec/ensembling corners: log-mode tables, 2-bit
+quantizer, soft voting, custom CDF tables."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu.ops import ensembling, quantize
+
+
+def test_log_mode_concentrates_near_zero(rng):
+    table = quantize.build_table(-1.0, 1.0, bits=8, mode="log")
+    x = jnp.asarray((rng.random(2000) * 2 - 1).astype(np.float32) * 0.01)
+    rec = quantize.extract(table, quantize.compress(table, x))
+    # log-spaced buckets give tiny relative error for tiny magnitudes
+    err = np.abs(np.asarray(rec) - np.asarray(x))
+    assert float(np.mean(err)) < 1e-3
+    # and the table still covers the full range
+    big = jnp.asarray([0.9, -0.9])
+    rec_big = quantize.extract(table, quantize.compress(table, big))
+    np.testing.assert_allclose(np.asarray(rec_big), [0.9, -0.9], rtol=0.2)
+
+
+def test_two_bit_quantizer(rng):
+    x = jnp.asarray(rng.normal(size=(128,)).astype(np.float32))
+    codes, dec = quantize.lowbit_quantize(x, bits=2)
+    assert set(np.unique(np.asarray(codes))) <= {0, 1, 2, 3}
+    # signs preserved, magnitudes snapped to {0.5, 1.5} * mean|x|
+    scale = float(jnp.mean(jnp.abs(x)))
+    mags = np.unique(np.round(np.abs(np.asarray(dec)) / scale, 3))
+    assert set(mags) <= {0.5, 1.5}
+    assert np.all(np.sign(np.asarray(dec)) == np.where(np.asarray(x) > 0, 1, -1))
+
+
+def test_custom_cdf_table_and_validation(rng):
+    edges = jnp.linspace(-2.0, 2.0, 257)
+    table = quantize.build_table(-2.0, 2.0, bits=8, mode="custom", custom_cdf_values=edges)
+    x = jnp.asarray(rng.normal(size=(100,)).astype(np.float32))
+    rec = quantize.extract(table, quantize.compress(table, x))
+    assert float(jnp.max(jnp.abs(rec - jnp.clip(x, -2, 2)))) < 0.02
+    with pytest.raises(ValueError, match="edges"):
+        quantize.build_table(-1, 1, bits=8, mode="custom", custom_cdf_values=jnp.zeros(5))
+    with pytest.raises(ValueError, match="custom mode"):
+        quantize.build_table(-1, 1, bits=8, mode="custom")
+    with pytest.raises(ValueError, match="unknown mode"):
+        quantize.build_table(-1, 1, mode="nope")
+
+
+def test_vote_soft_weighted():
+    probs = jnp.asarray([
+        [[0.9, 0.1], [0.2, 0.8]],   # model 0
+        [[0.4, 0.6], [0.4, 0.6]],   # model 1
+    ])
+    # unweighted: row0 -> class 0 (0.65 avg), row1 -> class 1
+    out = np.asarray(ensembling.vote_soft(probs))
+    np.testing.assert_array_equal(out, [0, 1])
+    # weight model 1 heavily: row0 flips to class 1
+    out_w = np.asarray(ensembling.vote_soft(probs, weights=jnp.asarray([0.1, 2.0])))
+    np.testing.assert_array_equal(out_w, [1, 1])

@@ -3,15 +3,18 @@
 # Order: latency bisect -> real-TPU bench -> flash-attention real compile.
 set -uo pipefail
 cd "$(dirname "$0")/.."
+FAIL=0
 
+# per-step hard timeouts: the relay can wedge AGAIN mid-run (only bench.py
+# carries its own watchdog), and a hung step must not block the sequence
 echo "== 1/3 step-latency bisect (variants A-F) =="
-python tools/tpu_bisect.py 50 || echo "bisect FAILED"
+timeout 900 python tools/tpu_bisect.py 50 || { echo "bisect FAILED"; FAIL=1; }
 
 echo "== 2/3 real-TPU benchmark =="
-python bench.py || echo "bench FAILED"
+timeout 900 python bench.py || { echo "bench FAILED"; FAIL=1; }
 
 echo "== 3/3 flash-attention real compile (interpret=False) =="
-python - <<'EOF' || echo "flash compile FAILED"
+timeout 600 python - <<'EOF' || { echo "flash compile FAILED"; FAIL=1; }
 import jax, jax.numpy as jnp, numpy as np, time
 from lightctr_tpu.nn.flash_attention import flash_attention
 from lightctr_tpu.nn.ring_attention import full_attention
@@ -25,4 +28,5 @@ print(f"flash compile+run: {time.perf_counter()-t0:.1f}s")
 ref = full_attention(q, k, v, causal=True)
 print("max err vs full:", float(jnp.abs(out - ref).max()))
 EOF
-echo "== done =="
+echo "== done (FAIL=$FAIL) =="
+exit $FAIL
