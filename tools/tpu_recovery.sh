@@ -8,13 +8,13 @@ FAIL=0
 # per-step hard timeouts: the relay can wedge AGAIN mid-run (only bench.py
 # carries its own watchdog), and a hung step must not block the sequence
 echo "== 1/3 step-latency bisect (variants A-F) =="
-timeout 900 python tools/tpu_bisect.py 50 || { echo "bisect FAILED"; FAIL=1; }
+timeout -k 30 900 python tools/tpu_bisect.py 50 || { echo "bisect FAILED"; FAIL=1; }
 
 echo "== 2/3 real-TPU benchmark =="
-timeout 900 python bench.py || { echo "bench FAILED"; FAIL=1; }
+timeout -k 30 900 python bench.py || { echo "bench FAILED"; FAIL=1; }
 
 echo "== 3/3 flash-attention real compile (interpret=False) =="
-timeout 600 python - <<'EOF' || { echo "flash compile FAILED"; FAIL=1; }
+timeout -k 30 600 python - <<'EOF' || { echo "flash compile FAILED"; FAIL=1; }
 import jax, jax.numpy as jnp, numpy as np, time
 from lightctr_tpu.nn.flash_attention import flash_attention
 from lightctr_tpu.nn.ring_attention import full_attention
@@ -26,7 +26,9 @@ out = flash_attention(q, k, v, causal=True)
 jax.block_until_ready(out)
 print(f"flash compile+run: {time.perf_counter()-t0:.1f}s")
 ref = full_attention(q, k, v, causal=True)
-print("max err vs full:", float(jnp.abs(out - ref).max()))
+err = float(jnp.abs(out - ref).max())
+print("max err vs full:", err)
+assert err < 2e-2, f"flash kernel numerically diverged: {err}"
 EOF
 echo "== done (FAIL=$FAIL) =="
 exit $FAIL
