@@ -215,6 +215,7 @@ def main(argv=None) -> int:
         model = gbm.GBMModel(gbm.GBMConfig(
             n_trees=args.n_trees, max_depth=args.max_depth,
             n_classes=args.n_classes, seed=args.seed,
+            shrinkage=args.lr,
         ))
         y = ds.labels if args.n_classes > 1 else (ds.labels > 0).astype(np.float32)
         hist = model.fit(ds.features, y)

@@ -77,9 +77,12 @@ class CTRTrainer:
         # own copy: steps donate their input buffers, so the caller's tree
         # must stay untouched (it may seed several trainers)
         self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
-        if mesh is not None:
-            sh = param_shardings if param_shardings is not None else replicated(mesh)
-            self.params = jax.device_put(self.params, sh)
+        self._param_sharding = (
+            param_shardings if param_shardings is not None else
+            (replicated(mesh) if mesh is not None else None)
+        )
+        if self._param_sharding is not None:
+            self.params = jax.device_put(self.params, self._param_sharding)
         self.opt_state = self.tx.init(self.params)  # inherits params' shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
@@ -115,6 +118,15 @@ class CTRTrainer:
         return step
 
     # ------------------------------------------------------------------
+
+    def reset(self, params) -> None:
+        """Reset trainer state to fresh (params, opt_state) while keeping all
+        compiled step/scan caches — repeated benchmark runs from init without
+        re-tracing."""
+        self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
+        if self._param_sharding is not None:
+            self.params = jax.device_put(self.params, self._param_sharding)
+        self.opt_state = self.tx.init(self.params)
 
     def _put(self, batch: Dict[str, np.ndarray]):
         if self.mesh is not None:
@@ -175,13 +187,19 @@ class CTRTrainer:
         self.params, self.opt_state, losses = run(self.params, self.opt_state, batch)
         return np.asarray(losses)
 
-    def compile_fullbatch_scan(self, arrays: Dict[str, np.ndarray], epochs: int) -> None:
-        """AOT-compile the scan (populating the jit cache) without executing —
-        benchmark warm-up that costs compile time only and leaves params
-        untouched."""
+    def warmup_fullbatch_scan(self, arrays: Dict[str, np.ndarray], epochs: int) -> None:
+        """Warm the scan's jit cache without touching trainer state —
+        benchmark warm-up.  NOTE: this EXECUTES one full throwaway scan
+        (``epochs`` steps) on COPIES of (params, opt_state): a compile-only
+        ``lower().compile()`` does not warm ``jax.jit``'s call cache, so a
+        timed first call would still pay a retrace+link (measured ~2s on the
+        axon relay); and the scan donates its argument buffers, hence the
+        copies."""
         batch = self._put(arrays)
         run = self._get_scan_fn(epochs)
-        run.lower(self.params, self.opt_state, batch).compile()
+        copy = partial(jax.tree_util.tree_map, lambda x: jnp.array(x, copy=True))
+        out = run(copy(self.params), copy(self.opt_state), batch)
+        jax.block_until_ready(out)
 
     def _get_scan_fn(self, epochs: int):
         run = self._scan_cache.get(epochs)
@@ -233,7 +251,7 @@ class CTRTrainer:
                     metrics_lib.auc_histogram(probs_j, labels_j.astype(jnp.int32))
                 ),
             }
-        ph = nh = None
+        auc = metrics_lib.StreamingAUC()
         loss_sum = 0.0
         correct = 0.0
         seen = 0
@@ -247,12 +265,10 @@ class CTRTrainer:
             correct += float(
                 jnp.sum((probs_j > 0.5).astype(jnp.int32) == labels_j.astype(jnp.int32))
             )
-            ph, nh = metrics_lib.auc_histogram_update(
-                probs_j, labels_j.astype(jnp.int32), ph, nh
-            )
+            auc.update(probs_j, labels_j.astype(jnp.int32))
             seen += m
         return {
             "logloss": loss_sum / seen,
             "accuracy": correct / seen,
-            "auc": float(metrics_lib.auc_from_histogram(ph, nh)),
+            "auc": auc.result(),
         }

@@ -45,7 +45,7 @@ def field_representatives(
     rep = np.zeros((n, field_cnt), np.int32)
     rep_mask = np.zeros((n, field_cnt), np.float32)
     for j in range(p - 1, -1, -1):
-        valid = (mask[:, j] > 0) & (fields[:, j] < field_cnt)
+        valid = (mask[:, j] > 0) & (fields[:, j] >= 0) & (fields[:, j] < field_cnt)
         rows = np.nonzero(valid)[0]
         f = fields[rows, j]
         rep[rows, f] = fids[rows, j]

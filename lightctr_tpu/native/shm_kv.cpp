@@ -16,6 +16,7 @@
 
 #include <atomic>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <fcntl.h>
 #include <sys/mman.h>
@@ -92,14 +93,22 @@ long find_slot(Store* s, uint64_t key, bool insert) {
 
 extern "C" {
 
-// Create (or truncate) a store file. Returns handle ptr or null.
+// Create a store file. Builds the table in a private temp file and renames
+// it over `path` atomically: a process that still has an old store at the
+// same path mapped keeps its mapping of the old inode alive (no SIGBUS from
+// truncating a file someone else is using).
 void* shmkv_create(const char* path, uint64_t capacity, uint64_t dim) {
-    int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+    char tmp[4096];
+    if (snprintf(tmp, sizeof(tmp), "%s.tmp.%ld", path, (long)getpid())
+        >= (int)sizeof(tmp)) return nullptr;
+    unlink(tmp);  // pid-named: any existing file is OUR stale leftover (crash
+                  // between open and rename, or pid reuse) — safe to clear
+    int fd = open(tmp, O_RDWR | O_CREAT | O_EXCL, 0644);
     if (fd < 0) return nullptr;
     size_t bytes = table_bytes(capacity, dim);
-    if (ftruncate(fd, (off_t)bytes) != 0) { close(fd); return nullptr; }
+    if (ftruncate(fd, (off_t)bytes) != 0) { close(fd); unlink(tmp); return nullptr; }
     void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-    if (mem == MAP_FAILED) { close(fd); return nullptr; }
+    if (mem == MAP_FAILED) { close(fd); unlink(tmp); return nullptr; }
     Store* s = new Store{fd, bytes, reinterpret_cast<Header*>(mem), nullptr, nullptr};
     s->hdr->capacity = capacity;
     s->hdr->dim = dim;
@@ -110,6 +119,9 @@ void* shmkv_create(const char* path, uint64_t capacity, uint64_t dim) {
     // publish the magic LAST (release order): a concurrent shmkv_open must
     // never validate a store whose key table is still uninitialized
     __atomic_store_n(&s->hdr->magic, MAGIC, __ATOMIC_RELEASE);
+    if (rename(tmp, path) != 0) {
+        munmap(mem, bytes); close(fd); unlink(tmp); delete s; return nullptr;
+    }
     return s;
 }
 

@@ -32,6 +32,15 @@ def auc_histogram(scores: jax.Array, labels: jax.Array, num_bins: int = DEFAULT_
 
 
 @partial(jax.jit, static_argnames=("num_bins",))
+def _auc_batch_hist(scores: jax.Array, labels: jax.Array, num_bins: int):
+    scores = scores.reshape(-1)
+    labels = labels.reshape(-1).astype(jnp.int32)
+    idx = jnp.clip((scores * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    pos_b = jax.ops.segment_sum(labels, idx, num_segments=num_bins)
+    neg_b = jax.ops.segment_sum(1 - labels, idx, num_segments=num_bins)
+    return pos_b, neg_b
+
+
 def auc_histogram_update(
     scores: jax.Array,
     labels: jax.Array,
@@ -40,17 +49,66 @@ def auc_histogram_update(
     num_bins: int = DEFAULT_BINS,
 ):
     """Accumulate one batch into (pos, neg) histograms — the streaming form of
-    ``AucEvaluator::init`` (evaluator.h:61-74) for epoch-long evaluation."""
-    scores = scores.reshape(-1)
-    labels = labels.reshape(-1).astype(jnp.int32)
-    idx = jnp.clip((scores * num_bins).astype(jnp.int32), 0, num_bins - 1)
-    pos_b = jax.ops.segment_sum(labels, idx, num_segments=num_bins)
-    neg_b = jax.ops.segment_sum(1 - labels, idx, num_segments=num_bins)
+    ``AucEvaluator::init`` (evaluator.h:61-74).  Device-resident int32; for
+    streams that may exceed 2^31 samples use :class:`StreamingAUC`, which
+    folds into host int64 before int32 can wrap."""
+    pos_b, neg_b = _auc_batch_hist(scores, labels, num_bins)
     if pos_hist is not None:
         pos_b = pos_b + pos_hist
     if neg_hist is not None:
         neg_b = neg_b + neg_hist
     return pos_b, neg_b
+
+
+class StreamingAUC:
+    """Epoch-scale streaming AUC: per-batch binning stays jitted on device in
+    int32 (zero host traffic in the hot loop); the device histograms fold into
+    a host int64 accumulator only when the on-device count could approach
+    int32 overflow (every ~2^30 samples), so Criteo-1TB-scale streams can't
+    silently wrap while small evaluations never pay a mid-stream transfer."""
+
+    _FOLD_AT = 1 << 30
+
+    def __init__(self, num_bins: int = DEFAULT_BINS):
+        self.num_bins = num_bins
+        # host int64 arrays are allocated lazily in _fold: small streams never
+        # pay the 16 MB zero-fill
+        self._host_pos = None
+        self._host_neg = None
+        self._dev_pos = None
+        self._dev_neg = None
+        self._dev_count = 0
+
+    def update(self, scores: jax.Array, labels: jax.Array) -> None:
+        n = scores.size
+        if self._dev_count + n > self._FOLD_AT:
+            self._fold()
+        self._dev_pos, self._dev_neg = auc_histogram_update(
+            scores, labels, self._dev_pos, self._dev_neg, self.num_bins
+        )
+        self._dev_count += n
+
+    def _fold(self) -> None:
+        import numpy as np
+
+        if self._dev_pos is not None:
+            if self._host_pos is None:
+                self._host_pos = np.asarray(self._dev_pos, dtype=np.int64)
+                self._host_neg = np.asarray(self._dev_neg, dtype=np.int64)
+            else:
+                self._host_pos += np.asarray(self._dev_pos, dtype=np.int64)
+                self._host_neg += np.asarray(self._dev_neg, dtype=np.int64)
+        self._dev_pos = self._dev_neg = None
+        self._dev_count = 0
+
+    def result(self) -> float:
+        import numpy as np
+
+        self._fold()
+        if self._host_pos is None:  # no updates at all
+            self._host_pos = np.zeros((self.num_bins,), np.int64)
+            self._host_neg = np.zeros((self.num_bins,), np.int64)
+        return float(auc_from_histogram(self._host_pos, self._host_neg))
 
 
 def auc_from_histogram(pos_hist: jax.Array, neg_hist: jax.Array) -> jax.Array:

@@ -25,21 +25,24 @@ DEFAULT_TIMEOUT_S = 180
 # per-process cache (deliberately NOT an env var: children must re-probe —
 # the relay may wedge between a parent's probe and a child's first jax use)
 _checked: Optional[bool] = None
+_device_count: Optional[int] = None
 
 
-def _probe_in_child() -> bool:
+def _probe_in_child() -> int:
+    """Device count of the default backend, probed in a forked child with a
+    hard timeout (the parent's backend stays uninitialized).  0 = dead/wedged
+    backend; counts are capped at 120 to fit an exit code."""
     pid = os.fork()
     if pid == 0:
         # child: every exit path must end in os._exit — escaping the fork
         # branch would run the caller's module body in a second process
-        code = 1
+        code = 0
         try:
             import jax
 
-            jax.devices()
-            code = 0
+            code = min(len(jax.devices()), 120)
         except BaseException:
-            code = 1
+            code = 0
         finally:
             os._exit(code)
     deadline = time.time() + float(
@@ -48,11 +51,23 @@ def _probe_in_child() -> bool:
     while time.time() < deadline:
         done, status = os.waitpid(pid, os.WNOHANG)
         if done:
-            return os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0
+            return os.WEXITSTATUS(status) if os.WIFEXITED(status) else 0
         time.sleep(1.0)
     os.kill(pid, signal.SIGKILL)
     os.waitpid(pid, 0)
-    return False
+    return 0
+
+
+def probe_device_count() -> int:
+    """Public form of the fork-probe: how many devices the default backend
+    exposes, without initializing this process's backend.  Honors the
+    LIGHTCTR_DEVICE_TIMEOUT_S override; 0 means dead/wedged.  Cached per
+    process (shared with ensure_live_backend) so startup forks at most one
+    probe child."""
+    global _device_count
+    if _device_count is None:
+        _device_count = _probe_in_child()
+    return _device_count
 
 
 def _force_cpu() -> None:
@@ -81,7 +96,9 @@ def ensure_live_backend(announce: bool = True, force_cpu: bool = False) -> bool:
         # fork + cold jax import (halves startup of CPU-pinned runs)
         _checked = True
         return True
-    alive = _probe_in_child()
+    global _device_count
+    _device_count = _probe_in_child()
+    alive = _device_count > 0
     _checked = alive
     if not alive:
         if announce:

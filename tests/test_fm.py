@@ -100,3 +100,53 @@ def test_fm_data_parallel_matches_single():
     l8 = jax.tree_util.tree_leaves(tr8.params)
     for a, b in zip(l1, l8):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_fm_dense_formulation_parity(rng):
+    # dense matmul path == sparse gather path: logits, L2, and gradients,
+    # including a row with a REPEATED fid (per-slot x2/cnt accumulation)
+    f, k, n, p = 60, 4, 16, 5
+    params = fm.init(jax.random.PRNGKey(1), f, k)
+    fids = rng.integers(0, f, size=(n, p)).astype(np.int32)
+    fids[0, 1] = fids[0, 0]  # duplicate fid within a row
+    vals = rng.normal(size=(n, p)).astype(np.float32)
+    mask = (rng.random((n, p)) > 0.2).astype(np.float32)
+    labels = (rng.random(n) > 0.5).astype(np.float32)
+    sparse = {
+        "fids": fids,
+        "fields": np.zeros_like(fids),
+        "vals": vals,
+        "mask": mask,
+        "labels": labels,
+    }
+    dense = fm.densify(sparse, f)
+
+    z_s, l2_s = fm.logits_with_l2(params, {k_: jnp.asarray(v) for k_, v in sparse.items()})
+    z_d, l2_d = fm.dense_logits_with_l2(params, {k_: jnp.asarray(v) for k_, v in dense.items()})
+    np.testing.assert_allclose(np.asarray(z_s), np.asarray(z_d), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(l2_s), float(l2_d), rtol=1e-5)
+
+    from lightctr_tpu.ops import losses as L
+
+    def loss_sparse(pr):
+        z, l2 = fm.logits_with_l2(pr, {k_: jnp.asarray(v) for k_, v in sparse.items()})
+        return L.logistic_loss(z, jnp.asarray(labels), reduction="mean") + 0.01 * l2
+
+    def loss_dense(pr):
+        z, l2 = fm.dense_logits_with_l2(pr, {k_: jnp.asarray(v) for k_, v in dense.items()})
+        return L.logistic_loss(z, jnp.asarray(labels), reduction="mean") + 0.01 * l2
+
+    g_s = jax.grad(loss_sparse)(params)
+    g_d = jax.grad(loss_dense)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_s), jax.tree_util.tree_leaves(g_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_fm_dense_trainer_converges():
+    arrays, f = synthetic_sparse(n=128)
+    dense = fm.densify(arrays, f)
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    params = fm.init(jax.random.PRNGKey(0), f, 4)
+    tr = CTRTrainer(params, fm.dense_logits, cfg, fused_fn=fm.dense_logits_with_l2)
+    losses = tr.fit_fullbatch_scan(dense, epochs=40)
+    assert losses[-1] < losses[0] * 0.9
