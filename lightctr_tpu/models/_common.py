@@ -2,10 +2,20 @@
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.core.config import TrainConfig
+
+
+def tree_copy(tree):
+    """Deep-copy a param/opt-state pytree onto fresh device buffers.  The
+    trainers donate their (params, opt_state) arguments to jitted steps, so
+    any tree that outlives a step — a caller's init tree, a warm-up's
+    throwaway state — must be copied first."""
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
 
 
 def default_dl_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:

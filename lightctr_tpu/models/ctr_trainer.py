@@ -28,6 +28,7 @@ from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.core.config import TrainConfig
 from lightctr_tpu.core.mesh import replicated, shard_batch
 from lightctr_tpu.data.batching import minibatches
+from lightctr_tpu.models._common import tree_copy
 from lightctr_tpu.ops import losses as losses_lib
 from lightctr_tpu.ops import metrics as metrics_lib
 from lightctr_tpu.ops.activations import sigmoid
@@ -76,7 +77,7 @@ class CTRTrainer:
             raise ValueError("param_shardings requires a mesh")
         # own copy: steps donate their input buffers, so the caller's tree
         # must stay untouched (it may seed several trainers)
-        self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
+        self.params = tree_copy(params)
         self._param_sharding = (
             param_shardings if param_shardings is not None else
             (replicated(mesh) if mesh is not None else None)
@@ -123,7 +124,7 @@ class CTRTrainer:
         """Reset trainer state to fresh (params, opt_state) while keeping all
         compiled step/scan caches — repeated benchmark runs from init without
         re-tracing."""
-        self.params = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params)
+        self.params = tree_copy(params)
         if self._param_sharding is not None:
             self.params = jax.device_put(self.params, self._param_sharding)
         self.opt_state = self.tx.init(self.params)
@@ -197,8 +198,7 @@ class CTRTrainer:
         copies."""
         batch = self._put(arrays)
         run = self._get_scan_fn(epochs)
-        copy = partial(jax.tree_util.tree_map, lambda x: jnp.array(x, copy=True))
-        out = run(copy(self.params), copy(self.opt_state), batch)
+        out = run(tree_copy(self.params), tree_copy(self.opt_state), batch)
         jax.block_until_ready(out)
 
     def _get_scan_fn(self, epochs: int):

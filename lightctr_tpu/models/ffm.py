@@ -79,3 +79,94 @@ def l2_penalty(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]) -> jax
     w = jnp.take(params["w"], batch["fids"], axis=0)
     v = jnp.take(params["v"], batch["fids"], axis=0)
     return 0.5 * (jnp.sum(w * w * mask) + jnp.sum(v * v * mask[..., None, None]))
+
+
+def densify(arrays: Dict, feature_cnt: int, field_cnt: int):
+    """Host-side one-time densification for full-batch FFM training on a
+    compacted vocabulary (the FFM analogue of ``fm.densify``).
+
+    Requires each fid to map to exactly ONE field (true of libFFM data, where
+    the field is a property of the feature).  Features are permuted so fields
+    are contiguous; the returned ``perm`` maps dense position -> original fid,
+    and ``field_slices`` gives each field's [start, end) column block.  The
+    caller must permute params to match (``w[perm]``, ``v[perm]``).
+
+    Returns ``(dense_batch, perm, field_slices)``.
+    """
+    import numpy as np
+
+    fids = np.asarray(arrays["fids"])
+    fields = np.asarray(arrays["fields"])
+    vals = np.asarray(arrays["vals"]) * np.asarray(arrays["mask"])
+    mask = np.asarray(arrays["mask"]) > 0
+    if mask.any():
+        lo, hi = fids[mask].min(), fids[mask].max()
+        if lo < 0 or hi >= feature_cnt:
+            raise ValueError(f"fid out of range [{lo}, {hi}] for feature_cnt={feature_cnt}")
+        flo, fhi = fields[mask].min(), fields[mask].max()
+        if flo < 0 or fhi >= field_cnt:
+            raise ValueError(
+                f"field out of range [{flo}, {fhi}] for field_cnt={field_cnt}; "
+                "the dense path would silently misplace the feature's block"
+            )
+
+    # field of each feature (must be unique per fid)
+    feat_field = np.full((feature_cnt,), -1, np.int64)
+    f_flat, fl_flat = fids[mask], fields[mask]
+    feat_field[f_flat] = fl_flat
+    if not (feat_field[f_flat] == fl_flat).all():
+        raise ValueError("a fid appears under two different fields; dense FFM "
+                         "requires field-unique features (libFFM semantics)")
+    feat_field[feat_field < 0] = field_cnt - 1  # untouched features: any block
+
+    perm = np.argsort(feat_field, kind="stable").astype(np.int64)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(feature_cnt)
+    sorted_fields = feat_field[perm]
+    starts = np.searchsorted(sorted_fields, np.arange(field_cnt))
+    ends = np.searchsorted(sorted_fields, np.arange(field_cnt), side="right")
+    field_slices = tuple((int(s), int(e)) for s, e in zip(starts, ends))
+
+    n, p = fids.shape
+    x = np.zeros((n, feature_cnt), np.float32)
+    x2 = np.zeros((n, feature_cnt), np.float32)
+    cnt = np.zeros((feature_cnt,), np.float32)
+    rows = np.broadcast_to(np.arange(n)[:, None], (n, p))
+    cols = inv[fids]
+    np.add.at(x, (rows[mask], cols[mask]), vals[mask])
+    np.add.at(x2, (rows[mask], cols[mask]), vals[mask] ** 2)
+    np.add.at(cnt, cols[mask], 1.0)
+    dense_batch = {"x": x, "x2": x2, "cnt": cnt, "labels": np.asarray(arrays["labels"])}
+    return dense_batch, perm, field_slices
+
+
+def make_dense_logits(field_slices):
+    """Build the fused (logits, l2) function for a given static field layout.
+
+    cross-term G[b,f,g,:] = X_f @ V_f[:,g,:] — one [B,F_f]x[F_f,Fl*k] MXU
+    matmul per field block; the backward is the transposed matmuls (no
+    scatter).  Self-pair and L2 terms from x2/cnt as in the sparse path."""
+    def dense_logits_with_l2(params: Dict[str, jax.Array], batch: Dict[str, jax.Array]):
+        w, v = params["w"], params["v"]
+        feature_cnt, field_cnt, k = v.shape
+        x, x2, cnt = batch["x"], batch["x2"], batch["cnt"]
+        linear = x @ w
+        g_blocks = []
+        diag = jnp.zeros(x.shape[0], v.dtype)
+        for f, (s, e) in enumerate(field_slices):
+            if e <= s:
+                g_blocks.append(
+                    jnp.zeros((x.shape[0], field_cnt * k), v.dtype)
+                )
+                continue
+            vb = v[s:e]                                        # [F_f, Fl, k]
+            g_blocks.append(x[:, s:e] @ vb.reshape(e - s, field_cnt * k))
+            diag = diag + x2[:, s:e] @ jnp.sum(vb[:, f, :] ** 2, -1)
+        g = jnp.stack(g_blocks, axis=1).reshape(
+            x.shape[0], len(field_slices), field_cnt, k
+        )
+        cross = jnp.einsum("bfgk,bgfk->b", g, g)
+        l2 = 0.5 * (cnt @ (w * w) + cnt @ jnp.sum(v * v, axis=(1, 2)))
+        return linear + 0.5 * (cross - diag), l2
+
+    return dense_logits_with_l2

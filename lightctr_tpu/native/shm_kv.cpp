@@ -98,11 +98,15 @@ extern "C" {
 // same path mapped keeps its mapping of the old inode alive (no SIGBUS from
 // truncating a file someone else is using).
 void* shmkv_create(const char* path, uint64_t capacity, uint64_t dim) {
+    static std::atomic<unsigned long> create_seq{0};
     char tmp[4096];
-    if (snprintf(tmp, sizeof(tmp), "%s.tmp.%ld", path, (long)getpid())
+    // pid + per-process sequence: unique across processes AND across threads
+    // of one process, so the unlink below can only ever clear a stale
+    // leftover of a crashed earlier incarnation (never a live sibling's file)
+    if (snprintf(tmp, sizeof(tmp), "%s.tmp.%ld.%lu", path, (long)getpid(),
+                 create_seq.fetch_add(1, std::memory_order_relaxed))
         >= (int)sizeof(tmp)) return nullptr;
-    unlink(tmp);  // pid-named: any existing file is OUR stale leftover (crash
-                  // between open and rename, or pid reuse) — safe to clear
+    unlink(tmp);
     int fd = open(tmp, O_RDWR | O_CREAT | O_EXCL, 0644);
     if (fd < 0) return nullptr;
     size_t bytes = table_bytes(capacity, dim);

@@ -92,3 +92,45 @@ def test_nfm_structure_and_training(rng):
     tr = CTRTrainer(params, nfm.logits, TrainConfig(learning_rate=0.1), l2_fn=nfm.l2_penalty)
     hist = tr.fit(batch, epochs=40, batch_size=32)
     assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_ffm_dense_formulation_parity():
+    import numpy as np
+    from lightctr_tpu.models import ffm
+
+    rng = np.random.default_rng(3)
+    F, Fl, k, n, p = 40, 5, 3, 12, 6
+    # each fid belongs to exactly one field (libFFM semantics)
+    feat_field = rng.integers(0, Fl, size=F)
+    fids = rng.integers(0, F, size=(n, p)).astype(np.int32)
+    fields = feat_field[fids].astype(np.int32)
+    vals = rng.normal(size=(n, p)).astype(np.float32)
+    mask = (rng.random((n, p)) > 0.25).astype(np.float32)
+    labels = (rng.random(n) > 0.5).astype(np.float32)
+    sparse = {"fids": fids, "fields": fields, "vals": vals, "mask": mask, "labels": labels}
+
+    params = ffm.init(jax.random.PRNGKey(0), F, Fl, k)
+    z_s, l2_s = ffm.logits_with_l2(params, {k_: jnp.asarray(v) for k_, v in sparse.items()})
+
+    dense, perm, slices = ffm.densify(sparse, F, Fl)
+    params_p = {"w": params["w"][perm], "v": params["v"][perm]}
+    fused = ffm.make_dense_logits(slices)
+    z_d, l2_d = fused(params_p, {k_: jnp.asarray(v) for k_, v in dense.items()})
+    np.testing.assert_allclose(np.asarray(z_s), np.asarray(z_d), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(l2_s), float(l2_d), rtol=1e-5)
+
+    # gradients agree too (in permuted space)
+    from lightctr_tpu.ops import losses as L
+
+    def loss_sparse(pr):
+        z, l2 = ffm.logits_with_l2(pr, {k_: jnp.asarray(v) for k_, v in sparse.items()})
+        return L.logistic_loss(z, jnp.asarray(labels), reduction="mean") + 0.01 * l2
+
+    def loss_dense(pr):
+        z, l2 = fused(pr, {k_: jnp.asarray(v) for k_, v in dense.items()})
+        return L.logistic_loss(z, jnp.asarray(labels), reduction="mean") + 0.01 * l2
+
+    g_s = jax.grad(loss_sparse)(params)
+    g_d = jax.grad(loss_dense)(params_p)
+    np.testing.assert_allclose(np.asarray(g_s["w"])[perm], np.asarray(g_d["w"]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_s["v"])[perm], np.asarray(g_d["v"]), rtol=1e-4, atol=1e-5)
