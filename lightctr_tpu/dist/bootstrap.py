@@ -76,17 +76,52 @@ class HeartbeatMonitor:
         dead_after_s: float = DEAD_AFTER_S,
         period_s: float = HEARTBEAT_PERIOD_S,
         clock: Callable[[], float] = time.monotonic,
+        on_recover: Optional[Callable[[str], None]] = None,
     ):
         self._last: Dict[str, float] = {}
         self._dead: set = set()
-        self._on_dead = on_dead
+        self._listeners: list = []  # (on_dead, on_recover) pairs
+        if on_dead is not None or on_recover is not None:
+            self._listeners.append((on_dead, on_recover))
         self.stale_after_s = stale_after_s
         self.dead_after_s = dead_after_s
         self.period_s = period_s
         self._clock = clock
         self._lock = threading.Lock()
+        # liveness transitions append ("dead"|"recover", worker) events under
+        # _lock; callbacks drain the queue under _dispatch_lock OUTSIDE _lock
+        # (they may call back into the monitor).  The single ordered queue
+        # makes callback order match the _dead-set transition order, so a
+        # beat racing a death sweep can never leave a live worker unrouted.
+        self._events: list = []
+        # RLock: a callback may call beat()/check(), whose _dispatch
+        # re-enters on the same thread
+        self._dispatch_lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def add_listener(
+        self,
+        on_dead: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        """Register death/recovery callbacks (the public wiring point for
+        consumers like AsyncParamServer.attach_heartbeat)."""
+        with self._lock:
+            self._listeners.append((on_dead, on_recover))
+
+    def _dispatch(self) -> None:
+        while True:
+            with self._dispatch_lock:
+                with self._lock:
+                    if not self._events:
+                        return
+                    kind, worker = self._events.pop(0)
+                    listeners = list(self._listeners)
+                for on_dead, on_recover in listeners:
+                    cb = on_dead if kind == "dead" else on_recover
+                    if cb is not None:
+                        cb(worker)
 
     def beat(self, worker: str) -> None:
         with self._lock:
@@ -95,12 +130,13 @@ class HeartbeatMonitor:
                 # re-registration of a returning node is tolerated
                 # (master.h:80-82)
                 self._dead.discard(worker)
+                self._events.append(("recover", worker))
+        self._dispatch()
 
     def check(self) -> Dict[str, str]:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
         now = self._clock()
         out = {}
-        newly_dead = []
         with self._lock:
             for w, t in self._last.items():
                 age = now - t
@@ -108,20 +144,12 @@ class HeartbeatMonitor:
                     out[w] = "dead"
                     if w not in self._dead:
                         self._dead.add(w)
-                        newly_dead.append(w)
+                        self._events.append(("dead", w))
                 elif age >= self.stale_after_s:
                     out[w] = "stale"
                 else:
                     out[w] = "alive"
-        # callbacks run OUTSIDE the lock (on_dead may call beat()/check();
-        # the lock is not reentrant) — but a worker that re-registered in the
-        # window between the sweep and here is alive again, so re-check
-        if self._on_dead:
-            for w in newly_dead:
-                with self._lock:
-                    still_dead = w in self._dead
-                if still_dead:
-                    self._on_dead(w)
+        self._dispatch()
         return out
 
     def start(self) -> None:

@@ -70,6 +70,12 @@ class AsyncParamServer:
         self.staleness_worker: Optional[int] = None
         self.dropped_pushes = 0
         self.withheld_pulls = 0
+        # unrouted workers (heartbeat-declared dead, master.h:202-262: the
+        # master deletes the dead node's router; here that means its traffic
+        # is rejected until it re-registers)
+        self._unrouted: set = set()
+        self.rejected_pushes = 0
+        self.rejected_pulls = 0
 
     # -- storage -----------------------------------------------------------
 
@@ -87,10 +93,21 @@ class AsyncParamServer:
 
     # -- protocol ----------------------------------------------------------
 
-    def pull(self, keys, worker_epoch: int) -> Optional[Dict[int, np.ndarray]]:
+    def pull(
+        self, keys, worker_epoch: int, worker_id: Optional[int] = None
+    ) -> Optional[Dict[int, np.ndarray]]:
         """Returns key->value, or None when SSP-withheld (the worker should
-        sleep and retry, pull.h:63-67)."""
+        sleep and retry, pull.h:63-67) or when the worker is unrouted
+        (heartbeat-dead: no route exists until it re-registers).
+
+        Routing enforcement needs the caller's identity: pass ``worker_id``
+        (the reference's pull is implicitly identified by the sender's node
+        id on its connection; this API models that only when told who is
+        asking).  Anonymous pulls skip the route check."""
         with self._lock:
+            if worker_id is not None and worker_id in self._unrouted:
+                self.rejected_pulls += 1
+                return None
             if (
                 worker_epoch > self.last_epoch_version
                 and self.staleness > self.staleness_threshold
@@ -101,9 +118,13 @@ class AsyncParamServer:
 
     def push(self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int) -> bool:
         """Apply per-key grads; returns False when dropped as too stale
-        (paramserver.h:201-205).  Grads are batch-summed; they are divided by
-        the minibatch size by the caller (we take pre-averaged grads)."""
+        (paramserver.h:201-205) or when the worker is unrouted (heartbeat
+        declared it dead).  Grads are batch-summed; they are divided by the
+        minibatch size by the caller (we take pre-averaged grads)."""
         with self._lock:
+            if worker_id in self._unrouted:
+                self.rejected_pushes += 1
+                return False
             # staleness ledger (paramserver.h:189-200)
             behind = self.last_epoch_version - worker_epoch
             if self.staleness > 0 and worker_id == self.staleness_worker:
@@ -145,6 +166,45 @@ class AsyncParamServer:
                     w -= self.lr * comp
                     self._shadow[key][worker_id] = w.copy()
             return True
+
+    # -- liveness routing (master.h:202-262 / network.h:148-151) ------------
+
+    def unroute_worker(self, worker_id: int) -> None:
+        """Heartbeat declared the worker dead: delete its route.  Its pushes
+        and pulls are rejected until :meth:`readmit_worker`."""
+        with self._lock:
+            self._unrouted.add(int(worker_id))
+
+    def readmit_worker(self, worker_id: int) -> None:
+        """Returning node re-registered (master.h:80-82): restore its route.
+        Per-worker DCASGD shadow state was kept, exactly as the PS keeps
+        shadow_copies across re-registration."""
+        with self._lock:
+            self._unrouted.discard(int(worker_id))
+
+    def attach_heartbeat(self, monitor) -> None:
+        """Wire a :class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` so
+        its death/recovery events drive routing: dead -> unroute, returning
+        beat -> readmit.  PS workers beat with ``str(worker_id)``; names that
+        are not integers belong to other components and are ignored here."""
+
+        def to_wid(w):
+            try:
+                return int(w)
+            except (TypeError, ValueError):
+                return None
+
+        def on_dead(w):
+            wid = to_wid(w)
+            if wid is not None:
+                self.unroute_worker(wid)
+
+        def on_recover(w):
+            wid = to_wid(w)
+            if wid is not None:
+                self.readmit_worker(wid)
+
+        monitor.add_listener(on_dead=on_dead, on_recover=on_recover)
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:

@@ -73,10 +73,27 @@ def dedup_grads(
     return uids, summed, valid
 
 
-def sparse_sgd_update(
-    table: jax.Array, ids: jax.Array, grads: jax.Array, lr: float
+def filter_preferred_grads(
+    grads: jax.Array, tiny: float = 1e-7, huge: float = 15.0
 ) -> jax.Array:
-    """PS simple-SGD branch (paramserver.h:296-300)."""
+    """Worker-side pre-wire gradient filter (``checkPreferredValue``,
+    push.h:61-63 / distributed_algo_abst.h:76-79): values that are ~0 carry
+    no information ("obsolete feature") and exploded values are dropped for
+    robustness.  Dropping = zeroing here — a zero grad is a no-op update, the
+    static-shape equivalent of omitting the key from the push."""
+    a = jnp.abs(grads)
+    keep = (a > tiny) & (a < huge)
+    return grads * keep.astype(grads.dtype)
+
+
+def sparse_sgd_update(
+    table: jax.Array, ids: jax.Array, grads: jax.Array, lr: float,
+    filter_grads: bool = False,
+) -> jax.Array:
+    """PS simple-SGD branch (paramserver.h:296-300).  ``filter_grads``
+    applies the push-side ``checkPreferredValue`` filter first."""
+    if filter_grads:
+        grads = filter_preferred_grads(grads)
     uids, g, valid = dedup_grads(ids, grads)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     return table.at[uids].add(-lr * g * _bcast(valid, g))
@@ -97,9 +114,12 @@ def sparse_adagrad_update(
     grads: jax.Array,
     lr: float,
     eps: float = 1e-7,
+    filter_grads: bool = False,
 ) -> Tuple[jax.Array, SparseAdagradState]:
     """PS Adagrad branch (paramserver.h:287-295), touched rows only:
     accum[k] += g^2 ; w[k] -= lr * g / sqrt(accum[k] + eps)."""
+    if filter_grads:
+        grads = filter_preferred_grads(grads)
     uids, g, valid = dedup_grads(ids, grads)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     vmask = _bcast(valid, g)
@@ -127,10 +147,13 @@ def sparse_dcasgd_update(
     grads: jax.Array,
     lr: float,
     dcasgd_lambda: float = 0.1,
+    filter_grads: bool = False,
 ) -> Tuple[jax.Array, SparseDCASGDState]:
     """PS DCASGD branch (paramserver.h:252-268):
     g' = g + lambda * g^2 * (w_cur - shadow[worker]);
     w -= lr * g'; shadow[worker] <- w_new."""
+    if filter_grads:
+        grads = filter_preferred_grads(grads)
     uids, g, valid = dedup_grads(ids, grads)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     vmask = _bcast(valid, g)

@@ -81,6 +81,23 @@ def activation(a: int, name: str) -> Node:
     return Node(kind="op", name=f"act:{name}", inputs=[a], fn=fn)
 
 
+def aggregate(inputs: List[int], fn: Callable, name: str = "aggregate") -> Node:
+    """N-in/M-out op (``AggregateNode``, dag/aggregate_node.h:15-29): ``fn``
+    takes the N input arrays and returns a TUPLE of M arrays.  The node's
+    value is the tuple; consume individual outputs through :func:`project`.
+    Single-execution semantics hold — the tuple is computed once and fanned
+    out to all consumers (the promise-array dance of node_abst.h:103-107)."""
+    return Node(kind="op", name=f"agg:{name}", inputs=list(inputs), fn=fn)
+
+
+def project(a: int, index: int) -> Node:
+    """Select output ``index`` of an aggregate node."""
+    return Node(
+        kind="op", name=f"project:{index}", inputs=[a],
+        fn=lambda t, _i=index: t[_i],
+    )
+
+
 def logistic_loss_node(pred: int, label_name: str = "label") -> Node:
     """LossOp<Logistic> terminus (dag/operator/loss_op.h:29-50).  The node's
     input is a *probability* (like the reference's sigmoid -> loss pairing);

@@ -54,6 +54,16 @@ class CTRTrainer:
     param_shardings: optional pytree of NamedSharding matching ``params`` —
         e.g. embedding tables row-sharded over the ``embed`` axis (the PS
         layout); optimizer state inherits the same shardings.
+    compress_bits: when set (8 or 16) with a mesh, the data-parallel gradient
+        exchange runs as an explicit ring all-reduce whose every hop is
+        quantile-compressed to that width — the production wiring of the
+        reference's compress-all-wire-traffic policy (fp16 on every PS value,
+        paramserver.h:161-163; int8 QuantileCompress, README.md:60).  The
+        optimizer then applies the identical decoded mean gradient on every
+        replica.
+    compress_range: symmetric quantization range; must bound a single
+        device's gradient magnitudes (inputs are pre-divided by the ring size
+        so partial sums stay inside it).
     """
 
     def __init__(
@@ -66,6 +76,8 @@ class CTRTrainer:
         mesh=None,
         fused_fn: Optional[Callable] = None,
         param_shardings=None,
+        compress_bits: Optional[int] = None,
+        compress_range: float = 1.0,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -73,8 +85,17 @@ class CTRTrainer:
         self.fused_fn = fused_fn
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh
+        self.compress_bits = compress_bits
+        self.compress_range = compress_range
         if param_shardings is not None and mesh is None:
             raise ValueError("param_shardings requires a mesh")
+        if compress_bits is not None:
+            if mesh is None:
+                raise ValueError("compress_bits requires a mesh (it compresses "
+                                 "the cross-device gradient exchange)")
+            if param_shardings is not None:
+                raise ValueError("compress_bits assumes replicated params "
+                                 "(ring-exchanged data-parallel gradients)")
         # own copy: steps donate their input buffers, so the caller's tree
         # must stay untouched (it may seed several trainers)
         self.params = tree_copy(params)
@@ -87,16 +108,22 @@ class CTRTrainer:
         self.opt_state = self.tx.init(self.params)  # inherits params' shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
-        self._step = jax.jit(self._make_step(), donate_argnums=(0, 1))
+        self._step = jax.jit(self._build_step(), donate_argnums=(0, 1))
         self._logits_j = jax.jit(self.logits_fn)
         self._scan_cache: Dict[int, Callable] = {}
 
-    def _make_step(self):
+    def _build_step(self):
+        """The training step: plain (XLA inserts psum for sharded batches) or
+        compressed-ring data-parallel when ``compress_bits`` is set."""
+        if self.compress_bits is not None:
+            return self._make_compressed_step()
+        return self._make_step()
+
+    def _make_loss_fn(self):
         lambda_l2 = self.cfg.lambda_l2
         l2_fn = self.l2_fn
         logits_fn = self.logits_fn
         fused_fn = self.fused_fn
-        tx = self.tx
 
         def loss_fn(params, batch):
             if fused_fn is not None:
@@ -110,6 +137,12 @@ class CTRTrainer:
                 loss = loss + lambda_l2 * l2
             return loss / n
 
+        return loss_fn
+
+    def _make_step(self):
+        loss_fn = self._make_loss_fn()
+        tx = self.tx
+
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -117,6 +150,52 @@ class CTRTrainer:
             return params, opt_state, loss
 
         return step
+
+    def _make_compressed_step(self):
+        """Data-parallel step whose gradient exchange is an explicit ring
+        all-reduce with a quantile codec on every hop (wire-compressed
+        training, the reference's production policy — paramserver.h:161-163,
+        README.md:60).  Per-device grads are computed under shard_map, the
+        flattened tree rides the compressed ring (dist/collectives.py), and
+        every replica applies the identical decoded mean."""
+        from jax.flatten_util import ravel_pytree
+        from jax.sharding import PartitionSpec as P
+
+        from lightctr_tpu.dist.collectives import _ring_all_reduce_local
+
+        loss_fn = self._make_loss_fn()
+        tx = self.tx
+        mesh = self.mesh
+        n = mesh.shape["data"]
+        bits = self.compress_bits
+        crange = self.compress_range
+
+        def local_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            flat, unravel = ravel_pytree(grads)
+            length = flat.shape[0]
+            padded = ((length + n - 1) // n) * n
+            if padded != length:
+                flat = jnp.pad(flat, (0, padded - length))
+            flat = _ring_all_reduce_local(
+                flat, "data", n, average=True,
+                compress_bits=bits, compress_range=crange,
+            )
+            grads = unravel(flat[:length])
+            loss = jax.lax.pmean(loss, "data")
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optim_lib.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        from jax import shard_map
+
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
 
     # ------------------------------------------------------------------
 
@@ -204,7 +283,7 @@ class CTRTrainer:
     def _get_scan_fn(self, epochs: int):
         run = self._scan_cache.get(epochs)
         if run is None:
-            step = self._make_step()
+            step = self._build_step()
 
             def body_fn(batch):
                 def body(carry, _):

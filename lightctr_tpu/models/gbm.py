@@ -118,27 +118,58 @@ def build_tree(
     g = grad * row_mask
     h = hess * row_mask
 
+    prev_hist_g = prev_hist_h = None   # previous level's histograms
+    prev_split = None                  # previous level's do_split mask
+
+    def _level_hist(stat, seg_idx, mask, n_groups):
+        rep = jnp.broadcast_to(stat[:, None] * mask, (n, f)).reshape(-1)
+        return jax.ops.segment_sum(
+            rep, seg_idx, num_segments=n_groups * f * n_slots
+        ).reshape(n_groups, f, n_slots)
+
     for depth in range(max_depth):
         level_size = 1 << depth
         offset = level_size - 1
         local = node_of_row - offset                           # [-., 0..level)
         active = (local >= 0) & (local < level_size)
-        # (node, feature, bin) histograms via one segment_sum per statistic
-        flat = (
-            jnp.where(active, local, 0)[:, None] * (f * n_slots)
-            + jnp.arange(f)[None, :] * n_slots
-            + bins
-        )                                                       # [N, F]
-        seg = flat.reshape(-1)
-        amask = active.astype(g.dtype)[:, None]
-        g_rep = jnp.broadcast_to(g[:, None] * amask, (n, f)).reshape(-1)
-        h_rep = jnp.broadcast_to(h[:, None] * amask, (n, f)).reshape(-1)
-        hist_g = jax.ops.segment_sum(
-            g_rep, seg, num_segments=level_size * f * n_slots
-        ).reshape(level_size, f, n_slots)
-        hist_h = jax.ops.segment_sum(
-            h_rep, seg, num_segments=level_size * f * n_slots
-        ).reshape(level_size, f, n_slots)
+        if depth == 0:
+            # root histogram: the only full scatter over all rows
+            flat = (
+                jnp.where(active, local, 0)[:, None] * (f * n_slots)
+                + jnp.arange(f)[None, :] * n_slots
+                + bins
+            ).reshape(-1)
+            amask = active.astype(g.dtype)[:, None]
+            hist_g = _level_hist(g, flat, amask, 1)
+            hist_h = _level_hist(h, flat, amask, 1)
+        else:
+            # sibling subtraction (the histogram replacement for the
+            # reference's bidirectional sorted scans, train_gbm_algo.cpp:
+            # 215-322): scatter ONLY the left children — local index 2p —
+            # then derive each right child as parent minus left.  Halves the
+            # level's scatter output; a parent that became a leaf routed no
+            # rows onward, so its children read as zero (mask by prev_split).
+            half = level_size // 2
+            is_left = active & (local % 2 == 0)
+            pidx = jnp.where(is_left, local // 2, 0)
+            flat = (
+                pidx[:, None] * (f * n_slots)
+                + jnp.arange(f)[None, :] * n_slots
+                + bins
+            ).reshape(-1)
+            lmask = is_left.astype(g.dtype)[:, None]
+            left_g = _level_hist(g, flat, lmask, half)
+            left_h = _level_hist(h, flat, lmask, half)
+            smask = prev_split.astype(g.dtype)[:, None, None]
+            right_g = prev_hist_g * smask - left_g
+            right_h = prev_hist_h * smask - left_h
+            hist_g = jnp.stack([left_g, right_g], axis=1).reshape(
+                level_size, f, n_slots
+            )
+            hist_h = jnp.stack([left_h, right_h], axis=1).reshape(
+                level_size, f, n_slots
+            )
+        prev_hist_g, prev_hist_h = hist_g, hist_h
 
         miss_g = hist_g[..., :1]                                # [L, F, 1]
         miss_h = hist_h[..., :1]
@@ -175,6 +206,7 @@ def build_tree(
         best_b = ((best // 2) % n_bins).astype(jnp.int32) + 1   # real-bin threshold
         best_ml = (best % 2).astype(jnp.bool_)                  # missing-left?
         do_split = best_gain > 1e-12                            # children beat parent
+        prev_split = do_split
 
         node_ids = offset + jnp.arange(level_size)
         feature = feature.at[node_ids].set(jnp.where(do_split, best_f, -1))

@@ -6,6 +6,7 @@ from lightctr_tpu.optim.updaters import (
     adam,
     ftrl,
     dcasgd,
+    dcasgda,
     clip_by_value,
     add_decayed_regularization,
     get,
@@ -20,6 +21,7 @@ __all__ = [
     "adam",
     "ftrl",
     "dcasgd",
+    "dcasgda",
     "clip_by_value",
     "add_decayed_regularization",
     "get",

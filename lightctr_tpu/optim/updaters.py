@@ -270,6 +270,53 @@ def dcasgd(learning_rate: float, lambda_dc: float = 2.0) -> optax.GradientTransf
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class DCASGDAState(NamedTuple):
+    shadow: optax.Params  # per-worker shadow copy at pull time
+    accum: optax.Updates  # EMA of g^2 (the adaptive denominator)
+
+
+def dcasgda(
+    learning_rate: float,
+    lambda_dc: float = 0.1,
+    momentum: float = 0.95,
+    eps: float = 1e-7,
+) -> optax.GradientTransformation:
+    """DCASGD-a — the PS's ADAPTIVE delayed-compensation variant
+    (paramserver.h:269-287):
+
+        accum <- m * accum + (1 - m) * g^2
+        w -= lr * (g + lambda * g^2 * (w - shadow) / sqrt(accum + eps))
+        shadow <- w_new
+
+    The compensation term is normalized by the RMS gradient, making the
+    staleness correction scale-free (the reference's dcasgd_lambda drops from
+    2.0 to 0.1 for this variant).  ``eps`` matches Value::sqrt's in-sqrt 1e-7
+    (distributed_algo_abst.h:80-83)."""
+
+    def init_fn(params):
+        return DCASGDAState(
+            shadow=_tree_map(jnp.array, params),
+            accum=_tree_map(jnp.zeros_like, params),
+        )
+
+    def update_fn(grads, state, params):
+        if params is None:
+            raise ValueError("dcasgda requires params")
+        accum = _tree_map(
+            lambda a, g: momentum * a + (1.0 - momentum) * g * g,
+            state.accum, grads,
+        )
+        updates = _tree_map(
+            lambda g, w, s, a: -learning_rate
+            * (g + lambda_dc * g * g * (w - s) * jax.lax.rsqrt(a + eps)),
+            grads, params, state.shadow, accum,
+        )
+        shadow = _tree_map(lambda w, u: w + u, params, updates)
+        return updates, DCASGDAState(shadow=shadow, accum=accum)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 # ---------------------------------------------------------------------------
 # Composable extras
 # ---------------------------------------------------------------------------
@@ -313,6 +360,7 @@ _REGISTRY = {
     "adam": adam,
     "ftrl": ftrl,
     "dcasgd": dcasgd,
+    "dcasgda": dcasgda,
 }
 
 
