@@ -80,7 +80,10 @@ def filter_preferred_grads(
     push.h:61-63 / distributed_algo_abst.h:76-79): values that are ~0 carry
     no information ("obsolete feature") and exploded values are dropped for
     robustness.  Dropping = zeroing here — a zero grad is a no-op update, the
-    static-shape equivalent of omitting the key from the push."""
+    static-shape equivalent of omitting the key from the push.  Applied to
+    the per-key SUMMED gradient (the reference filters the value being
+    pushed, after the worker batches duplicate keys) — callers run it after
+    :func:`dedup_grads`."""
     a = jnp.abs(grads)
     keep = (a > tiny) & (a < huge)
     return grads * keep.astype(grads.dtype)
@@ -91,10 +94,11 @@ def sparse_sgd_update(
     filter_grads: bool = False,
 ) -> jax.Array:
     """PS simple-SGD branch (paramserver.h:296-300).  ``filter_grads``
-    applies the push-side ``checkPreferredValue`` filter first."""
-    if filter_grads:
-        grads = filter_preferred_grads(grads)
+    applies the push-side ``checkPreferredValue`` filter to the deduped
+    per-key sums."""
     uids, g, valid = dedup_grads(ids, grads)
+    if filter_grads:
+        g = filter_preferred_grads(g)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     return table.at[uids].add(-lr * g * _bcast(valid, g))
 
@@ -118,9 +122,9 @@ def sparse_adagrad_update(
 ) -> Tuple[jax.Array, SparseAdagradState]:
     """PS Adagrad branch (paramserver.h:287-295), touched rows only:
     accum[k] += g^2 ; w[k] -= lr * g / sqrt(accum[k] + eps)."""
-    if filter_grads:
-        grads = filter_preferred_grads(grads)
     uids, g, valid = dedup_grads(ids, grads)
+    if filter_grads:
+        g = filter_preferred_grads(g)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     vmask = _bcast(valid, g)
     accum_rows = jnp.take(state.accum, uids, axis=0) + g * g
@@ -152,9 +156,9 @@ def sparse_dcasgd_update(
     """PS DCASGD branch (paramserver.h:252-268):
     g' = g + lambda * g^2 * (w_cur - shadow[worker]);
     w -= lr * g'; shadow[worker] <- w_new."""
-    if filter_grads:
-        grads = filter_preferred_grads(grads)
     uids, g, valid = dedup_grads(ids, grads)
+    if filter_grads:
+        g = filter_preferred_grads(g)
     g = g.reshape((uids.shape[0],) + table.shape[1:])
     vmask = _bcast(valid, g)
     cur = jnp.take(table, uids, axis=0)

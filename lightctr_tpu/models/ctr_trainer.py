@@ -41,8 +41,13 @@ class CTRTrainer:
     ----------
     params: initial parameter pytree.
     logits_fn: (params, batch) -> [B] raw scores (pre-sigmoid).
-    l2_fn: optional (params, batch) -> scalar penalty (already summed; it is
-        divided by batch size alongside the mean loss).
+    l2_fn: optional (params, batch) -> scalar penalty.  MUST be extensive in
+        the batch — a sum over the batch's touched features, like
+        ``fm.l2_penalty`` (per-occurrence L2, train_fm_algo.cpp:108-115) —
+        because it is divided by the batch size alongside the mean loss, and
+        under data parallelism (sharded batches or ``compress_bits``) each
+        replica contributes its local sum.  A batch-independent whole-table
+        norm would be over-counted n_devices-fold in the compressed path.
     fused_fn: optional (params, batch) -> (logits, l2) computing both from
         one set of gathers (e.g. fm.logits_with_l2); takes precedence over
         (logits_fn-for-training, l2_fn).

@@ -2,14 +2,23 @@
 
 The single-chip counterpart of :mod:`lightctr_tpu.nn.ring_attention`: exact
 attention computed block-by-block with an online softmax, never materializing
-the [T, T] score matrix.  Q blocks stream through VMEM on a (batch*heads,
-q-blocks) grid; the inner loop walks K/V blocks with running (max, denom,
-accumulator) statistics — the same math the ring version distributes across
-chips, here tiled for one core's VMEM.
+the [T, T] score matrix.  The grid is (batch*heads, q-blocks, k-blocks) with
+the k-axis innermost and marked ``arbitrary`` so Mosaic double-buffers the
+K/V block fetches from HBM while the MXU works on the previous block; running
+(max, denom, accumulator) statistics live in VMEM scratch across k-steps.
 
-Used for long sequences where XLA's fused attention would spill; for the
-reference-parity models (T = 28) plain ``full_attention`` is fine.  Tested in
-interpreter mode on CPU (tests/), compiled for real on TPU.
+Running stats are kept as [block_q, 128] tiles (lane-width replicated) rather
+than 1-D vectors — TPU vregs are (8, 128), so the replicated form keeps every
+elementwise op a full-tile VPU op instead of a sublane-reduction dance.
+
+Causal mode skips K blocks strictly above the diagonal (no MXU work issued),
+halving FLOPs at long T.  Forward-only: the production differentiable paths
+are ``full_attention`` (short T) and ``ring_attention`` (sharded long T);
+this kernel serves long-context inference/eval on one core.
+
+Validated compiled on TPU v5e against the ``full_attention`` oracle (see
+tests/test_flash_attention.py for the interpret-mode gate and
+tools/bench_pallas.py for on-chip timings).
 """
 
 from __future__ import annotations
@@ -19,52 +28,77 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+LANES = 128
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float, causal: bool, block_q: int):
+def _cols(x, n):
+    """Broadcast a lane-replicated [bq, 128] stat tile to n columns (any n:
+    ceil-tile then slice — the rows are constant, so any slice is exact)."""
+    reps, rem = divmod(n, LANES)
+    if reps == 0:
+        return x[:, :n]
+    if rem:
+        return jnp.tile(x, (1, reps + 1))[:, :n]
+    return jnp.tile(x, (1, reps))
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nk: int
+):
     qi = pl.program_id(1)
-    q = q_ref[:]                                   # [BQ, D]
-    t = k_ref.shape[0]
-    n_k = t // block_k
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
     if causal:
-        # K blocks entirely above the diagonal contribute nothing — skip them
-        # (standard flash bound; halves causal FLOPs at long T)
-        n_k_eff = jnp.minimum(
-            n_k, ((qi + 1) * block_q + block_k - 1) // block_k
-        )
+        # run iff the block's bottom-left corner is on/below the diagonal
+        should_run = (qi + 1) * block_q - 1 >= kj * block_k
     else:
-        n_k_eff = n_k
+        should_run = True
 
-    m0 = jnp.full((q.shape[0],), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((q.shape[0],), jnp.float32)
-    acc0 = jnp.zeros(q.shape, jnp.float32)
-
-    def body(j, carry):
-        m, l, acc = carry
-        kblk = k_ref[pl.ds(j * block_k, block_k), :]           # [BK, D]
-        vblk = v_ref[pl.ds(j * block_k, block_k), :]
-        s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+    @pl.when(should_run)
+    def _run():
+        q = q_ref[:]                                    # [BQ, D]
+        k = k_ref[:]                                    # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                                       # [BQ, BK]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
+            cols = kj * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        corr = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * corr + jnp.sum(p, axis=-1)
-        acc_new = acc * corr[:, None] + jnp.dot(
-            p.astype(vblk.dtype), vblk, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+            s = jnp.where(rows >= cols, s, NEG_INF)
 
-    m, l, acc = jax.lax.fori_loop(0, n_k_eff, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+        m_prev, l_prev = m_scr[:], l_scr[:]             # [BQ, 128]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=1)[:, None])
+        p = jnp.exp(s - _cols(m_next, block_k))
+        alpha = jnp.exp(m_prev - m_next)
+        l_corr = alpha * l_prev
+        l_next = jnp.sum(p, axis=1)[:, None] + l_corr
+        m_scr[:] = m_next
+        l_scr[:] = l_next
+        l_inv = jnp.where(l_next == 0.0, 1.0, 1.0 / l_next)
+        d = acc_scr.shape[-1]
+        acc_scr[:] *= _cols(l_corr * l_inv, d)
+        o_curr = jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[:], preferred_element_type=jnp.float32
+        )
+        acc_scr[:] += o_curr * _cols(l_inv, d)
+
+    @pl.when(kj == nk - 1)
+    def _out():
+        o_ref[:] = acc_scr[:].astype(o_ref.dtype)
 
 
 @partial(
@@ -76,41 +110,57 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 256,
+    block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
     b, t, h, d = q.shape
+    # shrink requested blocks to divisors of T (callers pick tuning caps,
+    # the kernel accepts any T with a power-of-two-divisible length)
     block_q = min(block_q, t)
     block_k = min(block_k, t)
+    while block_q > 8 and t % block_q:
+        block_q //= 2
+    while block_k > 8 and t % block_k:
+        block_k //= 2
     if t % block_q or t % block_k:
         raise ValueError(
             f"block sizes ({block_q}, {block_k}) must divide T={t}"
         )
     scale = 1.0 / (d ** 0.5)
+    nk = t // block_k
 
     # [B, T, H, D] -> [B*H, T, D]
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
 
     qf, kf, vf = fold(q), fold(k), fold(v)
-    grid = (b * h, t // block_q)
+    grid = (b * h, t // block_q, nk)
     out = pl.pallas_call(
         partial(
             _flash_kernel,
-            block_k=block_k,
             scale=scale,
             causal=causal,
             block_q=block_q,
+            block_k=block_k,
+            nk=nk,
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, kk: (i, kk, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j, kk: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

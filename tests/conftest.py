@@ -14,6 +14,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# a wedged axon relay can hang even CPU-pinned jax imports unless the plugin
+# is disabled outright (see lightctr_tpu/utils/devicecheck.py)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 
 import jax  # noqa: E402
 

@@ -1,0 +1,155 @@
+"""Multi-chip convergence-parity + per-step evidence (VERDICT r1 item 5).
+
+The reference's distributed proof is a 4-node-vs-1-node loss-tracking chart
+(/root/reference/benchmark/4_node_ps.png).  The TPU-native counterpart:
+train the flagship Wide&Deep model (a) on one device, (b) sharded over an
+8-device mesh (data x embed — the PS layout), same seeds and batch schedule,
+and show the loss curves track to floating-point tolerance, plus per-step
+wall times per mesh shape.
+
+Run from the repo root (forces an 8-device virtual CPU platform, so it works
+on any machine — same trick as tests/conftest.py):
+
+    python -m tools.multichip_evidence
+
+Writes MULTICHIP_r02.json.  Caveat recorded in the payload: with virtual CPU
+devices sharing one host, per-step times validate the sharded program's
+structure (collectives compile + execute), not ICI scaling efficiency — only
+a real multi-chip slice can measure that.
+"""
+
+import json
+import os
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# a wedged axon relay hangs even CPU-pinned jax imports unless the plugin is
+# disabled outright (see utils/devicecheck.py)
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from lightctr_tpu import TrainConfig  # noqa: E402
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh  # noqa: E402
+from lightctr_tpu.models import widedeep  # noqa: E402
+from lightctr_tpu.models.ctr_trainer import CTRTrainer  # noqa: E402
+
+# Realistic-ish single-host scale: 100k-row embedding table (the vocabulary
+# order of a hashed Criteo-Kaggle shard), 1024-row batch.
+FEATURE_CNT = 100_000
+FIELD_CNT = 26
+NNZ = 26
+DIM = 32
+BATCH = 1024
+STEPS = 200
+
+
+def make_batch(seed=0):
+    rng = np.random.default_rng(seed)
+    fids = rng.integers(1, FEATURE_CNT, size=(BATCH, NNZ)).astype(np.int32)
+    fields = (np.arange(NNZ, dtype=np.int32) % FIELD_CNT)[None, :].repeat(BATCH, 0)
+    mask = np.ones((BATCH, NNZ), np.float32)
+    labels = (rng.random(BATCH) > 0.6).astype(np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask, FIELD_CNT)
+    return {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((BATCH, NNZ), np.float32), "mask": mask,
+        "labels": labels, "rep_fids": rep, "rep_mask": rep_mask,
+    }
+
+
+def embed_shardings(mesh):
+    return {
+        "w": NamedSharding(mesh, P("embed")),
+        "embed": NamedSharding(mesh, P("embed", None)),
+        "fc1": {"w": NamedSharding(mesh, P()), "b": NamedSharding(mesh, P())},
+        "fc2": {"w": NamedSharding(mesh, P()), "b": NamedSharding(mesh, P())},
+    }
+
+
+def run(mesh=None, shardings=None, steps=STEPS):
+    params = widedeep.init(
+        jax.random.PRNGKey(0), FEATURE_CNT, FIELD_CNT, DIM, hidden=64
+    )
+    cfg = TrainConfig(learning_rate=0.05)
+    tr = CTRTrainer(
+        params, widedeep.logits, cfg, mesh=mesh, param_shardings=shardings
+    )
+    batch = make_batch()
+    tr.warmup_fullbatch_scan(batch, steps)
+    tr.reset(params)
+    t0 = time.perf_counter()
+    losses = tr.fit_fullbatch_scan(batch, steps)
+    dt = time.perf_counter() - t0
+    return np.asarray(losses), dt
+
+
+def main():
+    n = len(jax.devices())
+    assert n >= 8, f"need 8 virtual devices, got {n}"
+
+    print(f"1-device run ({STEPS} steps, table {FEATURE_CNT}x{DIM})...")
+    l1, t1 = run()
+
+    runs = {}
+    curves = {}
+    for spec_name, spec in (
+        ("data4_embed2", MeshSpec(data=4, embed=2)),
+        ("data8", MeshSpec(data=8)),
+        ("data2_embed4", MeshSpec(data=2, embed=4)),
+    ):
+        mesh = make_mesh(spec)
+        print(f"{spec_name} run...")
+        lk, tk = run(mesh=mesh, shardings=embed_shardings(mesh))
+        diff = np.max(np.abs(lk - l1))
+        curves[spec_name] = lk
+        runs[spec_name] = {
+            "per_step_ms": round(tk / STEPS * 1e3, 3),
+            "max_abs_loss_diff_vs_1dev": float(diff),
+            "final_loss": float(lk[-1]),
+        }
+        print(f"  max|Δloss| vs 1-dev: {diff:.2e}  per-step {tk/STEPS*1e3:.2f} ms")
+
+    assert l1[-1] < l1[0], "1-device run did not converge"
+    for name, r in runs.items():
+        assert r["max_abs_loss_diff_vs_1dev"] < 1e-3, (name, r)
+
+    curve_idx = [0, 1, 2, 5, 10, 20, 50, 100, 150, 199]
+    payload = {
+        "model": "widedeep",
+        "table": [FEATURE_CNT, DIM],
+        "batch": BATCH,
+        "steps": STEPS,
+        "one_device": {
+            "per_step_ms": round(t1 / STEPS * 1e3, 3),
+            "final_loss": float(l1[-1]),
+        },
+        "loss_parity_curve": {
+            "step": curve_idx,
+            "one_device": [float(l1[i]) for i in curve_idx],
+            "data4_embed2": [float(curves["data4_embed2"][i]) for i in curve_idx],
+        },
+        "meshes": runs,
+        "caveat": (
+            "virtual CPU devices on one host: parity and program structure "
+            "are validated; ICI scaling efficiency requires a real slice"
+        ),
+    }
+    with open("MULTICHIP_r02.json", "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote MULTICHIP_r02.json")
+
+
+if __name__ == "__main__":
+    main()
