@@ -123,43 +123,33 @@ def ring_all_reduce(
     sums stay within ``compress_range`` as long as it bounds a single
     gradient's magnitude; in ``average=False`` (sum) mode ``compress_range``
     must bound the FULL n-way sum or values clip.
+
+    The whole exchange — BufferFusion flatten, padded ring schedule, codec,
+    unflatten — runs per-device INSIDE one ``shard_map``, so the call is a
+    single jittable program with no host staging: wrap it (or a step using
+    it) in ``jax.jit`` and it serves as the production overlap-schedule
+    template, not just the bench artifact.
     """
     n = mesh.shape[axis]
-    leaves, treedef = jax.tree_util.tree_flatten(stacked_tree)
-    # BufferFusion: flatten each device's slice into one contiguous vector
-    flat0, unravel = ravel_pytree([leaf[0] for leaf in leaves])
-    length = flat0.shape[0]
-    padded = ((length + n - 1) // n) * n
 
-    stacked_flat = jnp.stack(
-        [ravel_pytree([leaf[d] for leaf in leaves])[0] for d in range(n)]
-    )
-    if padded != length:
-        stacked_flat = jnp.pad(stacked_flat, ((0, 0), (0, padded - length)))
+    def local(tree):
+        # per-device slice: leaves arrive as [1, ...]
+        per_dev = jax.tree_util.tree_map(lambda x: x[0], tree)
+        # BufferFusion (buffer_fusion.h:53-65): one contiguous vector
+        flat, unravel = ravel_pytree(per_dev)
+        length = flat.shape[0]
+        padded = ((length + n - 1) // n) * n
+        if padded != length:
+            flat = jnp.pad(flat, (0, padded - length))
+        flat = _ring_all_reduce_local(
+            flat, axis, n, average,
+            compress_bits=compress_bits, compress_range=compress_range,
+        )
+        out = unravel(flat[:length])
+        return jax.tree_util.tree_map(lambda x: x[None], out)
 
-    fn = shard_map(
-        partial(
-            _ring_all_reduce_local,
-            axis_name=axis,
-            n=n,
-            average=average,
-            compress_bits=compress_bits,
-            compress_range=compress_range,
-        ),
-        mesh=mesh,
-        in_specs=P(axis),
-        out_specs=P(axis),
-    )
-    # shard_map splits the leading dim: each device gets its [padded] vector
-    out = fn(stacked_flat.reshape(n * padded))
-    out = out.reshape(n, padded)[:, :length]
-    return jax.tree_util.tree_unflatten(
-        jax.tree_util.tree_structure(stacked_tree),
-        [
-            jnp.stack([unravel(out[d])[i] for d in range(n)])
-            for i in range(len(leaves))
-        ],
-    )
+    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    return fn(stacked_tree)
 
 
 def ring_broadcast(mesh: Mesh, stacked_tree, axis: str = "data"):

@@ -1,0 +1,259 @@
+"""Cross-process asynchronous parameter server backed by the native ShmKV.
+
+:class:`~lightctr_tpu.embed.async_ps.AsyncParamServer` preserves the
+reference PS's SSP/DCASGD semantics for threads of one process; this module
+is the multi-process form — the one-host counterpart of the reference's
+multi-node PS cluster (``distribut/paramserver.h`` over ZeroMQ): N worker
+*processes* push/pull against file-backed shared memory with the same
+float-CAS update discipline as ``util/shm_hashtable.h``.
+
+Layout (four ShmKV stores under one base path):
+  ``<base>.data``    key -> float[dim]       parameter rows
+  ``<base>.accum``   key -> float[dim]       Adagrad / DCASGDA accumulators
+  ``<base>.shadow``  (worker<<SHIFT)|key -> float[dim]  per-worker shadows
+  ``<base>.meta``    worker -> [epoch, routed]          version ledger
+
+Async-by-design concurrency notes (all match the reference's tolerance):
+  - sgd/adagrad updates are atomic float-CAS adds — concurrent pushes from
+    any number of processes interleave without loss;
+  - the adagrad read-after-add of the accumulator may observe a competitor's
+    increment (slightly smaller step) — same as the PS applying pushes in
+    arrival order;
+  - DCASGDA's EMA accumulator is last-writer-wins (``set``), the in-arrival
+    -order behavior of paramserver.h:269-287;
+  - lazy init races resolve to the sum of the racers' random rows — still a
+    valid random init (variance sqrt(2)x at worst, once per key ever).
+
+The SSP gate uses the min over live workers' ledger epochs: a pull from a
+worker more than ``staleness_threshold`` epochs ahead of the slowest is
+withheld (pull.h:50-67); a push more than the threshold behind the fastest
+is dropped (paramserver.h:201-205).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from lightctr_tpu.native.bindings import ShmKV, available
+
+STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
+_WORKER_SHIFT = 48  # shadow composite keys: (worker << 48) | key
+
+
+class ShmAsyncParamServer:
+    """Multi-process sparse async PS.  One process calls :meth:`create`;
+    every worker process calls :meth:`open` with its ``worker_id`` and then
+    uses :meth:`pull` / :meth:`push` — the same protocol surface as
+    ``AsyncParamServer``, minus the in-process heartbeat wiring (routing
+    flags live in the meta store and survive process restarts)."""
+
+    def __init__(
+        self,
+        stores,
+        dim: int,
+        n_workers: int,
+        updater: str,
+        learning_rate: float,
+        staleness_threshold: int,
+        dcasgd_lambda: float,
+        momentum_rate: float,
+        eps: float,
+        seed: int,
+    ):
+        if updater not in ("sgd", "adagrad", "dcasgd", "dcasgda"):
+            raise ValueError(f"unknown updater {updater!r}")
+        self._data, self._accum, self._shadow, self._meta = stores
+        self.dim = dim
+        self.n_workers = n_workers
+        self.updater = updater
+        self.lr = learning_rate
+        self.staleness_threshold = staleness_threshold
+        self.dcasgd_lambda = dcasgd_lambda
+        self.momentum_rate = momentum_rate
+        self.eps = eps
+        self._rng = np.random.default_rng(seed)
+        self.dropped_pushes = 0
+        self.withheld_pulls = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        base_path: str,
+        capacity: int,
+        dim: int,
+        n_workers: int,
+        updater: str = "adagrad",
+        learning_rate: float = 0.1,
+        staleness_threshold: int = STALENESS_THRESHOLD,
+        dcasgd_lambda: float = 0.1,
+        momentum_rate: float = 0.95,
+        eps: float = 1e-7,
+        seed: int = 0,
+    ) -> "ShmAsyncParamServer":
+        if not available():  # pragma: no cover - build env dependent
+            raise RuntimeError("native shm_kv library unavailable")
+        shadow_cap = capacity * (n_workers if updater.startswith("dcasgd") else 1)
+        stores = (
+            ShmKV.create(base_path + ".data", capacity, dim),
+            ShmKV.create(base_path + ".accum", capacity, dim),
+            ShmKV.create(base_path + ".shadow", shadow_cap, dim),
+            ShmKV.create(base_path + ".meta", 4 * (n_workers + 1), 2),
+        )
+        ps = cls(
+            stores, dim, n_workers, updater, learning_rate,
+            staleness_threshold, dcasgd_lambda, momentum_rate, eps, seed,
+        )
+        for w in range(n_workers):
+            ps._meta.set(w, np.array([0.0, 1.0], np.float32))  # epoch 0, routed
+        return ps
+
+    @classmethod
+    def open(
+        cls,
+        base_path: str,
+        n_workers: int,
+        updater: str = "adagrad",
+        learning_rate: float = 0.1,
+        staleness_threshold: int = STALENESS_THRESHOLD,
+        dcasgd_lambda: float = 0.1,
+        momentum_rate: float = 0.95,
+        eps: float = 1e-7,
+        seed: Optional[int] = None,
+    ) -> "ShmAsyncParamServer":
+        if not available():  # pragma: no cover - build env dependent
+            raise RuntimeError("native shm_kv library unavailable")
+        stores = (
+            ShmKV.open(base_path + ".data"),
+            ShmKV.open(base_path + ".accum"),
+            ShmKV.open(base_path + ".shadow"),
+            ShmKV.open(base_path + ".meta"),
+        )
+        dim = stores[0].dim
+        return cls(
+            stores, dim, n_workers, updater, learning_rate,
+            staleness_threshold, dcasgd_lambda, momentum_rate, eps,
+            seed if seed is not None else os.getpid(),
+        )
+
+    def close(self) -> None:
+        for s in (self._data, self._accum, self._shadow, self._meta):
+            s.close()
+
+    # -- ledger ------------------------------------------------------------
+
+    def _ledger(self):
+        """(epochs[n_workers], routed[n_workers]) from the meta store."""
+        rows, found = self._meta.get_batch(
+            np.arange(self.n_workers, dtype=np.uint64)
+        )
+        epochs = np.where(found.astype(bool), rows[:, 0], 0.0)
+        routed = np.where(found.astype(bool), rows[:, 1], 1.0)
+        return epochs, routed.astype(bool)
+
+    def advance_epoch(self, worker_id: int, epoch: int) -> None:
+        """Record the worker's ledger epoch (monotone: each worker is the
+        sole writer of its own row, and regressions are ignored)."""
+        row = self._meta.get(int(worker_id))
+        cur = float(row[0]) if row is not None else 0.0
+        routed = float(row[1]) if row is not None else 1.0
+        self._meta.set(
+            int(worker_id), np.array([max(cur, float(epoch)), routed], np.float32)
+        )
+
+    def unroute_worker(self, worker_id: int) -> None:
+        row = self._meta.get(int(worker_id))
+        epoch = float(row[0]) if row is not None else 0.0
+        self._meta.set(int(worker_id), np.array([epoch, 0.0], np.float32))
+
+    def readmit_worker(self, worker_id: int) -> None:
+        row = self._meta.get(int(worker_id))
+        epoch = float(row[0]) if row is not None else 0.0
+        self._meta.set(int(worker_id), np.array([epoch, 1.0], np.float32))
+
+    def _routed(self, worker_id: int) -> bool:
+        row = self._meta.get(int(worker_id))
+        return row is None or bool(row[1] > 0.5)
+
+    # -- protocol ----------------------------------------------------------
+
+    def _lazy_init(self, key: int) -> np.ndarray:
+        """First touch creates ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339)
+        via atomic add from the zero row ShmKV inserts."""
+        v = self._data.get(key)
+        if v is None:
+            init = (
+                self._rng.standard_normal(self.dim) * np.sqrt(1.0 / self.dim)
+            ).astype(np.float32)
+            self._data.add(key, init)
+            v = self._data.get(key)
+        return v
+
+    def pull(
+        self, keys, worker_epoch: int, worker_id: Optional[int] = None
+    ) -> Optional[Dict[int, np.ndarray]]:
+        """key->value, or None when SSP-withheld (too far ahead of the
+        slowest routed worker) or the caller is unrouted."""
+        if worker_id is not None:
+            if not self._routed(worker_id):
+                return None
+            self.advance_epoch(worker_id, worker_epoch)
+        epochs, routed = self._ledger()
+        if routed.any():
+            slowest = float(epochs[routed].min())
+            if worker_epoch - slowest > self.staleness_threshold:
+                self.withheld_pulls += 1
+                return None
+        return {int(k): self._lazy_init(int(k)).copy() for k in keys}
+
+    def push(
+        self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int
+    ) -> bool:
+        """Apply per-key grads with atomic float-CAS adds; False = dropped
+        (stale beyond threshold, or unrouted)."""
+        if not self._routed(worker_id):
+            return False
+        epochs, routed = self._ledger()
+        # only routed workers count: a dead sprinter must not wedge the
+        # survivors' pushes behind an unreachable fastest epoch
+        fastest = float(epochs[routed].max()) if routed.any() else 0.0
+        if worker_epoch + self.staleness_threshold < fastest:
+            self.dropped_pushes += 1
+            return False
+        self.advance_epoch(worker_id, max(worker_epoch, 0))
+        for key, g in grads.items():
+            key = int(key)
+            if key >= (1 << _WORKER_SHIFT):
+                raise ValueError(f"key {key} >= 2^{_WORKER_SHIFT} (shadow keyspace)")
+            g = np.asarray(g, np.float32).reshape(self.dim)
+            w = self._lazy_init(key)
+            if self.updater == "sgd":
+                self._data.add(key, -self.lr * g)
+            elif self.updater == "adagrad":
+                self._accum.add(key, g * g)
+                acc = self._accum.get(key)
+                self._data.add(key, -self.lr * g / np.sqrt(acc + self.eps))
+            else:
+                skey = (int(worker_id) << _WORKER_SHIFT) | key
+                shadow = self._shadow.get(skey)
+                if shadow is None:
+                    shadow = w.copy()
+                if self.updater == "dcasgd":
+                    comp = g + self.dcasgd_lambda * g * g * (w - shadow)
+                else:  # dcasgda
+                    acc = self._accum.get(key)
+                    acc = np.zeros(self.dim, np.float32) if acc is None else acc
+                    acc = self.momentum_rate * acc + (1.0 - self.momentum_rate) * g * g
+                    self._accum.set(key, acc)
+                    comp = g + (
+                        self.dcasgd_lambda * g * g * (w - shadow)
+                        / np.sqrt(acc + self.eps)
+                    )
+                self._data.add(key, -self.lr * comp)
+                new_w = self._data.get(key)
+                self._shadow.set(skey, new_w)
+        return True

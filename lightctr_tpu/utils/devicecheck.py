@@ -78,6 +78,31 @@ def _force_cpu() -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def pin_cpu_platform(n_devices: Optional[int] = None) -> None:
+    """Pin this process to the CPU platform, reliably, with ``n_devices``
+    virtual host devices — the one shared preamble for CPU-pinned tools and
+    the test suite.
+
+    Three layers, all needed: XLA_FLAGS (virtual device count must precede
+    first backend use), env vars (inherited by forked children, e.g. the
+    probe fork), and ``jax.config.update`` (the ambient env carries
+    JAX_PLATFORMS=axon and the axon site hook may import jax at interpreter
+    startup, so env vars alone are too late in THIS process — only the
+    config update reliably keeps a wedged relay out of the backend list)."""
+    if n_devices is not None:
+        import re
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        pat = r"--xla_force_host_platform_device_count=\d+"
+        want = f"--xla_force_host_platform_device_count={n_devices}"
+        if re.search(pat, flags):
+            flags = re.sub(pat, want, flags)
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags
+    _force_cpu()
+
+
 def ensure_live_backend(announce: bool = True, force_cpu: bool = False) -> bool:
     """Returns True when the configured backend answers; otherwise falls back
     to CPU in-process and returns False.  ``force_cpu`` skips the probe and

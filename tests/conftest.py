@@ -6,21 +6,11 @@ sharding on one host via XLA's host-platform device-count override, so every
 mesh/collective test runs on any machine.
 """
 
-import os
+from lightctr_tpu.utils.devicecheck import pin_cpu_platform
 
-# jax may already be imported at interpreter startup (axon platform hook), so
-# env vars alone are too late — update jax.config before the first backend use.
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-# a wedged axon relay can hang even CPU-pinned jax imports unless the plugin
-# is disabled outright (see lightctr_tpu/utils/devicecheck.py)
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+pin_cpu_platform(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

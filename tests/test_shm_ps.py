@@ -1,0 +1,131 @@
+"""ShmAsyncParamServer: cross-process PS semantics over the native ShmKV.
+
+The reference proves its PS cluster with multi-node runs; the one-host
+counterpart here forks real worker processes against the same file-backed
+stores and checks (a) no lost updates under concurrent float-CAS pushes,
+(b) SSP gating, (c) routing flags, (d) single-writer parity with the
+in-process AsyncParamServer oracle."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.native.bindings import available
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native shm_kv library unavailable"
+)
+
+DIM = 4
+LR = 0.1
+
+
+def _make(tmp_path, updater="sgd", n_workers=2, **kw):
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+
+    return ShmAsyncParamServer.create(
+        str(tmp_path / "ps"), capacity=1024, dim=DIM, n_workers=n_workers,
+        updater=updater, learning_rate=LR, **kw,
+    )
+
+
+def _worker_push_loop(base, worker_id, n_pushes, keys):
+    """Runs in a forked child: open the store and hammer pushes."""
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+
+    ps = ShmAsyncParamServer.open(
+        base, n_workers=2, updater="sgd", learning_rate=LR,
+        staleness_threshold=1 << 20,  # this test measures atomicity, not SSP
+    )
+    g = {k: np.ones(DIM, np.float32) for k in keys}
+    for i in range(n_pushes):
+        assert ps.push(worker_id, g, worker_epoch=i)
+    ps.close()
+
+
+def test_concurrent_pushes_lose_nothing(tmp_path):
+    ps = _make(tmp_path, updater="sgd", staleness_threshold=1 << 20)
+    keys = [3, 7, 11]
+    for k in keys:  # pre-seed zeros: no lazy-init randomness in the ledger
+        ps._data.set(k, np.zeros(DIM, np.float32))
+    n_pushes = 200
+    pids = []
+    for wid in range(2):
+        pid = os.fork()
+        if pid == 0:
+            try:
+                _worker_push_loop(str(tmp_path / "ps"), wid, n_pushes, keys)
+                os._exit(0)
+            except BaseException:
+                os._exit(1)
+        pids.append(pid)
+    for pid in pids:
+        _, status = os.waitpid(pid, 0)
+        assert os.WIFEXITED(status) and os.WEXITSTATUS(status) == 0
+    want = -LR * 2 * n_pushes
+    for k in keys:
+        np.testing.assert_allclose(
+            ps._data.get(k), np.full(DIM, want, np.float32), rtol=1e-5
+        )
+    ps.close()
+
+
+def test_ssp_pull_gate_and_push_drop(tmp_path):
+    ps = _make(tmp_path, updater="sgd", staleness_threshold=3)
+    # worker 1 sprints to epoch 10; worker 0 stays at 0
+    ps.advance_epoch(1, 10)
+    # a pull from epoch 10 while the slowest is 0 is withheld
+    assert ps.pull([1], worker_epoch=10) is None
+    assert ps.withheld_pulls == 1
+    # within the staleness bound it succeeds
+    got = ps.pull([1], worker_epoch=2)
+    assert got is not None and set(got) == {1}
+    # a push 10 behind the fastest is dropped
+    assert not ps.push(0, {1: np.ones(DIM, np.float32)}, worker_epoch=0)
+    assert ps.dropped_pushes == 1
+    # catch worker 0 up; its push lands
+    ps.advance_epoch(0, 9)
+    assert ps.push(0, {1: np.ones(DIM, np.float32)}, worker_epoch=9)
+    ps.close()
+
+
+def test_routing_flags(tmp_path):
+    ps = _make(tmp_path, updater="sgd")
+    ps.unroute_worker(0)
+    assert not ps.push(0, {5: np.ones(DIM, np.float32)}, worker_epoch=0)
+    assert ps.pull([5], worker_epoch=0, worker_id=0) is None
+    ps.readmit_worker(0)
+    assert ps.push(0, {5: np.ones(DIM, np.float32)}, worker_epoch=0)
+    assert ps.pull([5], worker_epoch=0, worker_id=0) is not None
+    ps.close()
+
+
+@pytest.mark.parametrize("updater", ["adagrad", "dcasgd", "dcasgda"])
+def test_single_writer_matches_async_ps_oracle(tmp_path, updater):
+    """With one worker and a fixed push sequence the shm PS must reproduce
+    the in-process AsyncParamServer numerics exactly."""
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    shm = _make(tmp_path, updater=updater, n_workers=1)
+    ref = AsyncParamServer(
+        dim=DIM, updater=updater, learning_rate=LR, n_workers=1
+    )
+    rng = np.random.default_rng(0)
+    key = 42
+    # identical lazy init on both sides
+    init = (rng.standard_normal(DIM) * np.sqrt(1.0 / DIM)).astype(np.float32)
+    shm._data.set(key, init)
+    shm._accum.set(key, np.zeros(DIM, np.float32))
+    ref._data[key] = init.copy()
+    ref._accum[key] = np.zeros(DIM, np.float32)
+    ref._shadow[key] = np.tile(init, (1, 1))
+    shm._shadow.set(key, init)  # worker 0 << 48 | key == key for worker 0
+    for step in range(20):
+        g = rng.standard_normal(DIM).astype(np.float32)
+        assert shm.push(0, {key: g}, worker_epoch=step)
+        assert ref.push(0, {key: g}, worker_epoch=step)
+    np.testing.assert_allclose(
+        shm._data.get(key), ref._data[key], rtol=2e-5, atol=2e-6
+    )
+    shm.close()

@@ -4,11 +4,13 @@ Run on the live TPU from the repo root:  python -m tools.bench_pallas
 Prints one JSON line per comparison and writes PALLAS_BENCH.json.
 
 Timing discipline for the axon relay: ``block_until_ready`` does NOT
-synchronize through the tunnel, so each measurement chains N dependent kernel
-invocations inside one jitted ``lax.scan`` and fetches a scalar (a real
-round trip).  Per-call time = (total - RTT) / N, with RTT measured from a
-trivial scalar fetch.
-"""
+synchronize through the tunnel, and a single scalar fetch pays an unknown
+round-trip latency.  Each measurement therefore runs the kernel chained
+N times and 2N times inside jitted ``lax.scan``s (data-dependent, so steps
+serialize) and reports per-call = (t_2N - t_N) / N — the tunnel RTT and
+dispatch overheads cancel in the difference.  A measurement is rejected
+(nulled) unless the differenced time is at least twice the RTT jitter
+observed across repeats."""
 
 import json
 import time
@@ -20,106 +22,118 @@ from lightctr_tpu.optim.fused_adagrad import fused_adagrad_update
 from lightctr_tpu.nn.flash_attention import flash_attention
 from lightctr_tpu.nn.ring_attention import full_attention
 
-N = 20
+N = 32
+REPS = 5
 
 
-def measure_rtt():
-    @jax.jit
-    def one(x):
-        return jnp.sum(x)
-
-    x = jnp.ones((8, 128), jnp.float32)
-    float(one(x))
-    ts = []
-    for _ in range(10):
+def _measure(chain_fn, *args):
+    """chain_fn(length) -> jitted scalar-returning function running the
+    kernel `length` times.  Returns (per_call_s, jitter_s) or (None, jitter)
+    when the difference is below the noise floor."""
+    f1, f2 = chain_fn(N), chain_fn(2 * N)
+    float(f1(*args)), float(f2(*args))  # compile both
+    t1s, t2s = [], []
+    for _ in range(REPS):
         t0 = time.perf_counter()
-        float(one(x))
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
-
-
-def timed_chain(fn, *args, iters=5, rtt=0.0):
-    """fn is a jitted function returning a scalar; min over iters of
-    (wall - rtt) / N."""
-    float(fn(*args))  # warm / compile
-    ts = []
-    for _ in range(iters):
+        float(f1(*args))
+        t1s.append(time.perf_counter() - t0)
         t0 = time.perf_counter()
-        float(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return max((min(ts) - rtt) / N, 1e-9)
+        float(f2(*args))
+        t2s.append(time.perf_counter() - t0)
+    jitter = max(max(t1s) - min(t1s), max(t2s) - min(t2s))
+    diff = min(t2s) - min(t1s)
+    if diff < 2 * jitter or diff <= 0:
+        return None, jitter
+    return diff / N, jitter
 
 
-def bench_adagrad(rtt):
+def _round(x, p=3):
+    return None if x is None else round(x, p)
+
+
+def bench_adagrad():
     out = []
 
-    def chain(update):
-        @jax.jit
-        def f(w, a, g):
-            def body(carry, _):
-                w, a = carry
-                return update(w, a, g), ()
+    def make_chain(update):
+        def chain(length):
+            @jax.jit
+            def f(w, a, g):
+                def body(carry, _):
+                    w, a = carry
+                    return update(w, a, g), ()
 
-            (w2, a2), _ = jax.lax.scan(body, (w, a), None, length=N)
-            return jnp.sum(w2)
+                (w2, _), _ = jax.lax.scan(body, (w, a), None, length=length)
+                return jnp.sum(w2)
 
-        return f
+            return f
+
+        return chain
 
     def xla_update(w, a, g):
         a2 = a + g * g
         return w - 0.1 * g * jax.lax.rsqrt(a2 + 1e-7), a2
 
-    pallas_fn = chain(lambda w, a, g: fused_adagrad_update(w, a, g, 0.1))
-    xla_fn = chain(xla_update)
+    pallas_chain = make_chain(lambda w, a, g: fused_adagrad_update(w, a, g, 0.1))
+    xla_chain = make_chain(xla_update)
     for n in (1 << 20, 1 << 24):
         w = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
         a = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.float32))
         g = jax.random.normal(jax.random.PRNGKey(2), (n,), jnp.float32)
-        tp = timed_chain(pallas_fn, w, a, g, rtt=rtt)
-        tx = timed_chain(xla_fn, w, a, g, rtt=rtt)
+        tp, jp = _measure(pallas_chain, w, a, g)
+        tx, jx = _measure(xla_chain, w, a, g)
         gb = 5 * 4 * n / 1e9
         out.append({
             "kernel": "fused_adagrad", "n": n,
-            "pallas_us": round(tp * 1e6, 1), "xla_us": round(tx * 1e6, 1),
-            "pallas_gbps": round(gb / tp, 1), "xla_gbps": round(gb / tx, 1),
-            "speedup": round(tx / tp, 3),
+            "pallas_us": _round(tp and tp * 1e6, 1),
+            "xla_us": _round(tx and tx * 1e6, 1),
+            "pallas_gbps": _round(tp and gb / tp, 1),
+            "xla_gbps": _round(tx and gb / tx, 1),
+            "speedup": _round(tp and tx and tx / tp, 3),
+            "jitter_ms": _round(max(jp, jx) * 1e3, 2),
         })
         print(json.dumps(out[-1]), flush=True)
     return out
 
 
-def bench_flash(rtt):
+def bench_flash():
     out = []
 
-    def chain(attn):
-        @jax.jit
-        def f(q, k, v):
-            def body(carry, _):
-                o = attn(carry, k, v, causal=True)
-                return o.astype(carry.dtype), ()
+    def make_chain(attn):
+        def chain(length):
+            @jax.jit
+            def f(q, k, v):
+                def body(carry, _):
+                    o = attn(carry, k, v, causal=True)
+                    return o.astype(carry.dtype), ()
 
-            o, _ = jax.lax.scan(body, q, None, length=N)
-            return jnp.sum(o)
+                o, _ = jax.lax.scan(body, q, None, length=length)
+                return jnp.sum(o)
 
-        return f
+            return f
 
-    pallas_fn = chain(lambda q, k, v, **kw: flash_attention(q, k, v, **kw))
-    xla_fn = chain(full_attention)
+        return chain
+
+    pallas_chain = make_chain(
+        lambda q, k, v, **kw: flash_attention(q, k, v, **kw)
+    )
+    xla_chain = make_chain(full_attention)
     for (b, t, h, d) in ((4, 1024, 8, 64), (2, 4096, 8, 64), (1, 8192, 8, 64)):
         q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, d), jnp.bfloat16)
         k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d), jnp.bfloat16)
         v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d), jnp.bfloat16)
-        tp = timed_chain(pallas_fn, q, k, v, rtt=rtt)
+        tp, jp = _measure(pallas_chain, q, k, v)
         try:
-            tx = timed_chain(xla_fn, q, k, v, rtt=rtt)
+            tx, jx = _measure(xla_chain, q, k, v)
         except Exception:
-            tx = float("nan")  # [T,T] may OOM at long T — that's the point
+            tx, jx = None, 0.0  # [T,T] may OOM at long T — that's the point
         fl = b * h * t * t * 0.5 * d * 2 * 2  # causal qk + pv
         out.append({
             "kernel": "flash_attention", "shape": [b, t, h, d],
-            "pallas_ms": round(tp * 1e3, 3), "xla_ms": round(tx * 1e3, 3),
-            "pallas_tflops": round(fl / tp / 1e12, 2),
-            "speedup": round(tx / tp, 3),
+            "pallas_ms": _round(tp and tp * 1e3, 3),
+            "xla_ms": _round(tx and tx * 1e3, 3),
+            "pallas_tflops": _round(tp and fl / tp / 1e12, 2),
+            "speedup": _round(tp and tx and tx / tp, 3),
+            "jitter_ms": _round(max(jp, jx) * 1e3, 2),
         })
         print(json.dumps(out[-1]), flush=True)
     return out
@@ -127,11 +141,12 @@ def bench_flash(rtt):
 
 if __name__ == "__main__":
     dev = jax.devices()[0]
-    rtt = measure_rtt()
-    print(json.dumps({"device": str(dev), "rtt_ms": round(rtt * 1e3, 2)}))
+    print(json.dumps({"device": str(dev)}))
     res = {
-        "device": str(dev), "rtt_ms": round(rtt * 1e3, 2),
-        "adagrad": bench_adagrad(rtt), "flash": bench_flash(rtt),
+        "device": str(dev),
+        "method": f"per-call = (t_{2*N} - t_{N}) / {N}, min over {REPS} reps",
+        "adagrad": bench_adagrad(),
+        "flash": bench_flash(),
     }
     with open("PALLAS_BENCH.json", "w") as f:
-        json.dump(res, f, indent=1)
+        json.dump(res, f, indent=1, allow_nan=False)

@@ -26,23 +26,14 @@ import json
 import os
 import time
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-# Pin the CPU platform unconditionally (the ambient env carries
-# JAX_PLATFORMS=axon): env var AND config update, because the axon site hook
-# may have imported jax before this module runs and a wedged relay would
-# hang backend init (same pattern as tests/conftest.py).  Set
-# LIGHTCTR_CRITEO_REAL=1 to run on real attached devices instead.
+# CPU-pinned by default (set LIGHTCTR_CRITEO_REAL=1 to run on real attached
+# devices instead); pin_cpu_platform is the shared wedge-proof preamble.
+from lightctr_tpu.utils.devicecheck import pin_cpu_platform  # noqa: E402
+
 if not os.environ.get("LIGHTCTR_CRITEO_REAL"):
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    pin_cpu_platform(8)
 
 import jax  # noqa: E402
-
-if not os.environ.get("LIGHTCTR_CRITEO_REAL"):
-    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402

@@ -22,19 +22,11 @@ import json
 import os
 import time
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-# a wedged axon relay hangs even CPU-pinned jax imports unless the plugin is
-# disabled outright (see utils/devicecheck.py)
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
+from lightctr_tpu.utils.devicecheck import pin_cpu_platform
+
+pin_cpu_platform(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
