@@ -21,8 +21,6 @@ one logical flat buffer for the collective.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -11,7 +11,13 @@ Layout (four ShmKV stores under one base path):
   ``<base>.data``    key -> float[dim]       parameter rows
   ``<base>.accum``   key -> float[dim]       Adagrad / DCASGDA accumulators
   ``<base>.shadow``  (worker<<SHIFT)|key -> float[dim]  per-worker shadows
-  ``<base>.meta``    worker -> [epoch, routed]          version ledger
+  ``<base>.meta``    version/routing ledger, one row per concern so every
+                     row has exactly ONE writer (no read-modify-write races):
+                       worker              -> epoch as two fp32 limbs
+                                              (lo = e % 2^24, hi = e // 2^24
+                                              — exact to 2^48 steps; a raw
+                                              fp32 would saturate at 2^24)
+                       ROUTE_BASE + worker -> [routed, 0] (coordinator-owned)
 
 Async-by-design concurrency notes (all match the reference's tolerance):
   - sgd/adagrad updates are atomic float-CAS adds — concurrent pushes from
@@ -41,6 +47,18 @@ from lightctr_tpu.native.bindings import ShmKV, available
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
 _WORKER_SHIFT = 48  # shadow composite keys: (worker << 48) | key
+_ROUTE_BASE = 1 << 32  # meta keys for routing flags (distinct writer per row)
+_LIMB = 1 << 24  # fp32 exact-integer range: epochs stored as (lo, hi) limbs
+
+
+def _encode_epoch(epoch: int) -> np.ndarray:
+    return np.array([epoch % _LIMB, epoch // _LIMB], np.float32)
+
+
+def _decode_epoch(row) -> int:
+    if row is None:
+        return 0
+    return int(row[0]) + int(row[1]) * _LIMB
 
 
 class ShmAsyncParamServer:
@@ -109,7 +127,8 @@ class ShmAsyncParamServer:
             staleness_threshold, dcasgd_lambda, momentum_rate, eps, seed,
         )
         for w in range(n_workers):
-            ps._meta.set(w, np.array([0.0, 1.0], np.float32))  # epoch 0, routed
+            ps._meta.set(w, _encode_epoch(0))
+            ps._meta.set(_ROUTE_BASE + w, np.array([1.0, 0.0], np.float32))
         return ps
 
     @classmethod
@@ -148,36 +167,37 @@ class ShmAsyncParamServer:
 
     def _ledger(self):
         """(epochs[n_workers], routed[n_workers]) from the meta store."""
-        rows, found = self._meta.get_batch(
-            np.arange(self.n_workers, dtype=np.uint64)
+        wids = np.arange(self.n_workers, dtype=np.uint64)
+        erows, efound = self._meta.get_batch(wids)
+        rrows, rfound = self._meta.get_batch(_ROUTE_BASE + wids)
+        limbs = erows.astype(np.int64)  # fp32 limbs hold exact ints < 2^24
+        epochs = np.where(
+            efound.astype(bool), limbs[:, 0] + limbs[:, 1] * _LIMB, 0
         )
-        epochs = np.where(found.astype(bool), rows[:, 0], 0.0)
-        routed = np.where(found.astype(bool), rows[:, 1], 1.0)
+        routed = np.where(rfound.astype(bool), rrows[:, 0], 1.0)
         return epochs, routed.astype(bool)
 
     def advance_epoch(self, worker_id: int, epoch: int) -> None:
-        """Record the worker's ledger epoch (monotone: each worker is the
-        sole writer of its own row, and regressions are ignored)."""
-        row = self._meta.get(int(worker_id))
-        cur = float(row[0]) if row is not None else 0.0
-        routed = float(row[1]) if row is not None else 1.0
-        self._meta.set(
-            int(worker_id), np.array([max(cur, float(epoch)), routed], np.float32)
-        )
+        """Record the worker's ledger epoch.  Each worker is the SOLE writer
+        of its epoch row (routing lives in a separate coordinator-owned row,
+        so this write can never resurrect a cleared routing flag); regressions
+        are ignored."""
+        cur = _decode_epoch(self._meta.get(int(worker_id)))
+        self._meta.set(int(worker_id), _encode_epoch(max(cur, int(epoch))))
 
     def unroute_worker(self, worker_id: int) -> None:
-        row = self._meta.get(int(worker_id))
-        epoch = float(row[0]) if row is not None else 0.0
-        self._meta.set(int(worker_id), np.array([epoch, 0.0], np.float32))
+        self._meta.set(
+            _ROUTE_BASE + int(worker_id), np.array([0.0, 0.0], np.float32)
+        )
 
     def readmit_worker(self, worker_id: int) -> None:
-        row = self._meta.get(int(worker_id))
-        epoch = float(row[0]) if row is not None else 0.0
-        self._meta.set(int(worker_id), np.array([epoch, 1.0], np.float32))
+        self._meta.set(
+            _ROUTE_BASE + int(worker_id), np.array([1.0, 0.0], np.float32)
+        )
 
     def _routed(self, worker_id: int) -> bool:
-        row = self._meta.get(int(worker_id))
-        return row is None or bool(row[1] > 0.5)
+        row = self._meta.get(_ROUTE_BASE + int(worker_id))
+        return row is None or bool(row[0] > 0.5)
 
     # -- protocol ----------------------------------------------------------
 

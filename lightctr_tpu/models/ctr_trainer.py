@@ -83,11 +83,20 @@ class CTRTrainer:
         param_shardings=None,
         compress_bits: Optional[int] = None,
         compress_range: float = 1.0,
+        fused_adagrad: bool = False,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
         self.l2_fn = l2_fn
         self.fused_fn = fused_fn
+        if fused_adagrad and optimizer is not None:
+            raise ValueError("fused_adagrad replaces the optimizer argument")
+        if fused_adagrad and compress_bits is not None:
+            raise ValueError(
+                "fused_adagrad is not supported with compress_bits (the "
+                "compressed ring step applies the optax update path)"
+            )
+        self.fused_adagrad = fused_adagrad
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh
         self.compress_bits = compress_bits
@@ -147,6 +156,35 @@ class CTRTrainer:
     def _make_step(self):
         loss_fn = self._make_loss_fn()
         tx = self.tx
+
+        if self.fused_adagrad:
+            from lightctr_tpu.optim.fused_adagrad import fused_adagrad_update
+
+            lr, eps = self.cfg.learning_rate, 1e-7
+            # Mosaic lowering needs a real TPU; everywhere else the kernel
+            # runs in interpret mode (same numerics, test path)
+            interpret = jax.devices()[0].platform != "tpu"
+
+            def step(params, opt_state, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                leaves_w, treedef = jax.tree_util.tree_flatten(params)
+                leaves_a = treedef.flatten_up_to(opt_state.accum)
+                leaves_g = treedef.flatten_up_to(grads)
+                pairs = [
+                    fused_adagrad_update(w, a, g, lr, eps, interpret=interpret)
+                    for w, a, g in zip(leaves_w, leaves_a, leaves_g)
+                ]
+                params = jax.tree_util.tree_unflatten(
+                    treedef, [p for p, _ in pairs]
+                )
+                opt_state = optim_lib.AdagradState(
+                    accum=jax.tree_util.tree_unflatten(
+                        treedef, [a for _, a in pairs]
+                    )
+                )
+                return params, opt_state, loss
+
+            return step
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)

@@ -41,3 +41,32 @@ def test_fused_handles_non_divisible_sizes(rng):
     w, a = fused_adagrad_update(w0, a0, g, lr=0.1, block=1 << 10, interpret=True)
     np.testing.assert_allclose(np.asarray(a), want_a, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(w), want_w, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_fused_adagrad_matches_plain(rng):
+    """CTRTrainer(fused_adagrad=True) reproduces the optax-adagrad trainer's
+    trajectory exactly (interpret mode here; Mosaic path on a real chip)."""
+    import jax
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models import fm
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+    n, f, nnz, k = 64, 128, 6, 4
+    batch = {
+        "fids": rng.integers(0, f, size=(n, nnz)).astype(np.int32),
+        "fields": np.zeros((n, nnz), np.int32),
+        "vals": np.ones((n, nnz), np.float32),
+        "mask": np.ones((n, nnz), np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    params = fm.init(jax.random.PRNGKey(0), f, k)
+    cfg = TrainConfig(learning_rate=0.1)
+    plain = CTRTrainer(params, fm.logits, cfg)
+    fused = CTRTrainer(params, fm.logits, cfg, fused_adagrad=True)
+    lp = plain.fit_fullbatch_scan(batch, 10)
+    lf = fused.fit_fullbatch_scan(batch, 10)
+    np.testing.assert_allclose(lf, lp, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(
+        np.asarray(fused.params["w"]), np.asarray(plain.params["w"]),
+        rtol=1e-6, atol=1e-7,
+    )

@@ -129,3 +129,32 @@ def test_single_writer_matches_async_ps_oracle(tmp_path, updater):
         shm._data.get(key), ref._data[key], rtol=2e-5, atol=2e-6
     )
     shm.close()
+
+
+def test_epochs_exact_beyond_float32_range(tmp_path):
+    """Epochs are stored as two fp32 limbs: values past 2^24 stay exact
+    (a raw fp32 ledger would saturate and wedge the SSP gate)."""
+    ps = _make(tmp_path, updater="sgd")
+    big = (1 << 24) + 12345
+    ps.advance_epoch(0, big)
+    ps.advance_epoch(1, big + 3)
+    epochs, _ = ps._ledger()
+    assert int(epochs[0]) == big
+    assert int(epochs[1]) == big + 3
+    # pull within the bound succeeds; ahead of it is withheld
+    assert ps.pull([1], worker_epoch=big + 3) is not None
+    assert ps.pull([1], worker_epoch=big + 100) is None
+    ps.close()
+
+
+def test_advance_epoch_cannot_resurrect_unrouted_worker(tmp_path):
+    """Routing flags live in coordinator-owned rows: a worker's epoch write
+    concurrent with unroute_worker can no longer flip the flag back."""
+    ps = _make(tmp_path, updater="sgd")
+    ps.unroute_worker(0)
+    ps.advance_epoch(0, 5)  # the race: epoch write after the unroute
+    assert not ps._routed(0)
+    assert not ps.push(0, {5: np.ones(DIM, np.float32)}, worker_epoch=5)
+    ps.readmit_worker(0)
+    assert ps.push(0, {5: np.ones(DIM, np.float32)}, worker_epoch=5)
+    ps.close()
