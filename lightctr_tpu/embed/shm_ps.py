@@ -49,6 +49,8 @@ STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
 _WORKER_SHIFT = 48  # shadow composite keys: (worker << 48) | key
 _ROUTE_BASE = 1 << 32  # meta keys for routing flags (distinct writer per row)
 _LIMB = 1 << 24  # fp32 exact-integer range: epochs stored as (lo, hi) limbs
+_FORMAT_KEY = 1 << 33  # meta row holding [format_version, 0]
+_FORMAT_VERSION = 2.0  # v2 = limb-encoded epochs + separate routing rows
 
 
 def _encode_epoch(epoch: int) -> np.ndarray:
@@ -126,6 +128,7 @@ class ShmAsyncParamServer:
             stores, dim, n_workers, updater, learning_rate,
             staleness_threshold, dcasgd_lambda, momentum_rate, eps, seed,
         )
+        ps._meta.set(_FORMAT_KEY, np.array([_FORMAT_VERSION, 0.0], np.float32))
         for w in range(n_workers):
             ps._meta.set(w, _encode_epoch(0))
             ps._meta.set(_ROUTE_BASE + w, np.array([1.0, 0.0], np.float32))
@@ -152,6 +155,14 @@ class ShmAsyncParamServer:
             ShmKV.open(base_path + ".shadow"),
             ShmKV.open(base_path + ".meta"),
         )
+        fmt = stores[3].get(_FORMAT_KEY)
+        if fmt is None or float(fmt[0]) != _FORMAT_VERSION:
+            found = None if fmt is None else float(fmt[0])
+            raise RuntimeError(
+                f"{base_path}.meta ledger format {found} != "
+                f"{_FORMAT_VERSION}: recreate the store (a stale-layout "
+                "ledger would silently decode garbage epochs)"
+            )
         dim = stores[0].dim
         return cls(
             stores, dim, n_workers, updater, learning_rate,

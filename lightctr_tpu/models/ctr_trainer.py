@@ -96,6 +96,13 @@ class CTRTrainer:
                 "fused_adagrad is not supported with compress_bits (the "
                 "compressed ring step applies the optax update path)"
             )
+        if fused_adagrad and param_shardings is not None:
+            raise ValueError(
+                "fused_adagrad is not supported with param_shardings: GSPMD "
+                "has no partitioning rule for the Pallas call on row-sharded "
+                "tables (it would force an all-gather of the largest arrays); "
+                "use the optax path for sharded params"
+            )
         self.fused_adagrad = fused_adagrad
         self.tx = optimizer or optim_lib.adagrad(cfg.learning_rate)
         self.mesh = mesh

@@ -158,3 +158,17 @@ def test_advance_epoch_cannot_resurrect_unrouted_worker(tmp_path):
     ps.readmit_worker(0)
     assert ps.push(0, {5: np.ones(DIM, np.float32)}, worker_epoch=5)
     ps.close()
+
+
+def test_open_rejects_stale_ledger_format(tmp_path):
+    """open() refuses a meta store without the current format stamp instead
+    of silently decoding garbage epochs."""
+    from lightctr_tpu.embed import shm_ps
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+
+    ps = _make(tmp_path)
+    # simulate a pre-v2 ledger: clobber the format row
+    ps._meta.set(shm_ps._FORMAT_KEY, np.array([1.0, 0.0], np.float32))
+    ps.close()
+    with pytest.raises(RuntimeError, match="ledger format"):
+        ShmAsyncParamServer.open(str(tmp_path / "ps"), n_workers=2)
