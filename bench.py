@@ -53,16 +53,22 @@ def main():
 
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
     params = fm.init(jax.random.PRNGKey(0), feature_cnt, 8)
-    # Dense matmul formulation: the batch is constant across the 1000
-    # full-batch epochs, so densify it ONCE and the whole step becomes MXU
-    # matmuls (backward = transposed matmuls, no scatter-adds).  Exact
-    # per-slot parity with the gather path (see fm.densify); the table holds
-    # the COMPACTED vocabulary (touched rows only — ds.compact() above),
-    # matching the reference's per-epoch cost, whose sparse Adagrad skips
-    # untouched rows.  Measured v5e: 0.46 ms/step dense vs 10.8 ms gathered.
     n_rows = len(arrays["labels"])
-    arrays = fm.densify(arrays, feature_cnt)
-    tr = CTRTrainer(params, fm.dense_logits, cfg, fused_fn=fm.dense_logits_with_l2)
+    # Path selection by backend, the way the reference picks AVX codepaths:
+    # - accelerator: dense matmul formulation — the batch is constant across
+    #   the 1000 full-batch epochs, so densify ONCE and the whole step is MXU
+    #   matmuls (backward = transposed matmuls, no scatter-adds; exact
+    #   per-slot parity with the gather path, see fm.densify).  Measured
+    #   v5e: 0.46 ms/step dense vs 10.8 ms gathered.
+    # - CPU fallback: the gathered sparse path — a [1000, 8245] dense matmul
+    #   LOSES to gather+scatter on one host core (28.6k vs 47.5k ex/s).
+    # The table holds the COMPACTED vocabulary either way (touched rows only,
+    # matching the reference's sparse Adagrad skipping untouched rows).
+    if jax.devices()[0].platform == "cpu":
+        tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2)
+    else:
+        arrays = fm.densify(arrays, feature_cnt)
+        tr = CTRTrainer(params, fm.dense_logits, cfg, fused_fn=fm.dense_logits_with_l2)
     epochs = 1000
     # transfer the (constant) batch to device once, outside the timed region —
     # the reference's 9.32 s likewise excludes data loading

@@ -75,6 +75,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--max-depth", type=int, default=6)
     sp.add_argument("--n-classes", type=int, default=1)
 
+    # GBM leaf-index -> FTRL-LR stacked model (BASELINE config 5)
+    def positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+        return n
+
+    sp = scoreable(common(sub.add_parser("stack"), lr=0.6, batch=0))
+    sp.add_argument("--n-trees", type=int, default=10)
+    sp.add_argument("--max-depth", type=int, default=6)
+    sp.add_argument("--lr-steps", type=positive_int, default=200)
+
     sp = common(sub.add_parser("gmm"), lr=0.0, batch=0)
     sp.add_argument("--clusters", type=int, default=10)
 
@@ -223,6 +235,25 @@ def main(argv=None) -> int:
         report["train"] = model.evaluate(ds.features, y)
         if getattr(args, "dump_scores", None):
             _dump_scores(args.dump_scores, model.predict_proba(ds.features), report)
+
+    elif args.model == "stack":
+        from lightctr_tpu.models import gbm
+        from lightctr_tpu.models.stacking import GBMLRStack
+
+        ds = load_dense_csv(args.data)
+        stack = GBMLRStack(
+            gbm.GBMConfig(
+                n_trees=args.n_trees, max_depth=args.max_depth,
+                seed=args.seed, shrinkage=args.lr,
+            ),
+            lr_steps=args.lr_steps,
+        )
+        y = (ds.labels > 0).astype(np.float32)
+        hist = stack.fit(ds.features, y)
+        report["final_loss"] = hist["lr_loss"][-1]
+        report["train"] = stack.evaluate(ds.features, y)
+        if getattr(args, "dump_scores", None):
+            _dump_scores(args.dump_scores, stack.predict_proba(ds.features), report)
 
     elif args.model == "gmm":
         from lightctr_tpu.models import gmm

@@ -168,3 +168,35 @@ class HeartbeatMonitor:
         if self._thread is not None:
             self._thread.join(timeout=2 * self.period_s)
             self._thread = None
+
+
+def wire_heartbeat(monitor: "HeartbeatMonitor", ps, n_workers=None) -> None:
+    """Route the monitor's death/recovery events into a parameter server's
+    unroute_worker/readmit_worker (master.h:202-262 semantics), shared by the
+    in-process and shared-memory PS.  PS workers beat with ``str(worker_id)``;
+    non-integer (or negative) names belong to other components sharing the
+    monitor and are ignored.  ``n_workers`` adds an exclusive upper bound on
+    accepted ids — required for the shm PS, whose fixed-capacity ledger a
+    stray id would grow; leave None for the in-process PS, which accepts any
+    worker id (its n_workers only sizes DCASGD shadows)."""
+
+    def to_wid(w):
+        try:
+            wid = int(w)
+        except (TypeError, ValueError):
+            return None
+        if wid < 0 or (n_workers is not None and wid >= n_workers):
+            return None
+        return wid
+
+    def on_dead(w):
+        wid = to_wid(w)
+        if wid is not None:
+            ps.unroute_worker(wid)
+
+    def on_recover(w):
+        wid = to_wid(w)
+        if wid is not None:
+            ps.readmit_worker(wid)
+
+    monitor.add_listener(on_dead=on_dead, on_recover=on_recover)

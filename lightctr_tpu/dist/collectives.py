@@ -169,7 +169,13 @@ def ring_broadcast(mesh: Mesh, stacked_tree, axis: str = "data"):
     return jax.tree_util.tree_map(lambda leaf: fn(leaf.reshape((-1,) + leaf.shape[2:])).reshape(leaf.shape), stacked_tree)
 
 
-def all_to_all_exchange(mesh: Mesh, stacked: jax.Array, axis: str = "data") -> jax.Array:
+def all_to_all_exchange(
+    mesh: Mesh,
+    stacked: jax.Array,
+    axis: str = "data",
+    compress_bits: int | None = None,
+    compress_range: float = 1.0,
+) -> jax.Array:
     """All-to-all block exchange — the collective under sharded-embedding
     push/pull (SURVEY.md §2.7: the reference's DHT-routed per-PS key batches
     become ``all_to_all`` on a mesh axis).
@@ -178,16 +184,42 @@ def all_to_all_exchange(mesh: Mesh, stacked: jax.Array, axis: str = "data") -> j
     FOR device j (e.g. the lookup requests i wants shard j to serve).
     Returns [n, n, ...] where slice [j, i] on device j is what i sent it —
     i.e. the transpose of the first two axes, moved over the interconnect.
+
+    ``compress_bits``: when set (8 or 16), every float block is
+    quantile-coded before the exchange and decoded after — the PS-traffic
+    counterpart of the ring codec (the reference fp16-codes EVERY value the
+    PS serves or receives, paramserver.h:161-163).  ``compress_range`` must
+    bound the block magnitudes (embedding rows / row gradients) or they
+    clip.  Integer payloads (key requests) must ride uncompressed.
     """
     n = mesh.shape[axis]
     if stacked.ndim < 2 or stacked.shape[0] != n or stacked.shape[1] != n:
         raise ValueError(
             f"expected leading dims [{n}, {n}, ...], got {stacked.shape}"
         )
+    if compress_bits is not None and not jnp.issubdtype(
+        stacked.dtype, jnp.floating
+    ):
+        raise ValueError(
+            f"compress_bits needs a float payload, got {stacked.dtype}"
+        )
+
+    if compress_bits is not None:
+        from lightctr_tpu.ops import quantize
+
+        table = quantize.build_table(
+            -compress_range, compress_range, bits=compress_bits, mode="uniform"
+        )
+
+        def wire(buf):
+            return quantize.extract(table, quantize.compress(table, buf))
+    else:
+        def wire(buf):
+            return buf
 
     def local(x):  # x: [1, n, ...] this device's outgoing blocks
         # concat on the same axis keeps the received blocks sender-indexed
-        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=1)
+        return jax.lax.all_to_all(wire(x), axis, split_axis=1, concat_axis=1)
 
     fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     return fn(stacked)

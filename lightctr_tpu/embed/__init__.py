@@ -9,8 +9,10 @@ from lightctr_tpu.embed.table import (
     sparse_dcasgd_update,
 )
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
 
 __all__ = [
+    "ShmAsyncParamServer",
     "init_table",
     "init_adagrad_state",
     "init_dcasgd_state",

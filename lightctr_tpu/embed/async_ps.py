@@ -185,26 +185,12 @@ class AsyncParamServer:
     def attach_heartbeat(self, monitor) -> None:
         """Wire a :class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` so
         its death/recovery events drive routing: dead -> unroute, returning
-        beat -> readmit.  PS workers beat with ``str(worker_id)``; names that
-        are not integers belong to other components and are ignored here."""
+        beat -> readmit (shared wiring — see ``dist.bootstrap.wire_heartbeat``).
+        No upper id bound: push/pull accept any worker id here (n_workers
+        only sizes the DCASGD shadow copies)."""
+        from lightctr_tpu.dist.bootstrap import wire_heartbeat
 
-        def to_wid(w):
-            try:
-                return int(w)
-            except (TypeError, ValueError):
-                return None
-
-        def on_dead(w):
-            wid = to_wid(w)
-            if wid is not None:
-                self.unroute_worker(wid)
-
-        def on_recover(w):
-            wid = to_wid(w)
-            if wid is not None:
-                self.readmit_worker(wid)
-
-        monitor.add_listener(on_dead=on_dead, on_recover=on_recover)
+        wire_heartbeat(monitor, self)
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:

@@ -158,6 +158,8 @@ class ShmAsyncParamServer:
         fmt = stores[3].get(_FORMAT_KEY)
         if fmt is None or float(fmt[0]) != _FORMAT_VERSION:
             found = None if fmt is None else float(fmt[0])
+            for s in stores:  # don't leak the four fresh mmap handles
+                s.close()
             raise RuntimeError(
                 f"{base_path}.meta ledger format {found} != "
                 f"{_FORMAT_VERSION}: recreate the store (a stale-layout "
@@ -209,6 +211,17 @@ class ShmAsyncParamServer:
     def _routed(self, worker_id: int) -> bool:
         row = self._meta.get(_ROUTE_BASE + int(worker_id))
         return row is None or bool(row[0] > 0.5)
+
+    def attach_heartbeat(self, monitor) -> None:
+        """Wire a :class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` on
+        the coordinator: dead -> unroute, returning beat -> readmit — the
+        same contract as ``AsyncParamServer.attach_heartbeat``, with the
+        routing flags visible to every worker PROCESS through the shared
+        meta store.  Keep the monitor stopped before :meth:`close` — the
+        listeners write through this handle."""
+        from lightctr_tpu.dist.bootstrap import wire_heartbeat
+
+        wire_heartbeat(monitor, self, self.n_workers)
 
     # -- protocol ----------------------------------------------------------
 

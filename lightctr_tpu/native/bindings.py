@@ -146,6 +146,14 @@ class ShmKV:
         self._h = handle
         self.dim = dim
 
+    @property
+    def _handle(self):
+        """Live handle or a loud error — the C side has no NULL guards, so a
+        use-after-close must fail here, not as a segfault in shmkv_*."""
+        if self._h is None:
+            raise RuntimeError("ShmKV store is closed")
+        return self._h
+
     @classmethod
     def create(cls, path: str, capacity: int, dim: int) -> "ShmKV":
         l_ = lib()
@@ -168,15 +176,15 @@ class ShmKV:
 
     @property
     def capacity(self) -> int:
-        return lib().shmkv_capacity(self._h)
+        return lib().shmkv_capacity(self._handle)
 
     @property
     def used(self) -> int:
-        return lib().shmkv_used(self._h)
+        return lib().shmkv_used(self._handle)
 
     def get(self, key: int) -> Optional[np.ndarray]:
         out = np.zeros(self.dim, np.float32)
-        rc = lib().shmkv_get(self._h, key, _fptr(out))
+        rc = lib().shmkv_get(self._handle, key, _fptr(out))
         return out if rc == 0 else None
 
     _SENTINEL = (1 << 64) - 1  # EMPTY slot marker in shm_kv.cpp
@@ -190,7 +198,7 @@ class ShmKV:
         v = np.ascontiguousarray(value, np.float32)
         if v.shape != (self.dim,):
             raise ValueError(f"value shape {v.shape} != ({self.dim},)")
-        rc = lib().shmkv_set(self._h, key, _fptr(v))
+        rc = lib().shmkv_set(self._handle, key, _fptr(v))
         if rc == -2:
             raise RuntimeError("store full")
 
@@ -199,7 +207,7 @@ class ShmKV:
         v = np.ascontiguousarray(delta, np.float32)
         if v.shape != (self.dim,):
             raise ValueError(f"delta shape {v.shape} != ({self.dim},)")
-        rc = lib().shmkv_add(self._h, key, _fptr(v))
+        rc = lib().shmkv_add(self._handle, key, _fptr(v))
         if rc == -2:
             raise RuntimeError("store full")
 
@@ -208,13 +216,13 @@ class ShmKV:
         out = np.zeros((len(ks), self.dim), np.float32)
         found = np.zeros(len(ks), np.uint8)
         lib().shmkv_get_batch(
-            self._h, ks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            self._handle, ks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
             len(ks), _fptr(out), found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
         return out, found.astype(bool)
 
     def sync(self) -> None:
-        lib().shmkv_sync(self._h)
+        lib().shmkv_sync(self._handle)
 
     def close(self) -> None:
         if self._h:

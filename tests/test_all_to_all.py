@@ -50,3 +50,22 @@ def test_sharded_lookup_roundtrip(rng):
     replies = np.asarray(all_to_all_exchange(mesh, jnp.asarray(served)))  # [i, j, k, d]
     want = table[reqs]  # ground truth gather
     np.testing.assert_allclose(replies, want, rtol=1e-6)
+
+
+def test_exchange_wire_compressed(rng):
+    """PS-traffic codec parity (paramserver.h:161-163 fp16-codes every PS
+    value): the coded exchange routes the same blocks within quantization
+    tolerance, and integer payloads are refused."""
+    mesh = make_mesh(MeshSpec(data=4))
+    x = jnp.asarray(
+        (rng.normal(size=(4, 4, 6, 3)) * 0.2).astype(np.float32).clip(-1, 1)
+    )
+    out16 = np.asarray(all_to_all_exchange(mesh, x, compress_bits=16))
+    want = np.swapaxes(np.asarray(x), 0, 1)
+    np.testing.assert_allclose(out16, want, atol=2 * 2.0 / (1 << 16))
+    out8 = np.asarray(all_to_all_exchange(mesh, x, compress_bits=8))
+    np.testing.assert_allclose(out8, want, atol=2 * 2.0 / (1 << 8))
+    with pytest.raises(ValueError, match="float payload"):
+        all_to_all_exchange(
+            mesh, jnp.zeros((4, 4, 2), jnp.int32), compress_bits=8
+        )

@@ -148,3 +148,15 @@ def test_cli_embed(capsys, text_file, tmp_path):
     )
     assert np.isfinite(report["final_loss"])
     assert out.exists() and out.stat().st_size > 0
+
+
+def test_cli_stack(capsys, dense_file, tmp_path):
+    scores = tmp_path / "scores.txt"
+    report = run_cli(
+        capsys, "stack", "--data", dense_file, "--n-trees", "2",
+        "--max-depth", "3", "--lr-steps", "50",
+        "--dump-scores", str(scores),
+    )
+    assert np.isfinite(report["final_loss"])
+    assert "auc" in report["train"]
+    assert scores.exists()

@@ -73,3 +73,19 @@ def test_monitor_thread_drives_unrouting():
         assert ps.push(0, g, worker_epoch=0) is True
     finally:
         mon.stop()
+
+
+def test_async_ps_unroutes_ids_beyond_n_workers():
+    """The in-process PS accepts any worker id (n_workers only sizes DCASGD
+    shadows), so heartbeat wiring must unroute ids >= n_workers too."""
+    ps = AsyncParamServer(dim=1, updater="sgd", n_workers=1)
+    clock = [0.0]
+    mon = HeartbeatMonitor(clock=lambda: clock[0], stale_after_s=10, dead_after_s=20)
+    ps.attach_heartbeat(mon)
+    mon.beat("3")
+    clock[0] = 25.0
+    mon.check()
+    g = {1: np.asarray([0.5], np.float32)}
+    assert ps.push(3, g, worker_epoch=0) is False
+    mon.beat("3")
+    assert ps.push(3, g, worker_epoch=0) is True

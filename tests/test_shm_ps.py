@@ -172,3 +172,30 @@ def test_open_rejects_stale_ledger_format(tmp_path):
     ps.close()
     with pytest.raises(RuntimeError, match="ledger format"):
         ShmAsyncParamServer.open(str(tmp_path / "ps"), n_workers=2)
+
+
+def test_heartbeat_drives_shared_routing(tmp_path):
+    """Coordinator-side HeartbeatMonitor unroutes/readmits through the
+    SHARED meta store: a second process handle observes the flag flips."""
+    import time
+
+    from lightctr_tpu.dist.bootstrap import HeartbeatMonitor
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+
+    ps = _make(tmp_path, updater="sgd")
+    other = ShmAsyncParamServer.open(str(tmp_path / "ps"), n_workers=2)
+    mon = HeartbeatMonitor(stale_after_s=0.05, dead_after_s=0.1, period_s=0.02)
+    ps.attach_heartbeat(mon)
+    mon.beat("0")
+    mon.start()
+    try:
+        g = {1: np.ones(DIM, np.float32)}
+        assert other.push(0, g, worker_epoch=0)
+        time.sleep(0.3)  # monitor thread declares worker 0 dead
+        assert not other.push(0, g, worker_epoch=0)  # other PROCESS handle
+        mon.beat("0")  # re-registration readmits
+        assert other.push(0, g, worker_epoch=0)
+    finally:
+        mon.stop()
+        other.close()
+        ps.close()
