@@ -126,7 +126,7 @@ class CTRTrainer:
         )
         if self._param_sharding is not None:
             self.params = jax.device_put(self.params, self._param_sharding)
-        self.opt_state = self.tx.init(self.params)  # inherits params' shardings
+        self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
         self._step = jax.jit(self._build_step(), donate_argnums=(0, 1))
@@ -256,7 +256,12 @@ class CTRTrainer:
         self.params = tree_copy(params)
         if self._param_sharding is not None:
             self.params = jax.device_put(self.params, self._param_sharding)
-        self.opt_state = self.tx.init(self.params)
+        self.opt_state = self._init_opt_state(self.params)
+
+    def _init_opt_state(self, params):
+        """Optimizer-state factory — subclasses with non-optax table state
+        override this (so no transient full-size optax state is allocated)."""
+        return self.tx.init(params)
 
     def _put(self, batch: Dict[str, np.ndarray]):
         if self.mesh is not None:

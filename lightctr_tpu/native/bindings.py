@@ -57,6 +57,14 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float),
     ]
+    lib.ffm_parse_chunk.restype = ctypes.c_long
+    lib.ffm_parse_chunk.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long,
+        ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_long),
+    ]
     lib.shmkv_create.restype = ctypes.c_void_p
     lib.shmkv_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
     lib.shmkv_open.restype = ctypes.c_void_p
@@ -136,6 +144,43 @@ def parse_libffm_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
         if rc != 0:
             raise ValueError(f"{path}: parse failed (rc={rc})")
     return fields, fids, vals, mask, labels
+
+
+def parse_libffm_chunk(
+    path: str, offset: int, max_rows: int, max_nnz: int
+) -> Tuple[dict, int, int]:
+    """Parse up to ``max_rows`` rows starting at byte ``offset`` into padded
+    arrays.  Returns ``(arrays, rows_parsed, next_offset)`` where ``arrays``
+    has fields/fids/vals/mask/labels of leading dim ``max_rows`` (tail rows
+    zero when fewer were available).  Rows longer than ``max_nnz`` are
+    truncated — the streaming-generator semantics."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    fields = np.zeros((max_rows, max_nnz), np.int32)
+    fids = np.zeros((max_rows, max_nnz), np.int32)
+    vals = np.zeros((max_rows, max_nnz), np.float32)
+    mask = np.zeros((max_rows, max_nnz), np.float32)
+    labels = np.zeros((max_rows,), np.float32)
+    off = ctypes.c_long(offset)
+    err_line = ctypes.c_long()
+    rc = l_.ffm_parse_chunk(
+        path.encode(), ctypes.byref(off), max_rows, max_nnz,
+        _iptr(fields), _iptr(fids), _fptr(vals), _fptr(mask), _fptr(labels),
+        ctypes.byref(err_line),
+    )
+    if rc == -1:
+        raise OSError(f"cannot read {path} at offset {offset}")
+    if rc == -2:
+        raise ValueError(
+            f"{path}: bad libFFM token ~{err_line.value} lines after "
+            f"offset {offset}"
+        )
+    arrays = {
+        "fields": fields, "fids": fids, "vals": vals, "mask": mask,
+        "labels": labels,
+    }
+    return arrays, int(rc), int(off.value)
 
 
 class ShmKV:

@@ -123,4 +123,73 @@ int ffm_parse(const char* path, long n_rows, long max_nnz, int* fields,
     return 0;
 }
 
+// Streaming chunk parse: up to max_rows rows starting at byte *offset.
+// Rows longer than max_nnz are TRUNCATED (streaming semantics — the Python
+// generator does the same), still validating the dropped tokens.  Fills
+// caller-allocated [max_rows, max_nnz] arrays (zero-padded) and labels;
+// advances *offset past the last consumed line.  fold_fid/fold_field > 0
+// reduce ids modulo the fold (the hashing trick) ON THE LONG VALUE —
+// matching the Python generator, which folds exact ints before any int32
+// narrowing.  Returns rows parsed >= 0, -1 on io error, -2 on parse error,
+// -3 when an id exceeds int32 range and no fold was given (*err_line =
+// line index within this chunk, 1-based).
+long ffm_parse_chunk(const char* path, long* offset, long max_rows,
+                     long max_nnz, long fold_fid, long fold_field,
+                     int* fields, int* fids, float* vals,
+                     float* mask, float* labels, long* err_line) {
+    FILE* f = fopen(path, "r");
+    if (!f) return -1;
+    if (fseek(f, *offset, SEEK_SET) != 0) { fclose(f); return -1; }
+    char* line = nullptr;
+    size_t cap = 0;
+    long r = 0, lineno = 0;
+    ssize_t len;
+    memset(fields, 0, sizeof(int) * max_rows * max_nnz);
+    memset(fids, 0, sizeof(int) * max_rows * max_nnz);
+    memset(vals, 0, sizeof(float) * max_rows * max_nnz);
+    memset(mask, 0, sizeof(float) * max_rows * max_nnz);
+    memset(labels, 0, sizeof(float) * max_rows);
+    while (r < max_rows && (len = getline(&line, &cap, f)) != -1) {
+        ++lineno;
+        const char* p = line;
+        skip_ws(p);
+        if (*p == '\n' || *p == '\0') { *offset = ftell(f); continue; }
+        char* end = nullptr;
+        double label = strtod(p, &end);
+        if (end == p) {
+            free(line); fclose(f); *err_line = lineno; return -2;
+        }
+        labels[r] = (float)label;
+        p = end;
+        long j = 0;
+        while (true) {
+            skip_ws(p);
+            if (*p == '\n' || *p == '\0') break;
+            long field, fid; double val;
+            if (!parse_token(p, field, fid, val)) {
+                free(line); fclose(f); *err_line = lineno; return -2;
+            }
+            if (fold_fid > 0) fid %= fold_fid;
+            if (fold_field > 0) field %= fold_field;
+            if (fid > 2147483647L || field > 2147483647L ||
+                fid < 0 || field < 0) {
+                free(line); fclose(f); *err_line = lineno; return -3;
+            }
+            if (j < max_nnz) {
+                const long o = r * max_nnz + j;
+                fields[o] = (int)field;
+                fids[o] = (int)fid;
+                vals[o] = (float)val;
+                mask[o] = 1.0f;
+            }
+            ++j;
+        }
+        ++r;
+        *offset = ftell(f);
+    }
+    free(line);
+    fclose(f);
+    return r;
+}
+
 }  // extern "C"

@@ -76,3 +76,32 @@ def test_cli_plsa_and_embed(tmp_path):
     assert out.returncode == 0, out.stderr[-2000:]
     rep = json.loads(out.stdout.strip().splitlines()[-1])
     assert os.path.exists(emb_path) and rep["n_pairs"] > 0
+
+
+def test_native_stream_matches_python(tmp_path, rng):
+    """The C chunk parser and the Python generator yield identical batch
+    streams (incl. truncation of over-long rows, id folding, tail padding)."""
+    from lightctr_tpu.native.bindings import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    path = tmp_path / "s.ffm"
+    with open(path, "w") as f:
+        for i in range(37):
+            nnz = rng.integers(1, 9)  # some rows exceed max_nnz=5 -> truncate
+            toks = " ".join(
+                f"{rng.integers(0, 7)}:{rng.integers(0, 999)}:{rng.random():.3f}"
+                for _ in range(nnz)
+            )
+            f.write(f"{i % 2} {toks}\n")
+            if i % 11 == 0:
+                f.write("\n")  # blank lines are skipped
+    kw = dict(batch_size=8, max_nnz=5, feature_cnt=100, field_cnt=4)
+    for drop in (True, False):
+        a = list(iter_libffm_batches(str(path), drop_remainder=drop, native=True, **kw))
+        b = list(iter_libffm_batches(str(path), drop_remainder=drop, native=False, **kw))
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert set(x) == set(y)
+            for k in y:
+                np.testing.assert_array_equal(x[k], y[k])
