@@ -89,19 +89,17 @@ def _iter_native(path, batch_size, max_nnz, feature_cnt, field_cnt, drop_remaind
 
     offset = 0
     while True:
-        arrays, rows, offset = parse_libffm_chunk(path, offset, batch_size, max_nnz)
+        # folding happens natively on the exact long value (pre-narrowing,
+        # same as the Python generator), so no np.mod post-pass is needed —
+        # and padded slots stay zero because the fold runs per real token
+        arrays, rows, offset = parse_libffm_chunk(
+            path, offset, batch_size, max_nnz,
+            fold_fid=feature_cnt or 0, fold_field=field_cnt or 0,
+        )
         if rows == 0:
             return
         if rows < batch_size and drop_remainder:
             return
-        if feature_cnt is not None:
-            np.mod(arrays["fids"], feature_cnt, out=arrays["fids"])
-        if field_cnt is not None:
-            np.mod(arrays["fields"], field_cnt, out=arrays["fields"])
-        # id-folding must not mark padded slots: re-zero where mask is 0
-        pad = arrays["mask"] == 0.0
-        arrays["fids"][pad] = 0
-        arrays["fields"][pad] = 0
         row_mask = np.zeros((batch_size,), np.float32)
         row_mask[:rows] = 1.0
         arrays["row_mask"] = row_mask

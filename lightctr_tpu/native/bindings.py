@@ -60,7 +60,8 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.ffm_parse_chunk.restype = ctypes.c_long
     lib.ffm_parse_chunk.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long,
-        ctypes.c_long, ctypes.POINTER(ctypes.c_int),
+        ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_long),
@@ -147,13 +148,16 @@ def parse_libffm_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
 
 
 def parse_libffm_chunk(
-    path: str, offset: int, max_rows: int, max_nnz: int
+    path: str, offset: int, max_rows: int, max_nnz: int,
+    fold_fid: int = 0, fold_field: int = 0,
 ) -> Tuple[dict, int, int]:
     """Parse up to ``max_rows`` rows starting at byte ``offset`` into padded
     arrays.  Returns ``(arrays, rows_parsed, next_offset)`` where ``arrays``
     has fields/fids/vals/mask/labels of leading dim ``max_rows`` (tail rows
     zero when fewer were available).  Rows longer than ``max_nnz`` are
-    truncated — the streaming-generator semantics."""
+    truncated — the streaming-generator semantics.  ``fold_fid``/``fold_field``
+    > 0 fold ids modulo the vocabulary natively on the exact long value (the
+    hashing trick), matching the Python generator's pre-narrowing fold."""
     l_ = lib()
     if l_ is None:
         raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
@@ -166,6 +170,7 @@ def parse_libffm_chunk(
     err_line = ctypes.c_long()
     rc = l_.ffm_parse_chunk(
         path.encode(), ctypes.byref(off), max_rows, max_nnz,
+        fold_fid, fold_field,
         _iptr(fields), _iptr(fids), _fptr(vals), _fptr(mask), _fptr(labels),
         ctypes.byref(err_line),
     )
@@ -176,6 +181,19 @@ def parse_libffm_chunk(
             f"{path}: bad libFFM token ~{err_line.value} lines after "
             f"offset {offset}"
         )
+    if rc == -3:
+        missing = []
+        if fold_fid <= 0:
+            missing.append("feature_cnt")
+        if fold_field <= 0:
+            missing.append("field_cnt")
+        raise ValueError(
+            f"{path}: id exceeds int32 ~{err_line.value} lines after offset "
+            f"{offset}; pass {' / '.join(missing) or 'a larger fold'} to fold "
+            "large ids into the vocabulary"
+        )
+    if rc < 0:
+        raise RuntimeError(f"{path}: native chunk parse failed (rc={rc})")
     arrays = {
         "fields": fields, "fids": fids, "vals": vals, "mask": mask,
         "labels": labels,

@@ -169,8 +169,10 @@ long ffm_parse_chunk(const char* path, long* offset, long max_rows,
             if (!parse_token(p, field, fid, val)) {
                 free(line); fclose(f); *err_line = lineno; return -2;
             }
-            if (fold_fid > 0) fid %= fold_fid;
-            if (fold_field > 0) field %= fold_field;
+            // Python-% semantics (result takes the divisor's sign) so both
+            // paths agree on negative ids too
+            if (fold_fid > 0) { fid %= fold_fid; if (fid < 0) fid += fold_fid; }
+            if (fold_field > 0) { field %= fold_field; if (field < 0) field += fold_field; }
             if (fid > 2147483647L || field > 2147483647L ||
                 fid < 0 || field < 0) {
                 free(line); fclose(f); *err_line = lineno; return -3;

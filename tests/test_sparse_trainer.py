@@ -1,6 +1,5 @@
 """SparseTableCTRTrainer: O(touched) updates == dense Adagrad trainer."""
 
-import time
 
 import jax
 import jax.numpy as jnp
@@ -75,29 +74,27 @@ def test_widedeep_mixed_dense_and_sparse_leaves(rng):
 
 
 def test_sparse_step_is_o_touched(rng):
-    """At a 2^18-row table with ~400 touched rows, the sparse step beats the
-    dense step.  On CPU the margin is bounded by XLA's missing buffer
-    donation (each step still copies the table); the gradient+optimizer
-    work it eliminates is what's measured here — the full O(touched)
-    asymptotics need an accelerator's in-place scatter."""
+    """At a 2^18-row table with ~400 touched rows, the sparse step does
+    asymptotically less work than the dense step.  Asserted structurally on
+    the compiled programs' XLA FLOP cost analysis rather than wall-clock,
+    which is load-sensitive on shared machines."""
     f = 1 << 18
     batch = fm_batch(rng, n=64, f=f, nnz=6)
     params = fm.init(jax.random.PRNGKey(0), f, 8)
     cfg = TrainConfig(learning_rate=0.1)
 
-    def timed(tr):
-        tr.train_step(batch)  # compile
-        t0 = time.perf_counter()
-        for _ in range(10):
-            tr.train_step(batch)
-        jax.block_until_ready(tr.params)
-        return time.perf_counter() - t0
+    def flops(tr):
+        args = (tr.params, tr.opt_state, tr._put(batch))
+        cost = tr._step.lower(*args).compile().cost_analysis()
+        return cost.get("flops", 0.0)
 
-    t_dense = timed(CTRTrainer(params, fm.logits, cfg))
-    t_sparse = timed(SparseTableCTRTrainer(
+    f_dense = flops(CTRTrainer(params, fm.logits, cfg))
+    f_sparse = flops(SparseTableCTRTrainer(
         params, fm.logits, cfg, sparse_tables={"w": ["fids"], "v": ["fids"]},
     ))
-    assert t_sparse < t_dense * 0.85, (t_sparse, t_dense)
+    # dense Adagrad walks every one of the 2^18 rows (grad + accum + update);
+    # the sparse step touches ~64*6 rows — orders of magnitude fewer FLOPs
+    assert f_sparse < f_dense * 0.1, (f_sparse, f_dense)
 
 
 def test_rejects_unknown_table_key(rng):

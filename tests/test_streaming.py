@@ -6,6 +6,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from lightctr_tpu.data import load_libffm
 from lightctr_tpu.data.streaming import iter_libffm_batches
@@ -105,3 +106,27 @@ def test_native_stream_matches_python(tmp_path, rng):
             assert set(x) == set(y)
             for k in y:
                 np.testing.assert_array_equal(x[k], y[k])
+
+
+def test_native_large_ids_fold_and_error(tmp_path):
+    """Ids beyond int32: with a fold both paths agree (exact long fold,
+    libffm_parser.cpp ffm_parse_chunk); without one the native path raises
+    instead of silently ending the stream (rc=-3)."""
+    from lightctr_tpu.native.bindings import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    path = tmp_path / "big.ffm"
+    with open(path, "w") as f:
+        f.write("1 3:5000000000:1.0 1:2:0.5\n")
+        f.write("0 2:7:1.0 0:4999999999:2.0\n")
+        f.write("1 2:-5:1.0 1:3:0.5\n")  # negative id: Python-% fold parity
+    kw = dict(batch_size=3, max_nnz=4, feature_cnt=1000, field_cnt=4)
+    a = list(iter_libffm_batches(str(path), native=True, **kw))
+    b = list(iter_libffm_batches(str(path), native=False, **kw))
+    assert len(a) == len(b) == 1
+    for k in b[0]:
+        np.testing.assert_array_equal(a[0][k], b[0][k])
+    assert a[0]["fids"].max() < 1000
+    with pytest.raises(ValueError, match="int32"):
+        list(iter_libffm_batches(str(path), native=True, batch_size=3, max_nnz=4))
