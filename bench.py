@@ -25,14 +25,30 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 
 
-def main():
+DEFAULT_DATA = os.environ.get(
+    "LIGHTCTR_BENCH_DATA", "/root/reference/data/train_sparse.csv"
+)
+
+
+def main(data_path: str | None = None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--data",
+        default=data_path or DEFAULT_DATA,
+        help="libffm-format training file (default: $LIGHTCTR_BENCH_DATA or "
+        "the reference dataset; a synthetic batch is generated when absent)",
+    )
+    args = ap.parse_args([] if data_path is not None else None)
+
     from lightctr_tpu import TrainConfig
     from lightctr_tpu.data import load_libffm
     from lightctr_tpu.models import fm
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
 
     try:
-        ds = load_libffm("/root/reference/data/train_sparse.csv")
+        ds = load_libffm(args.data)
         # compact the vocabulary: the reference's sparse Adagrad skips
         # untouched rows (gradientUpdater.h:143), so its per-epoch cost is
         # O(touched features); a dense table must match by only allocating

@@ -123,6 +123,11 @@ def _dump_scores(path: str, probs, report: dict) -> None:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    # never hang on a wedged accelerator relay: probe from a forked child
+    # and pin CPU on timeout (the bench.py watchdog, applied to the CLI)
+    from lightctr_tpu.utils.devicecheck import ensure_live_backend
+
+    ensure_live_backend()
     import jax
 
     from lightctr_tpu import TrainConfig

@@ -1,4 +1,5 @@
 from lightctr_tpu.dist.collectives import (
+    all_to_all_exchange,
     ring_all_reduce,
     ring_broadcast,
     psum_all_reduce,
@@ -6,6 +7,7 @@ from lightctr_tpu.dist.collectives import (
 from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, initialize_multihost
 
 __all__ = [
+    "all_to_all_exchange",
     "ring_all_reduce",
     "ring_broadcast",
     "psum_all_reduce",

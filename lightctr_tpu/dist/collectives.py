@@ -55,44 +55,66 @@ def _ring_all_reduce_local(
             -compress_range, compress_range, bits=compress_bits, mode="uniform"
         )
 
-        def wire(buf):
-            # codec applied to every transmitted segment — the reference
-            # runs its fp16/int8 codec on every ring Buffer the same way
-            return quantize.extract(table, quantize.compress(table, buf))
-
         if average:
             # pre-divide by n so every partial sum in the reduce phase is a
             # partial MEAN, bounded by max|g| — otherwise mid-ring sums grow
             # toward n*max|g| and saturate the table (systematic clipping,
             # not noise).  The final /n below is skipped in this mode.
             segs = segs / n
-    else:
-        def wire(buf):
-            return buf
+
+        # The hop payload is the uint8/uint16 CODES — decode happens on the
+        # receiving device, so the interconnect moves 1-2 bytes/element, the
+        # way the reference's fp16/int8 codec shrinks every ring Buffer it
+        # ships (ring_collect.h + buffer.h:140-149).  extract(compress(x)) is
+        # deterministic, so decoding receiver-side is bit-identical to the
+        # sender's own decoded view.
+        def rs_step(i, segs):
+            send_idx = (idx - i) % n
+            codes = quantize.compress(table, jnp.take(segs, send_idx, axis=0))
+            recv = jax.lax.ppermute(codes, axis_name, perm)
+            return segs.at[(idx - i - 1) % n].add(quantize.extract(table, recv))
+
+        segs = jax.lax.fori_loop(0, n - 1, rs_step, segs)  # reduce-scatter
+        # rank idx now owns fully-reduced segment (idx + 1) % n.  The
+        # all-gather circulates CODES end to end: the owner encodes once and
+        # every rank (owner included) reconstructs through the same table, so
+        # replicas cannot diverge.  Slots other than `own` start as zeros but
+        # each ag hop forwards only the segment received the previous hop, so
+        # uninitialized slots never ride the wire.
+        own = (idx + 1) % n
+        code_dtype = jnp.uint8 if compress_bits <= 8 else jnp.uint16
+        codes = jnp.zeros(segs.shape, code_dtype)
+        codes = codes.at[own].set(
+            quantize.compress(table, jnp.take(segs, own, axis=0))
+        )
+
+        def ag_step(i, codes):
+            send_idx = (idx + 1 - i) % n
+            buf = jnp.take(codes, send_idx, axis=0)
+            recv = jax.lax.ppermute(buf, axis_name, perm)
+            return codes.at[(idx - i) % n].set(recv)
+
+        codes = jax.lax.fori_loop(0, n - 1, ag_step, codes)  # all-gather
+        return quantize.extract(table, codes).reshape(-1)
 
     def rs_step(i, segs):
         send_idx = (idx - i) % n
-        buf = wire(jnp.take(segs, send_idx, axis=0))
+        buf = jnp.take(segs, send_idx, axis=0)
         recv = jax.lax.ppermute(buf, axis_name, perm)
         return segs.at[(idx - i - 1) % n].add(recv)
 
     segs = jax.lax.fori_loop(0, n - 1, rs_step, segs)  # reduce-scatter
     # rank idx now owns fully-reduced segment (idx + 1) % n.
-    # Code the owned segment BEFORE broadcasting and keep the coded copy
-    # locally too — otherwise the owner's replica (raw) differs from every
-    # receiver's (coded) and the "all-reduced" params diverge across devices.
-    own = (idx + 1) % n
-    segs = segs.at[own].set(wire(jnp.take(segs, own, axis=0)))
 
     def ag_step(i, segs):
         send_idx = (idx + 1 - i) % n
-        buf = jnp.take(segs, send_idx, axis=0)  # already wire-coded
+        buf = jnp.take(segs, send_idx, axis=0)
         recv = jax.lax.ppermute(buf, axis_name, perm)
         return segs.at[(idx - i) % n].set(recv)
 
     segs = jax.lax.fori_loop(0, n - 1, ag_step, segs)  # all-gather
     out = segs.reshape(-1)
-    if average and compress_bits is None:
+    if average:
         out = out / n  # ring_collect.h:61-68 divides by ring size
     return out
 
@@ -186,11 +208,13 @@ def all_to_all_exchange(
     i.e. the transpose of the first two axes, moved over the interconnect.
 
     ``compress_bits``: when set (8 or 16), every float block is
-    quantile-coded before the exchange and decoded after — the PS-traffic
-    counterpart of the ring codec (the reference fp16-codes EVERY value the
-    PS serves or receives, paramserver.h:161-163).  ``compress_range`` must
-    bound the block magnitudes (embedding rows / row gradients) or they
-    clip.  Integer payloads (key requests) must ride uncompressed.
+    quantile-coded before the exchange and the uint8/uint16 CODES are what
+    ride the interconnect; decode happens on the receiving device — the
+    PS-traffic counterpart of the ring codec (the reference fp16-codes EVERY
+    value the PS serves or receives, paramserver.h:161-163).
+    ``compress_range`` must bound the block magnitudes (embedding rows / row
+    gradients) or they clip.  Integer payloads (key requests) ride through
+    the separate varint host codec (`dist.wire.pack_varint`) or uncompressed.
     """
     n = mesh.shape[axis]
     if stacked.ndim < 2 or stacked.shape[0] != n or stacked.shape[1] != n:
@@ -211,15 +235,17 @@ def all_to_all_exchange(
             -compress_range, compress_range, bits=compress_bits, mode="uniform"
         )
 
-        def wire(buf):
-            return quantize.extract(table, quantize.compress(table, buf))
+        def local(x):  # x: [1, n, ...] this device's outgoing blocks
+            # encode BEFORE the collective so the all_to_all operand is the
+            # narrow code array; decode after, on the receiver
+            codes = jax.lax.all_to_all(
+                quantize.compress(table, x), axis, split_axis=1, concat_axis=1
+            )
+            return quantize.extract(table, codes)
     else:
-        def wire(buf):
-            return buf
-
-    def local(x):  # x: [1, n, ...] this device's outgoing blocks
-        # concat on the same axis keeps the received blocks sender-indexed
-        return jax.lax.all_to_all(wire(x), axis, split_axis=1, concat_axis=1)
+        def local(x):  # x: [1, n, ...] this device's outgoing blocks
+            # concat on the same axis keeps the received blocks sender-indexed
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=1)
 
     fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     return fn(stacked)

@@ -1,0 +1,103 @@
+"""Host wire formats for PS traffic that leaves the chip domain.
+
+On-mesh collectives compress with the jittable quantile codec
+(`dist.collectives`, `ops.quantize`); this module is the HOST boundary — the
+byte format for sparse pull/push requests that ride DCN / sockets / files
+between processes, the role of the reference's ZeroMQ ``Buffer`` packing:
+
+  - key streams: VarUint packing (buffer.h:112-128) becomes sorted-delta +
+    zigzag + LEB128 varints (``pack_keys``), implemented natively
+    (``native/varint.cpp``) with a numpy/python fallback.  Sorted unique
+    fids delta-code to tiny integers, so a request that is 8 bytes/key raw
+    typically packs to ~1-2 bytes/key.
+  - float payloads: the fp16 value codec the reference applies to every PS
+    value (paramserver.h:161-163) — numpy half round-trip on host
+    (``pack_values`` / ``unpack_values``).
+
+A packed request frames as: ``n_keys`` varint, then the delta-coded key
+stream — self-describing and byte-order independent.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from lightctr_tpu.native import bindings
+
+
+def _pack_py(vals: np.ndarray) -> bytes:
+    out = bytearray()
+    for v in vals.tolist():
+        u = (v << 1) ^ (v >> 63) if v >= 0 else ((-v) << 1) - 1
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            out.append(b | (0x80 if u else 0))
+            if not u:
+                break
+    return bytes(out)
+
+
+def _unpack_py(buf: bytes, n: int) -> np.ndarray:
+    out = np.empty(n, np.int64)
+    pos = 0
+    for i in range(n):
+        u = 0
+        shift = 0
+        while True:
+            if pos >= len(buf):
+                raise ValueError("truncated varint stream")
+            b = buf[pos]
+            pos += 1
+            u |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        out[i] = (u >> 1) ^ -(u & 1)
+    return out
+
+
+def pack_varint(vals: np.ndarray) -> bytes:
+    """Zigzag+varint pack of an int64 array (native when built)."""
+    v = np.ascontiguousarray(vals, np.int64)
+    if bindings.available():
+        return bindings.varint_pack_native(v)
+    return _pack_py(v)
+
+
+def unpack_varint(buf: bytes, n: int) -> np.ndarray:
+    """Decode exactly ``n`` int64 values."""
+    if bindings.available():
+        return bindings.varint_unpack_native(buf, n)
+    return _unpack_py(buf, n)
+
+
+def pack_keys(keys: np.ndarray) -> bytes:
+    """Compact a key batch: sort, delta, varint — the VarUint request stream.
+    Accepts any integer array; duplicates are preserved (delta 0 = 1 byte)."""
+    k = np.sort(np.asarray(keys, np.int64).reshape(-1))
+    deltas = np.diff(k, prepend=0)
+    header = pack_varint(np.array([k.size], np.int64))
+    return header + pack_varint(deltas)
+
+
+def unpack_keys(buf: bytes) -> np.ndarray:
+    """Inverse of :func:`pack_keys` -> sorted int64 keys."""
+    n = int(unpack_varint(buf[:10], 1)[0])
+    # re-parse from the start, skipping the header's actual byte length
+    hdr_len = len(pack_varint(np.array([n], np.int64)))
+    deltas = unpack_varint(buf[hdr_len:], n)
+    return np.cumsum(deltas)
+
+
+def pack_values(vals: np.ndarray) -> Tuple[bytes, tuple]:
+    """fp16 value codec for PS payloads (paramserver.h:161-163): returns the
+    half-precision bytes and the shape needed to decode."""
+    v = np.asarray(vals, np.float32)
+    return v.astype(np.float16).tobytes(), v.shape
+
+
+def unpack_values(buf: bytes, shape: tuple) -> np.ndarray:
+    return np.frombuffer(buf, np.float16).astype(np.float32).reshape(shape)

@@ -225,6 +225,15 @@ class ShmAsyncParamServer:
 
     # -- protocol ----------------------------------------------------------
 
+    def preload(self, values: Dict[int, np.ndarray]) -> None:
+        """Coordinator-side row initialization BEFORE workers start — the
+        master's ``syncInitializer`` broadcast of starting parameters
+        (ring_collect.h:74-79 / master.h:146-190).  Rows written here are
+        never lazy-inited by workers, so every process trains from the same
+        deterministic start."""
+        for k, v in values.items():
+            self._data.set(int(k), np.asarray(v, np.float32).reshape(self.dim))
+
     def _lazy_init(self, key: int) -> np.ndarray:
         """First touch creates ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339)
         via atomic add from the zero row ShmKV inserts."""

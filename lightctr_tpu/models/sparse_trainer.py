@@ -29,9 +29,13 @@ zero gradient there, so neither their weights nor their accumulators move
 by ``inv``, so they carry zero gradient and their scatter contribution is
 a no-op ``add``.
 
-Scope: Adagrad (the reference PS's workhorse); single-device or
-data-sharded batches (no param_shardings/compress_bits — those paths keep
-the dense trainer).
+Scope: Adagrad (the reference PS's workhorse); single-device, data-sharded
+batches, and PS-style ``param_shardings`` (tables row-sharded over the
+``embed`` axis: the touched-row gather/scatter compose with GSPMD — XLA
+inserts the cross-shard collectives around the O(touched) row ops, which
+is exactly the reference's worker→PS-shard pull/push topology,
+pull.h:50-99 / distributed_algo_abst.h:176-280).  ``compress_bits`` keeps
+the dense trainer (the ring codec path assumes replicated params).
 
 Platform note: the step donates (params, opt_state), so on accelerators
 the row scatters update the tables in place and the step is truly
@@ -71,6 +75,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         l2_fn=None,
         fused_fn=None,
         mesh=None,
+        param_shardings=None,
         eps: float = 1e-7,
     ):
         if not sparse_tables:
@@ -97,7 +102,8 @@ class SparseTableCTRTrainer(CTRTrainer):
                 owner[f] = k
         self._eps = eps
         super().__init__(
-            params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh
+            params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
+            param_shardings=param_shardings,
         )
 
     # -- state -------------------------------------------------------------

@@ -12,7 +12,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp"]
+_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp", "varint.cpp"]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _BUILD_ERROR: Optional[str] = None
@@ -89,6 +89,16 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.shmkv_sync.argtypes = [ctypes.c_void_p]
     lib.shmkv_close.restype = None
     lib.shmkv_close.argtypes = [ctypes.c_void_p]
+    lib.varint_pack.restype = ctypes.c_long
+    lib.varint_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+    ]
+    lib.varint_unpack.restype = ctypes.c_long
+    lib.varint_unpack.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+    ]
     return lib
 
 
@@ -297,3 +307,37 @@ class ShmKV:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def varint_pack_native(vals: np.ndarray) -> bytes:
+    """Zigzag+LEB128 pack of an int64 array (native)."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    v = np.ascontiguousarray(vals, np.int64)
+    out = np.empty(10 * len(v) + 1, np.uint8)
+    n = l_.varint_pack(
+        v.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), len(v),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), len(out),
+    )
+    if n < 0:
+        raise RuntimeError("varint_pack buffer overflow (cannot happen)")
+    return out[:n].tobytes()
+
+
+def varint_unpack_native(buf: bytes, n: int) -> np.ndarray:
+    """Decode exactly ``n`` int64 values from a varint stream (native)."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    b = np.frombuffer(buf, np.uint8)
+    out = np.empty(n, np.int64)
+    rc = l_.varint_unpack(
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), len(b),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)), n,
+    )
+    if rc == -1:
+        raise ValueError("truncated varint stream")
+    if rc == -2:
+        raise ValueError("corrupt varint stream (value overflows 64 bits)")
+    return out

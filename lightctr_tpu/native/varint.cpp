@@ -1,0 +1,51 @@
+// Variable-width integer wire codec for sparse key streams.
+//
+// Role parity with the reference's VarUint Buffer packing
+// (LightCTR/common/buffer.h:112-128): a PS pull/push request is a stream of
+// feature ids whose magnitudes are small after delta-coding, so 7-bit
+// continuation bytes shrink the request severalfold vs fixed 8-byte keys.
+// Design is NOT a translation: zigzag mapping first (so signed deltas from
+// the Python layer's sorted-key differencing pack tight), then LEB128-style
+// little-endian 7-bit groups with the high bit as "more follows".
+
+#include <cstdint>
+
+extern "C" {
+
+// Worst case 10 bytes per 64-bit value.  Returns bytes written, or -1 when
+// `cap` is too small (caller sizes with varint_max_bytes).
+long varint_pack(const long long* vals, long n, unsigned char* out, long cap) {
+    long pos = 0;
+    for (long i = 0; i < n; ++i) {
+        uint64_t u = ((uint64_t)vals[i] << 1) ^ (uint64_t)(vals[i] >> 63);
+        do {
+            if (pos >= cap) return -1;
+            unsigned char byte = u & 0x7f;
+            u >>= 7;
+            out[pos++] = byte | (u ? 0x80 : 0);
+        } while (u);
+    }
+    return pos;
+}
+
+// Decodes exactly `n` values.  Returns bytes consumed, -1 on truncated
+// stream, -2 on a value overflowing 64 bits (corrupt input).
+long varint_unpack(const unsigned char* buf, long nbytes, long long* out, long n) {
+    long pos = 0;
+    for (long i = 0; i < n; ++i) {
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (pos >= nbytes) return -1;
+            if (shift > 63) return -2;
+            unsigned char byte = buf[pos++];
+            u |= (uint64_t)(byte & 0x7f) << shift;
+            if (!(byte & 0x80)) break;
+            shift += 7;
+        }
+        out[i] = (long long)((u >> 1) ^ (~(u & 1) + 1));
+    }
+    return pos;
+}
+
+}  // extern "C"

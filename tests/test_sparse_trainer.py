@@ -97,6 +97,54 @@ def test_sparse_step_is_o_touched(rng):
     assert f_sparse < f_dense * 0.1, (f_sparse, f_dense)
 
 
+def test_sparse_composes_with_embed_sharding(rng):
+    """O(touched) row updates on an embed-axis row-sharded table must track
+    the replicated dense trainer — the Criteo-scale configuration (sharded
+    PS tables AND O(touched) steps in the same program)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+    from lightctr_tpu.models import widedeep
+
+    n, f, field_cnt, nnz, dim = 64, 128, 4, 6, 8
+    fids = rng.integers(1, f, size=(n, nnz)).astype(np.int32)
+    fields = rng.integers(0, field_cnt, size=(n, nnz)).astype(np.int32)
+    mask = np.ones((n, nnz), np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask, field_cnt)
+    batch = {
+        "fids": fids, "fields": fields, "vals": np.ones((n, nnz), np.float32),
+        "mask": mask, "labels": (rng.random(n) > 0.5).astype(np.float32),
+        "rep_fids": rep, "rep_mask": rep_mask,
+    }
+    params = widedeep.init(jax.random.PRNGKey(1), f, field_cnt, dim)
+    cfg = TrainConfig(learning_rate=0.1)
+
+    mesh = make_mesh(MeshSpec(data=4, embed=2))
+    shardings = {
+        "w": NamedSharding(mesh, P("embed")),
+        "embed": NamedSharding(mesh, P("embed", None)),
+        "fc1": {"w": NamedSharding(mesh, P()), "b": NamedSharding(mesh, P())},
+        "fc2": {"w": NamedSharding(mesh, P()), "b": NamedSharding(mesh, P())},
+    }
+    sharded = SparseTableCTRTrainer(
+        params, widedeep.logits, cfg,
+        sparse_tables={"w": ["fids"], "embed": ["rep_fids"]},
+        mesh=mesh, param_shardings=shardings,
+    )
+    plain = CTRTrainer(params, widedeep.logits, cfg)
+    ls = sharded.fit_fullbatch_scan(batch, 12)
+    lp = plain.fit_fullbatch_scan(batch, 12)
+    np.testing.assert_allclose(ls, lp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sharded.params["embed"]), np.asarray(plain.params["embed"]),
+        rtol=1e-4, atol=1e-5,
+    )
+    # the table (and its accumulator) really live row-sharded on the mesh
+    spec = sharded.params["embed"].sharding.spec
+    assert spec[0] == "embed", spec
+    assert sharded.opt_state["accum"]["embed"].sharding.spec[0] == "embed"
+
+
 def test_rejects_unknown_table_key(rng):
     params = fm.init(jax.random.PRNGKey(0), 64, 4)
     try:

@@ -1,0 +1,55 @@
+"""Host wire codec: varint key streams + fp16 value payloads."""
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.dist import wire
+from lightctr_tpu.native import bindings
+
+
+def test_varint_roundtrip_exhaustive_edges():
+    vals = np.array(
+        [0, 1, -1, 127, 128, -128, 300, 2**20, -(2**20), 2**62, -(2**62),
+         np.iinfo(np.int64).max, np.iinfo(np.int64).min + 1],
+        np.int64,
+    )
+    buf = wire.pack_varint(vals)
+    out = wire.unpack_varint(buf, len(vals))
+    np.testing.assert_array_equal(out, vals)
+
+
+def test_native_and_python_codecs_agree(rng):
+    vals = rng.integers(-(2**40), 2**40, size=2000).astype(np.int64)
+    b_py = wire._pack_py(vals)
+    if bindings.available():
+        assert wire.pack_varint(vals) == b_py
+    np.testing.assert_array_equal(wire._unpack_py(b_py, len(vals)), vals)
+
+
+def test_key_stream_roundtrip_and_compaction(rng):
+    # a realistic pull request: unique sorted fids from a hot vocabulary
+    keys = np.unique(rng.integers(0, 1 << 22, size=4000)).astype(np.int64)
+    buf = wire.pack_keys(keys)
+    np.testing.assert_array_equal(wire.unpack_keys(buf), np.sort(keys))
+    # the VarUint point (buffer.h:112-128): way under 8 bytes/key raw
+    assert len(buf) < 0.5 * keys.size * 8, (len(buf), keys.size * 8)
+
+
+def test_unsorted_and_duplicate_keys_survive(rng):
+    keys = rng.integers(0, 1000, size=500).astype(np.int64)  # duplicates
+    out = wire.unpack_keys(wire.pack_keys(keys))
+    np.testing.assert_array_equal(out, np.sort(keys))
+
+
+def test_truncated_stream_raises():
+    buf = wire.pack_keys(np.arange(100, dtype=np.int64))
+    with pytest.raises(ValueError):
+        wire.unpack_keys(buf[: len(buf) // 2])
+
+
+def test_value_codec_fp16_roundtrip(rng):
+    v = rng.normal(size=(64, 8)).astype(np.float32) * 0.1
+    buf, shape = wire.pack_values(v)
+    assert len(buf) == v.size * 2  # half the fp32 bytes on the wire
+    out = wire.unpack_values(buf, shape)
+    np.testing.assert_allclose(out, v, atol=2e-4)
