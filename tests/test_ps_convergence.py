@@ -1,0 +1,46 @@
+"""PS-mode end-to-end convergence: worker processes over the shm PS reach
+the single-process baseline (the 4_node_ps.png counterpart, scaled down)."""
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.models import widedeep
+from lightctr_tpu.native.bindings import available
+from tools.ps_convergence import run
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native shm_kv unavailable"
+)
+
+
+def _synthetic(rng, n=256, f=200, field_cnt=4, nnz=5):
+    fids = rng.integers(1, f, size=(n, nnz)).astype(np.int32)
+    fields = rng.integers(0, field_cnt, size=(n, nnz)).astype(np.int32)
+    mask = np.ones((n, nnz), np.float32)
+    # separable-ish labels so convergence is visible in a few epochs
+    w_true = rng.normal(size=f).astype(np.float32)
+    z = w_true[fids].sum(axis=1) * 0.5
+    labels = (z + rng.normal(size=n) * 0.3 > 0).astype(np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask, field_cnt)
+    return {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((n, nnz), np.float32), "mask": mask,
+        "labels": labels, "rep_fids": rep, "rep_mask": rep_mask,
+    }, f, field_cnt
+
+
+def test_two_process_ps_training_converges_to_parity(rng, tmp_path):
+    arrays, f, field_cnt = _synthetic(rng)
+    report = run(
+        arrays=arrays, feature_cnt=f, field_cnt=field_cnt,
+        n_workers=2, epochs=6, batch_size=32, factor_dim=4,
+        workdir=str(tmp_path),
+    )
+    # each worker's async loss curve must fall substantially
+    for w in report["workers"]:
+        curve = w["loss_curve"]
+        assert curve[-1] < 0.7 * curve[0], curve
+    # and the PS-trained model must track the single-process run
+    assert report["parity"]["auc"] < 0.05, report["parity"]
+    assert report["parity"]["logloss"] < 0.1, report["parity"]
+    assert report["final_ps"]["auc"] > 0.8, report["final_ps"]

@@ -1,0 +1,428 @@
+"""PS-mode end-to-end convergence: N worker PROCESSES × shared-memory PS.
+
+The counterpart of the reference's 4-node PS benchmark
+(``/root/reference/benchmark/4_node_ps.png``; protocol
+``distribut/paramserver.h:127-210``): several worker processes train
+Wide&Deep on the reference dataset against one ``ShmAsyncParamServer``,
+asynchronously pushing Adagrad updates with atomic float-CAS — then the
+result is evaluated against a single-process run of the same schedule.
+
+Layout on the PS (one row per feature id, dim = 1 + factor_dim):
+  row[0]  = wide weight      (the reference keeps W in the PS sparse table,
+                              distributed_algo_abst.h:203-212)
+  row[1:] = embedding vector (the PS tensor table, ibid:210-226)
+fusing the two pulls the reference makes per key into one round trip.  The
+deep MLP (fc1/fc2) is stored as dim-sized chunks under ``DENSE_BASE`` keys —
+dense blobs sharded as PS rows — preloaded by the coordinator
+(``preload`` = master syncInitializer) so every process starts identically.
+
+Workers:
+  - hold a strided row shard (worker ``w`` owns rows ``w::n_workers`` — the
+    proc_file_split.py partition);
+  - per minibatch: dedup touched fids, PULL rows + dense chunks, rewrite the
+    batch's ids to positions, run ONE jitted value_and_grad on the compact
+    tables (static shapes, so each worker compiles exactly once), PUSH
+    per-key row grads + dense chunk grads;
+  - SSP-gated: a pull too far ahead of the slowest worker is withheld
+    (retried), a push too far behind is dropped — paramserver.h:201-205
+    semantics via the shared ledger.
+
+Run:  python -m tools.ps_convergence --workers 4 --epochs 30
+Emits PS_CONVERGENCE.json: per-worker loss curves + final PS-trained
+metrics vs the single-process baseline (the loss/accuracy-parity artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+from typing import Dict
+
+import numpy as np
+
+DENSE_BASE = 1 << 30
+REF_SPARSE = "/root/reference/data/train_sparse.csv"
+
+
+# ---------------------------------------------------------------------------
+# shared model plumbing (host side)
+
+
+def _dense_template(params) -> Dict[str, tuple]:
+    """{leaf_name: shape} for the MLP leaves, in a fixed order."""
+    return {
+        "fc1.w": tuple(params["fc1"]["w"].shape),
+        "fc1.b": tuple(params["fc1"]["b"].shape),
+        "fc2.w": tuple(params["fc2"]["w"].shape),
+        "fc2.b": tuple(params["fc2"]["b"].shape),
+    }
+
+
+def _flatten_dense(params) -> np.ndarray:
+    return np.concatenate(
+        [
+            np.asarray(params["fc1"]["w"]).reshape(-1),
+            np.asarray(params["fc1"]["b"]).reshape(-1),
+            np.asarray(params["fc2"]["w"]).reshape(-1),
+            np.asarray(params["fc2"]["b"]).reshape(-1),
+        ]
+    ).astype(np.float32)
+
+
+def _unflatten_dense(vec: np.ndarray, template: Dict[str, tuple]):
+    out = {}
+    ofs = 0
+    for name, shape in template.items():
+        n = int(np.prod(shape))
+        out[name] = vec[ofs : ofs + n].reshape(shape)
+        ofs += n
+    return {
+        "fc1": {"w": out["fc1.w"], "b": out["fc1.b"]},
+        "fc2": {"w": out["fc2.w"], "b": out["fc2.b"]},
+    }
+
+
+def _dense_chunks(vec: np.ndarray, row_dim: int) -> Dict[int, np.ndarray]:
+    n_chunks = (len(vec) + row_dim - 1) // row_dim
+    padded = np.zeros(n_chunks * row_dim, np.float32)
+    padded[: len(vec)] = vec
+    return {
+        DENSE_BASE + i: padded[i * row_dim : (i + 1) * row_dim]
+        for i in range(n_chunks)
+    }
+
+
+def _pull_retry(ps, keys, epoch, worker_id=None, max_wait_s: float = 30.0):
+    """Pull with SSP-withheld retry (the reference worker blocks on the PS
+    reply the same way, pull.h:50-67)."""
+    t0 = time.time()
+    while True:
+        rows = ps.pull(keys, worker_epoch=epoch, worker_id=worker_id)
+        if rows is not None:
+            return rows
+        if time.time() - t0 > max_wait_s:
+            raise TimeoutError("SSP pull withheld for too long")
+        time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# worker process
+
+
+def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
+    from lightctr_tpu.utils.devicecheck import pin_cpu_platform
+
+    pin_cpu_platform(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+    from lightctr_tpu.models import widedeep
+    from lightctr_tpu.ops import losses as losses_lib
+
+    D = cfg["factor_dim"]
+    row_dim = 1 + D
+    B = cfg["batch_size"]
+    template = {k: tuple(v) for k, v in cfg["dense_template"]}
+    dense_len = sum(int(np.prod(s)) for s in template.values())
+
+    ps = ShmAsyncParamServer.open(
+        base, n_workers=n_workers, updater=cfg["updater"],
+        learning_rate=cfg["lr"], staleness_threshold=cfg["staleness"],
+    )
+
+    data = payload  # the coordinator ships this worker's shard only
+    n = len(data["labels"])
+    if n < B:
+        raise ValueError(f"worker shard has {n} rows < batch size {B}")
+
+    P = data["fids"].shape[1]
+    FLD = data["rep_fids"].shape[1]
+    U_w, U_e = B * P, B * FLD
+
+    @jax.jit
+    def grads_fn(wide_rows, embed_rows, fc1, fc2, batch):
+        def loss(wr, er, f1, f2):
+            params = {"w": wr, "embed": er, "fc1": f1, "fc2": f2}
+            z = widedeep.logits(params, batch)
+            return losses_lib.logistic_loss(z, batch["labels"], reduction="mean")
+
+        return jax.value_and_grad(loss, argnums=(0, 1, 2, 3))(
+            wide_rows, embed_rows, fc1, fc2
+        )
+
+    from lightctr_tpu.data.batching import minibatches
+
+    curve = []
+    for epoch in range(cfg["epochs"]):
+        ep_losses = []
+        for mb in minibatches(
+            data, B, seed=cfg["seed"] + worker_id * 1000 + epoch
+        ):
+            fids = mb["fids"]
+            rep = mb["rep_fids"]
+
+            uw = np.unique(fids.reshape(-1))
+            ue = np.unique(rep.reshape(-1))
+            # pad with an id that was REALLY pulled (edge-repeat): a pad of 0
+            # would KeyError whenever feature 0 is absent from the batch
+            uw_pad = np.pad(uw, (0, U_w - len(uw)), mode="edge")
+            ue_pad = np.pad(ue, (0, U_e - len(ue)), mode="edge")
+
+            keys = sorted(set(uw.tolist()) | set(ue.tolist()))
+            dense_keys = [DENSE_BASE + i
+                          for i in range((dense_len + row_dim - 1) // row_dim)]
+            pulled = _pull_retry(ps, keys + dense_keys, epoch, worker_id)
+
+            wide_rows = np.stack([pulled[int(k)] for k in uw_pad])[:, 0]
+            embed_rows = np.stack([pulled[int(k)] for k in ue_pad])[:, 1:]
+            dvec = np.concatenate([pulled[k] for k in dense_keys])[:dense_len]
+            mlp = _unflatten_dense(dvec, template)
+
+            batch = {
+                "fids": np.searchsorted(uw_pad[: len(uw)], fids).astype(np.int32),
+                "rep_fids": np.searchsorted(ue_pad[: len(ue)], rep).astype(np.int32),
+                "vals": mb["vals"],
+                "mask": mb["mask"],
+                "rep_mask": mb["rep_mask"],
+                "labels": mb["labels"],
+            }
+            loss, (g_w, g_e, g_fc1, g_fc2) = grads_fn(
+                jnp.asarray(wide_rows), jnp.asarray(embed_rows),
+                jax.tree_util.tree_map(jnp.asarray, mlp["fc1"]),
+                jax.tree_util.tree_map(jnp.asarray, mlp["fc2"]),
+                {k: jnp.asarray(v) for k, v in batch.items()},
+            )
+            ep_losses.append(float(loss))
+
+            g_w, g_e = np.asarray(g_w), np.asarray(g_e)
+            grads: Dict[int, np.ndarray] = {}
+            for i, k in enumerate(uw):
+                row = grads.setdefault(int(k), np.zeros(row_dim, np.float32))
+                row[0] += g_w[i]
+            for i, k in enumerate(ue):
+                row = grads.setdefault(int(k), np.zeros(row_dim, np.float32))
+                row[1:] += g_e[i]
+            g_dense = _flatten_dense({"fc1": g_fc1, "fc2": g_fc2})
+            grads.update(_dense_chunks(g_dense, row_dim))
+            ps.push(worker_id, grads, worker_epoch=epoch)
+        curve.append(float(np.mean(ep_losses)))
+
+    with open(os.path.join(out_dir, f"worker_{worker_id}.json"), "w") as f:
+        json.dump(
+            {
+                "worker": worker_id,
+                "loss_curve": curve,
+                "withheld_pulls": ps.withheld_pulls,
+                "dropped_pushes": ps.dropped_pushes,
+            },
+            f,
+        )
+    ps.close()
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+
+
+def run(
+    data_path: str = REF_SPARSE,
+    n_workers: int = 4,
+    epochs: int = 30,
+    batch_size: int = 50,
+    factor_dim: int = 8,
+    lr: float = 0.1,
+    updater: str = "adagrad",
+    staleness: int = 10,
+    seed: int = 0,
+    workdir: str = None,
+    arrays: Dict[str, np.ndarray] = None,
+    field_cnt: int = None,
+    feature_cnt: int = None,
+) -> dict:
+    """Returns the convergence/parity report (and leaves worker JSONs in
+    ``workdir``).  ``arrays`` overrides ``data_path`` for synthetic tests."""
+    import tempfile
+
+    import jax
+
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+    from lightctr_tpu.models import widedeep
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+    from lightctr_tpu.ops import metrics as metrics_lib
+    from lightctr_tpu.ops.activations import sigmoid
+
+    if arrays is None:
+        from lightctr_tpu.data import load_libffm
+
+        ds, _ = load_libffm(data_path).compact()
+        feature_cnt, field_cnt = ds.feature_cnt, ds.field_cnt
+        rep, rep_mask = widedeep.field_representatives(
+            ds.fids, ds.fields, ds.mask, field_cnt
+        )
+        arrays = widedeep.make_batch(ds, rep, rep_mask)
+
+    D = factor_dim
+    row_dim = 1 + D
+    params0 = widedeep.init(
+        jax.random.PRNGKey(seed), feature_cnt, field_cnt, D
+    )
+    template = _dense_template(params0)
+    dense_vec = _flatten_dense(params0)
+
+    workdir = workdir or tempfile.mkdtemp(prefix="ps_conv_")
+    base = os.path.join(workdir, "ps")
+    n_chunks = (len(dense_vec) + row_dim - 1) // row_dim
+    capacity = 2 * (feature_cnt + n_chunks + 16)
+    ps = ShmAsyncParamServer.create(
+        base, capacity=capacity, dim=row_dim, n_workers=n_workers,
+        updater=updater, learning_rate=lr, staleness_threshold=staleness,
+        seed=seed,
+    )
+    # master syncInitializer: deterministic start for every process
+    w0 = np.asarray(params0["w"])
+    e0 = np.asarray(params0["embed"])
+    rows = np.concatenate([w0[:, None], e0], axis=1).astype(np.float32)
+    ps.preload({fid: rows[fid] for fid in range(feature_cnt)})
+    ps.preload(_dense_chunks(dense_vec, row_dim))
+
+    cfg = {
+        "factor_dim": D, "batch_size": batch_size, "epochs": epochs,
+        "lr": lr, "updater": updater, "staleness": staleness, "seed": seed,
+        "dense_template": [(k, list(v)) for k, v in template.items()],
+    }
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+
+    ctx = mp.get_context("spawn")
+    # ship each worker ONLY its strided shard (proc_file_split.py partition);
+    # contiguous copies so no process keeps the full buffers alive via views
+    from lightctr_tpu.data.batching import shard_for_hosts
+
+    procs = [
+        ctx.Process(
+            target=_worker,
+            args=(
+                base, w, n_workers,
+                {
+                    k: np.ascontiguousarray(v)
+                    for k, v in shard_for_hosts(payload, w, n_workers).items()
+                },
+                workdir, cfg,
+            ),
+        )
+        for w in range(n_workers)
+    ]
+    t0 = time.time()
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+    wall = time.time() - t0
+    for p in procs:
+        if p.exitcode != 0:
+            raise RuntimeError(f"worker exited with {p.exitcode}")
+
+    # reconstruct the PS-trained model
+    final = _pull_retry(ps, list(range(feature_cnt)), epochs)
+    w_fin = np.stack([final[k] for k in range(feature_cnt)])
+    dense_keys = [DENSE_BASE + i for i in range(n_chunks)]
+    pulled_dense = _pull_retry(ps, dense_keys, epochs)
+    dvec = np.concatenate(
+        [pulled_dense[k] for k in dense_keys]
+    )[: len(dense_vec)]
+    ps_params = {
+        "w": w_fin[:, 0],
+        "embed": w_fin[:, 1:],
+        **_unflatten_dense(dvec, template),
+    }
+
+    import jax.numpy as jnp
+
+    def eval_params(params):
+        z = widedeep.logits(
+            jax.tree_util.tree_map(jnp.asarray, params),
+            {k: jnp.asarray(v) for k, v in payload.items()},
+        )
+        probs = sigmoid(z)
+        labels = jnp.asarray(payload["labels"])
+        return {
+            "logloss": float(metrics_lib.logloss(probs, labels)),
+            "accuracy": float(
+                metrics_lib.accuracy(
+                    (probs > 0.5).astype(jnp.int32), labels.astype(jnp.int32)
+                )
+            ),
+            "auc": float(metrics_lib.auc_histogram(probs, labels.astype(jnp.int32))),
+        }
+
+    # single-process baseline: same model/optimizer/schedule, one process
+    cfg_tr = TrainConfig(learning_rate=lr, seed=seed)
+    tr = CTRTrainer(params0, widedeep.logits, cfg_tr)
+    hist = tr.fit(payload, epochs=epochs, batch_size=batch_size)
+
+    curves = []
+    for w in range(n_workers):
+        with open(os.path.join(workdir, f"worker_{w}.json")) as f:
+            curves.append(json.load(f))
+
+    ev_ps = eval_params(ps_params)
+    ev_single = eval_params(tr.params)
+    report = {
+        "config": {
+            "n_workers": n_workers, "epochs": epochs,
+            "batch_size": batch_size, "factor_dim": D, "lr": lr,
+            "updater": updater, "staleness": staleness,
+            "rows": int(len(payload["labels"])), "feature_cnt": int(feature_cnt),
+        },
+        "wall_time_s": round(wall, 2),
+        "workers": curves,
+        "single_loss_curve": [float(x) for x in hist["loss"]],
+        "final_ps": ev_ps,
+        "final_single": ev_single,
+        "parity": {
+            k: round(abs(ev_ps[k] - ev_single[k]), 5) for k in ev_ps
+        },
+    }
+    ps.close()
+    return report
+
+
+def main():
+    from lightctr_tpu.utils.devicecheck import pin_cpu_platform
+
+    pin_cpu_platform(1)
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=REF_SPARSE)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=50)
+    ap.add_argument("--factor-dim", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--updater", default="adagrad")
+    ap.add_argument("--out", default="PS_CONVERGENCE.json")
+    args = ap.parse_args()
+
+    report = run(
+        data_path=args.data, n_workers=args.workers, epochs=args.epochs,
+        batch_size=args.batch_size, factor_dim=args.factor_dim, lr=args.lr,
+        updater=args.updater,
+    )
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps({
+        "final_ps": report["final_ps"],
+        "final_single": report["final_single"],
+        "parity": report["parity"],
+        "wall_time_s": report["wall_time_s"],
+    }))
+
+
+if __name__ == "__main__":
+    main()
