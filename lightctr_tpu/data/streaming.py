@@ -29,12 +29,37 @@ def iter_libffm_batches(
     field_cnt: Optional[int] = None,
     drop_remainder: bool = True,
     native: Optional[bool] = None,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Yield batch dicts with keys fids/fields/vals/mask/labels (+``row_mask``
     flagging real rows when the tail batch is padded).  ``native=None``
     auto-selects the C chunk parser when the native library builds; the two
-    paths yield identical batches (tested)."""
+    paths yield identical batches (tested).
+
+    ``process_index``/``process_count`` stream a per-worker shard: global row
+    ``i`` belongs to worker ``i % process_count`` — the streaming counterpart
+    of the reference's per-worker input split (``data/proc_file_split.py``)
+    and of :func:`lightctr_tpu.data.batching.shard_for_hosts`, so multi-host
+    ingest needs no pre-split files.  Each worker's batches hold only its own
+    rows (every batch still ``batch_size`` rows)."""
     from lightctr_tpu.native import bindings
+
+    if (process_index is None) != (process_count is None):
+        raise ValueError("process_index and process_count go together")
+    if process_count is not None:
+        if not (0 <= process_index < process_count):
+            raise ValueError(
+                f"process_index {process_index} not in [0, {process_count})"
+            )
+        inner = iter_libffm_batches(
+            path, batch_size, max_nnz, feature_cnt, field_cnt,
+            drop_remainder=False, native=native,
+        )
+        yield from _stride_rebatch(
+            inner, batch_size, process_index, process_count, drop_remainder
+        )
+        return
 
     if native is None:
         native = bindings.available()
@@ -82,6 +107,64 @@ def iter_libffm_batches(
                 fill = 0
     if fill and not drop_remainder:
         yield buf
+
+
+def _stride_rebatch(inner, batch_size, process_index, process_count, drop_remainder):
+    """Select global rows ``process_index::process_count`` from a full-stream
+    batch iterator and re-pack them into full ``batch_size`` batches.
+
+    SPMD lockstep guarantee (``drop_remainder=True``): a completed batch —
+    the ``k``-th — is held back until ``(k+1) * batch_size * process_count``
+    global rows have streamed past, which is exactly the condition for EVERY
+    worker to be able to fill its own ``k``-th batch.  So all workers yield
+    the same number of batches regardless of where the file ends, the
+    streaming form of ``shard_for_hosts``'s trim-to-common-multiple (a tail
+    imbalance would strand one host in a collective)."""
+    carry: Dict[str, np.ndarray] = {}
+    carried = 0
+    g = 0  # global row counter across inner batches
+    pending = None  # completed batch awaiting the lockstep threshold
+    n_done = 0  # batches fully completed (pending included)
+    for batch in inner:
+        rows = int(batch["row_mask"].sum())
+        own = np.nonzero((g + np.arange(rows)) % process_count == process_index)[0]
+        g += rows
+        if pending is not None and g >= n_done * batch_size * process_count:
+            yield pending
+            pending = None
+        if own.size == 0:
+            continue
+        take = {k: v[own] for k, v in batch.items()}
+        if not carry:
+            carry = {
+                k: np.zeros((batch_size,) + v.shape[1:], v.dtype)
+                for k, v in take.items()
+            }
+        ofs = 0
+        while ofs < own.size:
+            n = min(batch_size - carried, own.size - ofs)
+            for k, v in take.items():
+                carry[k][carried : carried + n] = v[ofs : ofs + n]
+            carried += n
+            ofs += n
+            if carried == batch_size:
+                if pending is not None:  # threshold passed when it completed
+                    yield pending
+                n_done += 1
+                pending = carry
+                if g >= n_done * batch_size * process_count:
+                    yield pending
+                    pending = None
+                carry = {
+                    k: np.zeros((batch_size,) + v.shape[1:], v.dtype)
+                    for k, v in carry.items()
+                }
+                carried = 0
+    if pending is not None:
+        if not drop_remainder or g >= n_done * batch_size * process_count:
+            yield pending
+    if carried and not drop_remainder:
+        yield carry
 
 
 def _iter_native(path, batch_size, max_nnz, feature_cnt, field_cnt, drop_remainder):

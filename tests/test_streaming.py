@@ -130,3 +130,94 @@ def test_native_large_ids_fold_and_error(tmp_path):
     assert a[0]["fids"].max() < 1000
     with pytest.raises(ValueError, match="int32"):
         list(iter_libffm_batches(str(path), native=True, batch_size=3, max_nnz=4))
+
+
+def _real_rows(batches):
+    """Stack the real rows of a batch stream into flat arrays."""
+    out = {}
+    for b in batches:
+        n = int(b["row_mask"].sum())
+        for k, v in b.items():
+            if k == "row_mask":
+                continue
+            out.setdefault(k, []).append(v[:n])
+    return {k: np.concatenate(v) for k, v in out.items()}
+
+
+def test_strided_shards_partition_the_stream():
+    """proc_file_split parity: the per-process shards are disjoint, strided,
+    and their union is the whole file."""
+    full = _real_rows(
+        iter_libffm_batches(
+            REF_SPARSE, batch_size=128, max_nnz=30, drop_remainder=False
+        )
+    )
+    pc = 3
+    shards = [
+        _real_rows(
+            iter_libffm_batches(
+                REF_SPARSE, batch_size=128, max_nnz=30, drop_remainder=False,
+                process_index=w, process_count=pc,
+            )
+        )
+        for w in range(pc)
+    ]
+    for w, sh in enumerate(shards):
+        np.testing.assert_array_equal(sh["fids"], full["fids"][w::pc])
+        np.testing.assert_allclose(sh["labels"], full["labels"][w::pc])
+    assert sum(len(s["labels"]) for s in shards) == len(full["labels"])
+
+
+def test_strided_native_matches_python():
+    for w in range(2):
+        kw = dict(
+            batch_size=64, max_nnz=30, process_index=w, process_count=2
+        )
+        nat = list(iter_libffm_batches(REF_SPARSE, native=True, **kw))
+        py = list(iter_libffm_batches(REF_SPARSE, native=False, **kw))
+        assert len(nat) == len(py)
+        for a, b in zip(nat, py):
+            for k in a:
+                np.testing.assert_allclose(a[k], b[k], err_msg=k)
+
+
+def test_strided_validates_args():
+    with pytest.raises(ValueError):
+        next(iter_libffm_batches(REF_SPARSE, 8, 4, process_index=1))
+    with pytest.raises(ValueError):
+        next(
+            iter_libffm_batches(
+                REF_SPARSE, 8, 4, process_index=2, process_count=2
+            )
+        )
+
+
+def test_strided_workers_yield_equal_batch_counts(tmp_path):
+    """SPMD lockstep: every worker must yield the SAME number of full
+    batches regardless of the file's tail (255 rows, B=128, 2 workers:
+    worker 0 owns 128 rows but must NOT yield a batch worker 1 can't
+    match)."""
+    p = tmp_path / "uneven.ffm"
+    with open(p, "w") as f:
+        for i in range(255):
+            f.write(f"{i % 2} 0:{i % 50}:1.0 1:{(i * 7) % 50}:1.0\n")
+    for native in (False, True):
+        counts = [
+            len(list(iter_libffm_batches(
+                str(p), batch_size=128, max_nnz=4, native=native,
+                process_index=w, process_count=2,
+            )))
+            for w in range(2)
+        ]
+        assert counts[0] == counts[1] == 0, (native, counts)
+    # 256 rows -> both workers own exactly 128 -> both yield 1
+    with open(p, "a") as f:
+        f.write("1 0:3:1.0\n")
+    counts = [
+        len(list(iter_libffm_batches(
+            str(p), batch_size=128, max_nnz=4,
+            process_index=w, process_count=2,
+        )))
+        for w in range(2)
+    ]
+    assert counts == [1, 1], counts

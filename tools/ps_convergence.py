@@ -251,12 +251,8 @@ def run(
 
     import jax
 
-    from lightctr_tpu import TrainConfig
     from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
     from lightctr_tpu.models import widedeep
-    from lightctr_tpu.models.ctr_trainer import CTRTrainer
-    from lightctr_tpu.ops import metrics as metrics_lib
-    from lightctr_tpu.ops.activations import sigmoid
 
     if arrays is None:
         from lightctr_tpu.data import load_libffm
@@ -278,6 +274,7 @@ def run(
 
     workdir = workdir or tempfile.mkdtemp(prefix="ps_conv_")
     base = os.path.join(workdir, "ps")
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
     n_chunks = (len(dense_vec) + row_dim - 1) // row_dim
     capacity = 2 * (feature_cnt + n_chunks + 16)
     ps = ShmAsyncParamServer.create(
@@ -285,6 +282,31 @@ def run(
         updater=updater, learning_rate=lr, staleness_threshold=staleness,
         seed=seed,
     )
+    try:
+        return _run_with_ps(
+            ps, base, workdir, payload, params0, template, dense_vec,
+            n_workers, epochs, batch_size, D, row_dim, n_chunks, lr,
+            updater, staleness, seed, feature_cnt,
+        )
+    finally:
+        # close even when a worker dies mid-run: the four mmap handles (and
+        # a waiting SSP puller) must not outlive the failed attempt
+        ps.close()
+
+
+def _run_with_ps(
+    ps, base, workdir, payload, params0, template, dense_vec,
+    n_workers, epochs, batch_size, D, row_dim, n_chunks, lr,
+    updater, staleness, seed, feature_cnt,
+):
+    import jax
+
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models import widedeep
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+    from lightctr_tpu.ops import metrics as metrics_lib
+    from lightctr_tpu.ops.activations import sigmoid
+
     # master syncInitializer: deterministic start for every process
     w0 = np.asarray(params0["w"])
     e0 = np.asarray(params0["embed"])
@@ -297,7 +319,6 @@ def run(
         "lr": lr, "updater": updater, "staleness": staleness, "seed": seed,
         "dense_template": [(k, list(v)) for k, v in template.items()],
     }
-    payload = {k: np.asarray(v) for k, v in arrays.items()}
 
     ctx = mp.get_context("spawn")
     # ship each worker ONLY its strided shard (proc_file_split.py partition);
@@ -389,7 +410,6 @@ def run(
             k: round(abs(ev_ps[k] - ev_single[k]), 5) for k in ev_ps
         },
     }
-    ps.close()
     return report
 
 

@@ -1,19 +1,30 @@
 #!/bin/bash
-# Run this the moment the TPU answers (docs/STATUS_r1.md priority list).
-# Order: latency bisect -> real-TPU bench -> flash-attention real compile.
+# Run this the moment the TPU answers. Captures every driver-verifiable TPU
+# artifact VERDICT r2 items 2/7 ask for, most valuable first (the relay can
+# wedge again mid-sequence).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 FAIL=0
 
 # per-step hard timeouts: the relay can wedge AGAIN mid-run (only bench.py
 # carries its own watchdog), and a hung step must not block the sequence
-echo "== 1/3 step-latency bisect (variants A-F) =="
-timeout -k 30 900 python tools/tpu_bisect.py 50 || { echo "bisect FAILED"; FAIL=1; }
+echo "== 1/7 real-TPU benchmark =="
+timeout -k 30 1200 python bench.py || { echo "bench FAILED"; FAIL=1; }
 
-echo "== 2/3 real-TPU benchmark =="
-timeout -k 30 900 python bench.py || { echo "bench FAILED"; FAIL=1; }
+echo "== 2/7 TPU compiled-kernel gates =="
+timeout -k 30 1800 python -m pytest tests_tpu -q || { echo "tests_tpu FAILED"; FAIL=1; }
 
-echo "== 3/3 flash-attention real compile (interpret=False) =="
+echo "== 3/7 pallas kernel bench (PALLAS_BENCH.json) =="
+timeout -k 30 1800 python -m tools.bench_pallas || { echo "bench_pallas FAILED"; FAIL=1; }
+
+echo "== 4/7 full benchmark matrix (FM/FFM/NN) =="
+timeout -k 30 3600 python bench_matrix.py || { echo "bench_matrix FAILED"; FAIL=1; }
+
+echo "== 5/7 Criteo-scale on the real chip (sparse sharded trainer) =="
+timeout -k 30 1800 env LIGHTCTR_CRITEO_REAL=1 python -m tools.criteo_scale \
+    --out CRITEO_SCALE_TPU.json || { echo "criteo FAILED"; FAIL=1; }
+
+echo "== 6/7 flash-attention real compile (interpret=False) =="
 timeout -k 30 600 python - <<'EOF' || { echo "flash compile FAILED"; FAIL=1; }
 import jax, jax.numpy as jnp, numpy as np, time
 from lightctr_tpu.nn.flash_attention import flash_attention
@@ -30,5 +41,9 @@ err = float(jnp.abs(out - ref).max())
 print("max err vs full:", err)
 assert err < 2e-2, f"flash kernel numerically diverged: {err}"
 EOF
+
+echo "== 7/7 step-latency bisect (variants A-F) =="
+timeout -k 30 900 python tools/tpu_bisect.py 50 || { echo "bisect FAILED"; FAIL=1; }
+
 echo "== done (FAIL=$FAIL) =="
 exit $FAIL
