@@ -56,25 +56,56 @@ def bench_fm(epochs):
     ds, _ = load_libffm(REF_SPARSE).compact()
     arrays = ds.batch_dict()
     n_rows = len(arrays["labels"])
-    dense = fm.densify(arrays, ds.feature_cnt)
-    dense = {k: jax.device_put(jnp.asarray(v)) for k, v in dense.items()}
-    jax.block_until_ready(dense)
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+
+    use_native = False
+    if jax.devices()[0].platform == "cpu":
+        from lightctr_tpu.native.bindings import (
+            available as native_available,
+            fm_train_fullbatch_native,
+        )
+        use_native = native_available()
+    if not use_native:
+        dense = fm.densify(arrays, ds.feature_cnt)
+        dense = {k: jax.device_put(jnp.asarray(v)) for k, v in dense.items()}
+        jax.block_until_ready(dense)
 
     out = []
     for k in (8, 16, 32, 64):
         params = fm.init(jax.random.PRNGKey(0), ds.feature_cnt, k)
-        tr = CTRTrainer(params, fm.dense_logits, cfg, fused_fn=fm.dense_logits_with_l2)
-        tr.warmup_fullbatch_scan(dense, epochs)
+        if use_native:
+            # host fallback: the native CSR kernel (parity-tested trajectory)
+            w0 = np.asarray(params["w"], np.float32)
+            v0 = np.asarray(params["v"], np.float32)
+            fm_train_fullbatch_native(
+                arrays, ds.feature_cnt, k, max(epochs // 20, 1),
+                cfg.learning_rate, cfg.lambda_l2, w0.copy(), v0.copy(),
+            )
 
-        def one():
-            tr.reset(params)
-            t0 = time.perf_counter()
-            losses = tr.fit_fullbatch_scan(dense, epochs)
-            jax.block_until_ready(tr.params)
-            dt = time.perf_counter() - t0
-            assert losses[-1] < losses[0], "diverged"
-            return dt
+            def one():
+                w, v = w0.copy(), v0.copy()
+                t0 = time.perf_counter()
+                losses = fm_train_fullbatch_native(
+                    arrays, ds.feature_cnt, k, epochs,
+                    cfg.learning_rate, cfg.lambda_l2, w, v,
+                )
+                dt = time.perf_counter() - t0
+                assert losses[-1] < losses[0], "diverged"
+                return dt
+        else:
+            tr = CTRTrainer(
+                params, fm.dense_logits, cfg, fused_fn=fm.dense_logits_with_l2
+            )
+            tr.warmup_fullbatch_scan(dense, epochs)
+
+            def one():
+                tr.reset(params)
+                t0 = time.perf_counter()
+                losses = tr.fit_fullbatch_scan(dense, epochs)
+                jax.block_until_ready(tr.params)
+                dt = time.perf_counter() - t0
+                assert losses[-1] < losses[0], "diverged"
+                return dt
 
         dt = _best_of(one)
         ex_s = epochs * n_rows / dt

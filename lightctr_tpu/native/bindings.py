@@ -12,7 +12,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp", "varint.cpp"]
+_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp", "varint.cpp", "fm_cpu.cpp"]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _BUILD_ERROR: Optional[str] = None
@@ -23,6 +23,20 @@ def _source_digest() -> str:
     for s in _SOURCES:
         with open(os.path.join(_DIR, s), "rb") as f:
             h.update(f.read())
+    # the build is host-tuned (-march=native), so the cache key must identify
+    # the host ISA too: a repo on shared storage must not reuse an AVX-512
+    # .so on an older machine (SIGILL on dlopen'd code)
+    import platform
+
+    h.update(platform.machine().encode())
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    h.update(line.encode())
+                    break
+    except OSError:
+        pass
     return h.hexdigest()[:16]
 
 
@@ -33,13 +47,27 @@ def _build() -> Optional[ctypes.CDLL]:
         # compile to a per-process temp path, then atomically rename: two
         # fresh processes may race here and must never dlopen a half-written so
         tmp_path = f"{so_path}.tmp.{os.getpid()}"
-        cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-            *[os.path.join(_DIR, s) for s in _SOURCES],
-            "-o", tmp_path,
-        ]
+
+        def cmd(arch_flags):
+            return [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC", *arch_flags,
+                *[os.path.join(_DIR, s) for s in _SOURCES],
+                "-o", tmp_path,
+            ]
+
         try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            # the .so is digest-keyed and built on the machine that runs it,
+            # so tune for the host ISA (AVX2/512 inner loops in fm_cpu.cpp);
+            # retry portable when the toolchain rejects -march=native
+            try:
+                subprocess.run(
+                    cmd(["-march=native"]), check=True,
+                    capture_output=True, text=True,
+                )
+            except subprocess.CalledProcessError:
+                subprocess.run(
+                    cmd([]), check=True, capture_output=True, text=True
+                )
             os.replace(tmp_path, so_path)
         except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
             _BUILD_ERROR = getattr(e, "stderr", str(e)) or str(e)
@@ -98,6 +126,18 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.varint_unpack.argtypes = [
         ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+    ]
+    lib.fm_train_fullbatch.restype = ctypes.c_int
+    lib.fm_train_fullbatch.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),   # row_ptr
+        ctypes.POINTER(ctypes.c_int32),   # fids
+        ctypes.POINTER(ctypes.c_float),   # vals
+        ctypes.POINTER(ctypes.c_float),   # labels
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # B, F, K
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float),   # w
+        ctypes.POINTER(ctypes.c_float),   # v
+        ctypes.POINTER(ctypes.c_float),   # losses
     ]
     return lib
 
@@ -341,3 +381,51 @@ def varint_unpack_native(buf: bytes, n: int) -> np.ndarray:
     if rc == -2:
         raise ValueError("corrupt varint stream (value overflows 64 bits)")
     return out
+
+
+def fm_train_fullbatch_native(
+    arrays: dict,
+    feature_cnt: int,
+    factor_cnt: int,
+    epochs: int,
+    learning_rate: float,
+    lambda_l2: float,
+    w: np.ndarray,
+    v: np.ndarray,
+    eps: float = 1e-7,
+) -> np.ndarray:
+    """Run `epochs` full-batch FM Adagrad steps natively, updating (w, v)
+    in place from a padded batch dict; returns the per-epoch mean losses.
+    Same trajectory as CTRTrainer(fm.logits_with_l2) to float rounding
+    (tests/test_fm_native.py)."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    mask = np.asarray(arrays["mask"]) > 0
+    fids_p = np.asarray(arrays["fids"], np.int32)
+    vals_p = (np.asarray(arrays["vals"], np.float32)
+              * np.asarray(arrays["mask"], np.float32))
+    nnz = mask.sum(axis=1).astype(np.int64)
+    row_ptr = np.zeros(len(nnz) + 1, np.int64)
+    np.cumsum(nnz, out=row_ptr[1:])
+    fids = np.ascontiguousarray(fids_p[mask], np.int32)
+    vals = np.ascontiguousarray(vals_p[mask], np.float32)
+    labels = np.ascontiguousarray(arrays["labels"], np.float32)
+    if fids.size and (fids.min() < 0 or fids.max() >= feature_cnt):
+        raise ValueError("fid out of range for feature_cnt")
+    if w.shape != (feature_cnt,) or v.shape != (feature_cnt, factor_cnt):
+        raise ValueError("w/v shape mismatch")
+    if not (w.flags.c_contiguous and v.flags.c_contiguous):
+        raise ValueError("w/v must be C-contiguous")
+    losses = np.zeros(epochs, np.float32)
+    rc = l_.fm_train_fullbatch(
+        row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        fids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _fptr(vals), _fptr(labels),
+        len(labels), feature_cnt, factor_cnt,
+        epochs, learning_rate, lambda_l2, eps,
+        _fptr(w), _fptr(v.reshape(-1)), _fptr(losses),
+    )
+    if rc != 0:
+        raise RuntimeError(f"fm_train_fullbatch rc={rc}")
+    return losses

@@ -1,0 +1,82 @@
+"""Native full-batch FM trainer == the JAX CTRTrainer trajectory."""
+
+import jax
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.data import load_libffm
+from lightctr_tpu.models import fm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.native.bindings import available, fm_train_fullbatch_native
+
+REF_SPARSE = "/root/reference/data/train_sparse.csv"
+
+pytestmark = pytest.mark.skipif(not available(), reason="native lib unavailable")
+
+
+def test_native_fm_matches_jax_trajectory():
+    ds, _ = load_libffm(REF_SPARSE).compact()
+    arrays = ds.batch_dict()
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    epochs = 40
+
+    params = fm.init(jax.random.PRNGKey(0), ds.feature_cnt, 8)
+    tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, epochs)
+
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = fm_train_fullbatch_native(
+        arrays, ds.feature_cnt, 8, epochs, cfg.learning_rate, cfg.lambda_l2,
+        w, v,
+    )
+    # same loss trajectory to float rounding (different summation order)
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    # same final parameters
+    np.testing.assert_allclose(w, np.asarray(tr.params["w"]), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=5e-4)
+
+
+def test_native_fm_respects_duplicate_fids_and_padding(rng):
+    """Rows repeating a fid and heavy padding: both paths agree (the
+    per-slot L2 and self-interaction subtraction are per-OCCURRENCE)."""
+    n, p, f = 64, 10, 128
+    fids = rng.integers(0, f, size=(n, p)).astype(np.int32)
+    fids[:, 1] = fids[:, 0]  # guaranteed duplicates
+    mask = (rng.random((n, p)) < 0.5).astype(np.float32)
+    mask[:, :2] = 1.0
+    arrays = {
+        "fids": fids,
+        "fields": np.zeros((n, p), np.int32),
+        "vals": rng.normal(size=(n, p)).astype(np.float32),
+        "mask": mask,
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.01)
+    params = fm.init(jax.random.PRNGKey(1), f, 4)
+    tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, 25)
+
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = fm_train_fullbatch_native(
+        arrays, f, 4, 25, cfg.learning_rate, cfg.lambda_l2, w, v
+    )
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(w, np.asarray(tr.params["w"]), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=5e-4)
+
+
+def test_native_fm_validates_inputs():
+    arrays = {
+        "fids": np.array([[5]], np.int32),
+        "fields": np.zeros((1, 1), np.int32),
+        "vals": np.ones((1, 1), np.float32),
+        "mask": np.ones((1, 1), np.float32),
+        "labels": np.ones(1, np.float32),
+    }
+    w = np.zeros(4, np.float32)
+    v = np.zeros((4, 2), np.float32)
+    with pytest.raises(ValueError):
+        fm_train_fullbatch_native(arrays, 4, 2, 5, 0.1, 0.0, w, v)

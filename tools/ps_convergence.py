@@ -284,9 +284,12 @@ def run(
     )
     try:
         return _run_with_ps(
-            ps, base, workdir, payload, params0, template, dense_vec,
-            n_workers, epochs, batch_size, D, row_dim, n_chunks, lr,
-            updater, staleness, seed, feature_cnt,
+            ps=ps, base=base, workdir=workdir, payload=payload,
+            params0=params0, template=template, dense_vec=dense_vec,
+            n_workers=n_workers, epochs=epochs, batch_size=batch_size,
+            D=D, row_dim=row_dim, n_chunks=n_chunks, lr=lr,
+            updater=updater, staleness=staleness, seed=seed,
+            feature_cnt=feature_cnt,
         )
     finally:
         # close even when a worker dies mid-run: the four mmap handles (and
@@ -295,7 +298,7 @@ def run(
 
 
 def _run_with_ps(
-    ps, base, workdir, payload, params0, template, dense_vec,
+    *, ps, base, workdir, payload, params0, template, dense_vec,
     n_workers, epochs, batch_size, D, row_dim, n_chunks, lr,
     updater, staleness, seed, feature_cnt,
 ):
