@@ -47,6 +47,19 @@ def _best_of(fn, reps=3):
     return best
 
 
+def _native_cpu_trainers():
+    """(fm_native, ffm_native) when the host-fallback kernels apply (CPU
+    platform + native lib builds), else (None, None) — one probe shared by
+    the FM and FFM cells."""
+    if jax.devices()[0].platform != "cpu":
+        return None, None
+    from lightctr_tpu.native import bindings
+
+    if not bindings.available():
+        return None, None
+    return bindings.fm_train_fullbatch_native, bindings.ffm_train_fullbatch_native
+
+
 def bench_fm(epochs):
     from lightctr_tpu import TrainConfig
     from lightctr_tpu.data import load_libffm
@@ -58,13 +71,8 @@ def bench_fm(epochs):
     n_rows = len(arrays["labels"])
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
 
-    use_native = False
-    if jax.devices()[0].platform == "cpu":
-        from lightctr_tpu.native.bindings import (
-            available as native_available,
-            fm_train_fullbatch_native,
-        )
-        use_native = native_available()
+    fm_train_fullbatch_native, _ = _native_cpu_trainers()
+    use_native = fm_train_fullbatch_native is not None
     if not use_native:
         dense = fm.densify(arrays, ds.feature_cnt)
         dense = {k: jax.device_put(jnp.asarray(v)) for k, v in dense.items()}
@@ -129,27 +137,50 @@ def bench_ffm(epochs):
     ds, _ = load_libffm(REF_SPARSE).compact()
     arrays = ds.batch_dict()
     n_rows = len(arrays["labels"])
-    dense, perm, slices = ffm.densify(arrays, ds.feature_cnt, ds.field_cnt)
-    dense = {k: jax.device_put(jnp.asarray(v)) for k, v in dense.items()}
-    jax.block_until_ready(dense)
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
-    fused = ffm.make_dense_logits(slices)
+
+    _, ffm_train_fullbatch_native = _native_cpu_trainers()
+    use_native = ffm_train_fullbatch_native is not None
+    if not use_native:
+        dense, perm, slices = ffm.densify(arrays, ds.feature_cnt, ds.field_cnt)
+        dense = {k: jax.device_put(jnp.asarray(v)) for k, v in dense.items()}
+        jax.block_until_ready(dense)
+        fused = ffm.make_dense_logits(slices)
 
     out = []
     for k in (2, 4, 8, 16):
         p0 = ffm.init(jax.random.PRNGKey(0), ds.feature_cnt, ds.field_cnt, k)
-        params = {"w": p0["w"][perm], "v": p0["v"][perm]}
-        tr = CTRTrainer(params, lambda p, b: fused(p, b)[0], cfg, fused_fn=fused)
-        tr.warmup_fullbatch_scan(dense, epochs)
+        if use_native:
+            w0 = np.asarray(p0["w"], np.float32)
+            v0 = np.asarray(p0["v"], np.float32)
+            ffm_train_fullbatch_native(
+                arrays, ds.feature_cnt, ds.field_cnt, k, max(epochs // 20, 1),
+                cfg.learning_rate, cfg.lambda_l2, w0.copy(), v0.copy(),
+            )
 
-        def one():
-            tr.reset(params)
-            t0 = time.perf_counter()
-            losses = tr.fit_fullbatch_scan(dense, epochs)
-            jax.block_until_ready(tr.params)
-            dt = time.perf_counter() - t0
-            assert losses[-1] < losses[0], "diverged"
-            return dt
+            def one():
+                w, v = w0.copy(), v0.copy()
+                t0 = time.perf_counter()
+                losses = ffm_train_fullbatch_native(
+                    arrays, ds.feature_cnt, ds.field_cnt, k, epochs,
+                    cfg.learning_rate, cfg.lambda_l2, w, v,
+                )
+                dt = time.perf_counter() - t0
+                assert losses[-1] < losses[0], "diverged"
+                return dt
+        else:
+            params = {"w": p0["w"][perm], "v": p0["v"][perm]}
+            tr = CTRTrainer(params, lambda p, b: fused(p, b)[0], cfg, fused_fn=fused)
+            tr.warmup_fullbatch_scan(dense, epochs)
+
+            def one():
+                tr.reset(params)
+                t0 = time.perf_counter()
+                losses = tr.fit_fullbatch_scan(dense, epochs)
+                jax.block_until_ready(tr.params)
+                dt = time.perf_counter() - t0
+                assert losses[-1] < losses[0], "diverged"
+                return dt
 
         dt = _best_of(one)
         ex_s = epochs * n_rows / dt

@@ -12,7 +12,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["libffm_parser.cpp", "shm_kv.cpp", "varint.cpp", "fm_cpu.cpp"]
+_SOURCES = [
+    "libffm_parser.cpp", "shm_kv.cpp", "varint.cpp", "fm_cpu.cpp",
+    "ffm_cpu.cpp",
+]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _BUILD_ERROR: Optional[str] = None
@@ -134,6 +137,19 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_float),   # vals
         ctypes.POINTER(ctypes.c_float),   # labels
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # B, F, K
+        ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float),   # w
+        ctypes.POINTER(ctypes.c_float),   # v
+        ctypes.POINTER(ctypes.c_float),   # losses
+    ]
+    lib.ffm_train_fullbatch.restype = ctypes.c_int
+    lib.ffm_train_fullbatch.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),   # row_ptr
+        ctypes.POINTER(ctypes.c_int32),   # fids
+        ctypes.POINTER(ctypes.c_int32),   # fields
+        ctypes.POINTER(ctypes.c_float),   # vals
+        ctypes.POINTER(ctypes.c_float),   # labels
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
         ctypes.POINTER(ctypes.c_float),   # w
         ctypes.POINTER(ctypes.c_float),   # v
@@ -383,6 +399,39 @@ def varint_unpack_native(buf: bytes, n: int) -> np.ndarray:
     return out
 
 
+def _csr_flatten(arrays: dict, feature_cnt: int, with_fields: bool = False):
+    """Padded batch dict -> CSR (row_ptr, fids[, fields], vals, labels) for
+    the native trainers; validates fid range."""
+    mask = np.asarray(arrays["mask"]) > 0
+    vals_p = (np.asarray(arrays["vals"], np.float32)
+              * np.asarray(arrays["mask"], np.float32))
+    nnz = mask.sum(axis=1).astype(np.int64)
+    row_ptr = np.zeros(len(nnz) + 1, np.int64)
+    np.cumsum(nnz, out=row_ptr[1:])
+    fids = np.ascontiguousarray(np.asarray(arrays["fids"], np.int32)[mask])
+    vals = np.ascontiguousarray(vals_p[mask], np.float32)
+    labels = np.ascontiguousarray(arrays["labels"], np.float32)
+    if fids.size and (fids.min() < 0 or fids.max() >= feature_cnt):
+        raise ValueError("fid out of range for feature_cnt")
+    if with_fields:
+        fields = np.ascontiguousarray(
+            np.asarray(arrays["fields"], np.int32)[mask]
+        )
+        return row_ptr, fids, fields, vals, labels
+    return row_ptr, fids, vals, labels
+
+
+def _check_param_buffers(feature_cnt, shapes_and_arrays):
+    for name, arr, want_shape in shapes_and_arrays:
+        if arr.shape != want_shape:
+            raise ValueError(f"{name} shape {arr.shape} != {want_shape}")
+        if arr.dtype != np.float32:
+            # ctypes would silently reinterpret float64 memory as float32
+            raise ValueError(f"{name} must be float32, got {arr.dtype}")
+        if not arr.flags.c_contiguous:
+            raise ValueError(f"{name} must be C-contiguous")
+
+
 def fm_train_fullbatch_native(
     arrays: dict,
     feature_cnt: int,
@@ -401,22 +450,11 @@ def fm_train_fullbatch_native(
     l_ = lib()
     if l_ is None:
         raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
-    mask = np.asarray(arrays["mask"]) > 0
-    fids_p = np.asarray(arrays["fids"], np.int32)
-    vals_p = (np.asarray(arrays["vals"], np.float32)
-              * np.asarray(arrays["mask"], np.float32))
-    nnz = mask.sum(axis=1).astype(np.int64)
-    row_ptr = np.zeros(len(nnz) + 1, np.int64)
-    np.cumsum(nnz, out=row_ptr[1:])
-    fids = np.ascontiguousarray(fids_p[mask], np.int32)
-    vals = np.ascontiguousarray(vals_p[mask], np.float32)
-    labels = np.ascontiguousarray(arrays["labels"], np.float32)
-    if fids.size and (fids.min() < 0 or fids.max() >= feature_cnt):
-        raise ValueError("fid out of range for feature_cnt")
-    if w.shape != (feature_cnt,) or v.shape != (feature_cnt, factor_cnt):
-        raise ValueError("w/v shape mismatch")
-    if not (w.flags.c_contiguous and v.flags.c_contiguous):
-        raise ValueError("w/v must be C-contiguous")
+    row_ptr, fids, vals, labels = _csr_flatten(arrays, feature_cnt)
+    _check_param_buffers(feature_cnt, [
+        ("w", w, (feature_cnt,)),
+        ("v", v, (feature_cnt, factor_cnt)),
+    ])
     losses = np.zeros(epochs, np.float32)
     rc = l_.fm_train_fullbatch(
         row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -428,4 +466,46 @@ def fm_train_fullbatch_native(
     )
     if rc != 0:
         raise RuntimeError(f"fm_train_fullbatch rc={rc}")
+    return losses
+
+
+def ffm_train_fullbatch_native(
+    arrays: dict,
+    feature_cnt: int,
+    field_cnt: int,
+    factor_cnt: int,
+    epochs: int,
+    learning_rate: float,
+    lambda_l2: float,
+    w: np.ndarray,
+    v: np.ndarray,
+    eps: float = 1e-7,
+) -> np.ndarray:
+    """Native full-batch FFM Adagrad, updating (w, v[F, Fl, K]) in place;
+    returns per-epoch mean losses.  Trajectory parity with
+    CTRTrainer(ffm.logits_with_l2) — tests/test_ffm_native.py."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    row_ptr, fids, fields, vals, labels = _csr_flatten(
+        arrays, feature_cnt, with_fields=True
+    )
+    if fields.size and (fields.min() < 0 or fields.max() >= field_cnt):
+        raise ValueError("field out of range for field_cnt")
+    _check_param_buffers(feature_cnt, [
+        ("w", w, (feature_cnt,)),
+        ("v", v, (feature_cnt, field_cnt, factor_cnt)),
+    ])
+    losses = np.zeros(epochs, np.float32)
+    rc = l_.ffm_train_fullbatch(
+        row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        fids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        _fptr(vals), _fptr(labels),
+        len(labels), feature_cnt, field_cnt, factor_cnt,
+        epochs, learning_rate, lambda_l2, eps,
+        _fptr(w), _fptr(v.reshape(-1)), _fptr(losses),
+    )
+    if rc != 0:
+        raise RuntimeError(f"ffm_train_fullbatch rc={rc}")
     return losses

@@ -1,0 +1,115 @@
+"""Native full-batch FFM trainer == the JAX CTRTrainer trajectory."""
+
+import jax
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.data import load_libffm
+from lightctr_tpu.models import ffm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.native.bindings import available, ffm_train_fullbatch_native
+
+REF_SPARSE = "/root/reference/data/train_sparse.csv"
+
+pytestmark = pytest.mark.skipif(not available(), reason="native lib unavailable")
+
+
+def test_native_ffm_matches_jax_trajectory_synthetic(rng):
+    """Random fields/vals/mask incl. duplicate fids: trajectory parity."""
+    n, p, f, fl, k = 48, 8, 96, 6, 4
+    fids = rng.integers(0, f, size=(n, p)).astype(np.int32)
+    fids[:, 1] = fids[:, 0]  # duplicates
+    arrays = {
+        "fids": fids,
+        "fields": rng.integers(0, fl, size=(n, p)).astype(np.int32),
+        "vals": rng.normal(size=(n, p)).astype(np.float32),
+        "mask": (rng.random((n, p)) < 0.7).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    arrays["mask"][:, 0] = 1.0
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.01)
+    params = ffm.init(jax.random.PRNGKey(0), f, fl, k)
+    tr = CTRTrainer(params, ffm.logits, cfg, fused_fn=ffm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, 25)
+
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = ffm_train_fullbatch_native(
+        arrays, f, fl, k, 25, cfg.learning_rate, cfg.lambda_l2, w, v
+    )
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(w, np.asarray(tr.params["w"]), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=1e-3)
+
+
+def test_native_ffm_matches_jax_on_reference_data():
+    ds, _ = load_libffm(REF_SPARSE).compact()
+    arrays = ds.batch_dict()
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    k, epochs = 4, 8
+
+    params = ffm.init(jax.random.PRNGKey(0), ds.feature_cnt, ds.field_cnt, k)
+    tr = CTRTrainer(params, ffm.logits, cfg, fused_fn=ffm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, epochs)
+
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = ffm_train_fullbatch_native(
+        arrays, ds.feature_cnt, ds.field_cnt, k, epochs,
+        cfg.learning_rate, cfg.lambda_l2, w, v,
+    )
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(w, np.asarray(tr.params["w"]), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=1e-3)
+
+
+def test_native_ffm_validates_inputs():
+    arrays = {
+        "fids": np.array([[1]], np.int32),
+        "fields": np.array([[9]], np.int32),  # out of range
+        "vals": np.ones((1, 1), np.float32),
+        "mask": np.ones((1, 1), np.float32),
+        "labels": np.ones(1, np.float32),
+    }
+    w = np.zeros(4, np.float32)
+    v = np.zeros((4, 3, 2), np.float32)
+    with pytest.raises(ValueError):
+        ffm_train_fullbatch_native(arrays, 4, 3, 2, 5, 0.1, 0.0, w, v)
+
+
+def test_native_ffm_generic_k_path(rng):
+    """K=3 exercises the runtime-K fallback (not in the templated switch)."""
+    n, p, f, fl, k = 24, 5, 48, 4, 3
+    arrays = {
+        "fids": rng.integers(0, f, size=(n, p)).astype(np.int32),
+        "fields": rng.integers(0, fl, size=(n, p)).astype(np.int32),
+        "vals": rng.normal(size=(n, p)).astype(np.float32),
+        "mask": np.ones((n, p), np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.01)
+    params = ffm.init(jax.random.PRNGKey(2), f, fl, k)
+    tr = CTRTrainer(params, ffm.logits, cfg, fused_fn=ffm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, 15)
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = ffm_train_fullbatch_native(
+        arrays, f, fl, k, 15, cfg.learning_rate, cfg.lambda_l2, w, v
+    )
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=1e-3)
+
+
+def test_native_ffm_rejects_float64_buffers():
+    arrays = {
+        "fids": np.array([[1]], np.int32),
+        "fields": np.array([[0]], np.int32),
+        "vals": np.ones((1, 1), np.float32),
+        "mask": np.ones((1, 1), np.float32),
+        "labels": np.ones(1, np.float32),
+    }
+    w = np.zeros(4)            # float64: ctypes would reinterpret silently
+    v = np.zeros((4, 3, 2))
+    with pytest.raises(ValueError):
+        ffm_train_fullbatch_native(arrays, 4, 3, 2, 5, 0.1, 0.0, w, v)

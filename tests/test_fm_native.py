@@ -80,3 +80,40 @@ def test_native_fm_validates_inputs():
     v = np.zeros((4, 2), np.float32)
     with pytest.raises(ValueError):
         fm_train_fullbatch_native(arrays, 4, 2, 5, 0.1, 0.0, w, v)
+
+
+def test_native_fm_generic_k_path(rng):
+    """K=3 exercises the runtime-K fallback (not in the templated switch)."""
+    n, p, f, k = 32, 6, 64, 3
+    arrays = {
+        "fids": rng.integers(0, f, size=(n, p)).astype(np.int32),
+        "fields": np.zeros((n, p), np.int32),
+        "vals": rng.normal(size=(n, p)).astype(np.float32),
+        "mask": np.ones((n, p), np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.01)
+    params = fm.init(jax.random.PRNGKey(3), f, k)
+    tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2)
+    losses_jax = tr.fit_fullbatch_scan(arrays, 15)
+    w = np.array(params["w"], np.float32)
+    v = np.array(params["v"], np.float32)
+    losses_nat = fm_train_fullbatch_native(
+        arrays, f, k, 15, cfg.learning_rate, cfg.lambda_l2, w, v
+    )
+    np.testing.assert_allclose(losses_nat, losses_jax, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(v, np.asarray(tr.params["v"]), rtol=5e-3, atol=5e-4)
+
+
+def test_native_fm_rejects_float64_buffers():
+    arrays = {
+        "fids": np.array([[1]], np.int32),
+        "fields": np.zeros((1, 1), np.int32),
+        "vals": np.ones((1, 1), np.float32),
+        "mask": np.ones((1, 1), np.float32),
+        "labels": np.ones(1, np.float32),
+    }
+    w = np.zeros(4)            # float64
+    v = np.zeros((4, 2))
+    with pytest.raises(ValueError):
+        fm_train_fullbatch_native(arrays, 4, 2, 5, 0.1, 0.0, w, v)
