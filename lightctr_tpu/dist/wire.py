@@ -40,7 +40,7 @@ def _pack_py(vals: np.ndarray) -> bytes:
     return bytes(out)
 
 
-def _unpack_py(buf: bytes, n: int) -> np.ndarray:
+def _unpack_py(buf: bytes, n: int) -> Tuple[np.ndarray, int]:
     out = np.empty(n, np.int64)
     pos = 0
     for i in range(n):
@@ -56,7 +56,7 @@ def _unpack_py(buf: bytes, n: int) -> np.ndarray:
                 break
             shift += 7
         out[i] = (u >> 1) ^ -(u & 1)
-    return out
+    return out, pos
 
 
 def pack_varint(vals: np.ndarray) -> bytes:
@@ -67,11 +67,18 @@ def pack_varint(vals: np.ndarray) -> bytes:
     return _pack_py(v)
 
 
+def split_varint(buf: bytes, n: int) -> Tuple[np.ndarray, int]:
+    """Decode exactly ``n`` int64 values; also returns the bytes consumed,
+    so framed messages can slice past the varint section without
+    re-encoding it."""
+    if bindings.available():
+        return bindings.varint_unpack_native(buf, n, return_consumed=True)
+    return _unpack_py(buf, n)
+
+
 def unpack_varint(buf: bytes, n: int) -> np.ndarray:
     """Decode exactly ``n`` int64 values."""
-    if bindings.available():
-        return bindings.varint_unpack_native(buf, n)
-    return _unpack_py(buf, n)
+    return split_varint(buf, n)[0]
 
 
 def pack_keys(keys: np.ndarray) -> bytes:
@@ -83,13 +90,17 @@ def pack_keys(keys: np.ndarray) -> bytes:
     return header + pack_varint(deltas)
 
 
+def split_keys(buf: bytes) -> Tuple[np.ndarray, int]:
+    """Decode a :func:`pack_keys` stream -> (sorted int64 keys, bytes
+    consumed)."""
+    hdr, hdr_len = split_varint(buf[:10], 1)
+    deltas, body_len = split_varint(buf[hdr_len:], int(hdr[0]))
+    return np.cumsum(deltas), hdr_len + body_len
+
+
 def unpack_keys(buf: bytes) -> np.ndarray:
     """Inverse of :func:`pack_keys` -> sorted int64 keys."""
-    n = int(unpack_varint(buf[:10], 1)[0])
-    # re-parse from the start, skipping the header's actual byte length
-    hdr_len = len(pack_varint(np.array([n], np.int64)))
-    deltas = unpack_varint(buf[hdr_len:], n)
-    return np.cumsum(deltas)
+    return split_keys(buf)[0]
 
 
 def pack_values(vals: np.ndarray) -> Tuple[bytes, tuple]:

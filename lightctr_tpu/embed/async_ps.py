@@ -192,6 +192,21 @@ class AsyncParamServer:
 
         wire_heartbeat(monitor, self)
 
+    def preload(self, values: Dict[int, np.ndarray]) -> None:
+        """Coordinator-side deterministic row init BEFORE workers start —
+        the master's syncInitializer broadcast (same contract as
+        ``ShmAsyncParamServer.preload``)."""
+        with self._lock:
+            for k, v in values.items():
+                row = np.asarray(v, np.float32).reshape(self.dim)
+                self._data[int(k)] = row.copy()
+                # overwrite, not setdefault: a lazily-created key must not
+                # keep its stale random shadow/accum after the coordinator
+                # re-initializes the row (DCASGD compensation would pull
+                # toward the discarded random init)
+                self._accum[int(k)] = np.zeros(self.dim, np.float32)
+                self._shadow[int(k)] = np.tile(row, (self.n_workers, 1))
+
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:
             return {k: v.copy() for k, v in self._data.items()}

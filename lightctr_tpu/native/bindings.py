@@ -381,8 +381,9 @@ def varint_pack_native(vals: np.ndarray) -> bytes:
     return out[:n].tobytes()
 
 
-def varint_unpack_native(buf: bytes, n: int) -> np.ndarray:
-    """Decode exactly ``n`` int64 values from a varint stream (native)."""
+def varint_unpack_native(buf: bytes, n: int, return_consumed: bool = False):
+    """Decode exactly ``n`` int64 values from a varint stream (native).
+    With ``return_consumed`` also returns the bytes consumed."""
     l_ = lib()
     if l_ is None:
         raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
@@ -396,7 +397,7 @@ def varint_unpack_native(buf: bytes, n: int) -> np.ndarray:
         raise ValueError("truncated varint stream")
     if rc == -2:
         raise ValueError("corrupt varint stream (value overflows 64 bits)")
-    return out
+    return (out, int(rc)) if return_consumed else out
 
 
 def _csr_flatten(arrays: dict, feature_cnt: int, with_fields: bool = False):

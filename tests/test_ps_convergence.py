@@ -44,3 +44,20 @@ def test_two_process_ps_training_converges_to_parity(rng, tmp_path):
     assert report["parity"]["auc"] < 0.05, report["parity"]
     assert report["parity"]["logloss"] < 0.1, report["parity"]
     assert report["final_ps"]["auc"] > 0.8, report["final_ps"]
+
+
+def test_tcp_transport_converges_to_parity(rng, tmp_path):
+    """Same demo over the network PS (wire-coded pull/push, dist/ps_server):
+    the multi-node transport must converge like the shared-memory one."""
+    arrays, f, field_cnt = _synthetic(rng)
+    report = run(
+        arrays=arrays, feature_cnt=f, field_cnt=field_cnt,
+        n_workers=2, epochs=6, batch_size=32, factor_dim=4,
+        workdir=str(tmp_path), transport="tcp",
+    )
+    for w in report["workers"]:
+        curve = w["loss_curve"]
+        assert curve[-1] < 0.7 * curve[0], curve
+    assert report["parity"]["auc"] < 0.05, report["parity"]
+    assert report["final_ps"]["auc"] > 0.8, report["final_ps"]
+    assert report["config"]["transport"] == "tcp"

@@ -1,0 +1,118 @@
+"""Network PS transport: wire-coded pull/push over TCP == direct store ops."""
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+DIM = 6
+
+
+@pytest.fixture
+def service():
+    ps = AsyncParamServer(dim=DIM, updater="adagrad", learning_rate=0.1,
+                          n_workers=2, seed=0)
+    svc = ParamServerService(ps)
+    yield svc
+    svc.close()
+
+
+def test_pull_push_roundtrip_matches_store(service, rng):
+    client = PSClient(service.address, DIM)
+    rows = {k: rng.normal(size=DIM).astype(np.float32) * 0.1
+            for k in (3, 17, 42, 1000)}
+    client.preload(rows)
+
+    pulled = client.pull([3, 17, 42, 1000], worker_epoch=0, worker_id=0)
+    for k, v in rows.items():
+        # hot path is fp16-coded (paramserver.h:161-163): half-precision agreement
+        np.testing.assert_allclose(pulled[k], v, atol=2e-3)
+
+    g = {3: np.full(DIM, 0.5, np.float32)}
+    assert client.push(0, g, worker_epoch=0)
+    after = client.pull([3], worker_epoch=0, worker_id=0)[3]
+    # adagrad first step: w -= lr * g / sqrt(g^2 + eps) = lr * sign(g)
+    np.testing.assert_allclose(after, rows[3] - 0.1, atol=4e-3)
+    client.close()
+
+
+def test_snapshot_is_exact_fp32(service, rng):
+    client = PSClient(service.address, DIM)
+    rows = {k: rng.normal(size=DIM).astype(np.float32) for k in range(10)}
+    client.preload(rows)
+    snap = client.snapshot()
+    for k, v in rows.items():
+        np.testing.assert_array_equal(snap[k], v)  # admin ops are exact
+    client.close()
+
+
+def test_wire_bytes_are_compact(service, rng):
+    """The point of the codecs: a pull request must cost ~bytes/key, not
+    8 (raw i64) + framing; pushed rows ride 2 bytes/element, not 4."""
+    client = PSClient(service.address, DIM)
+    keys = np.unique(rng.integers(0, 1 << 20, size=3000)).tolist()
+    sent_before = client.bytes_sent
+    client.pull(keys, worker_epoch=0, worker_id=0)
+    req_bytes = client.bytes_sent - sent_before
+    assert req_bytes < len(keys) * 4, (req_bytes, len(keys) * 8)
+
+    g = {k: rng.normal(size=DIM).astype(np.float32) for k in keys[:500]}
+    sent_before = client.bytes_sent
+    client.push(0, g, worker_epoch=0)
+    push_bytes = client.bytes_sent - sent_before
+    raw = 500 * (8 + DIM * 4)
+    assert push_bytes < 0.6 * raw, (push_bytes, raw)
+    client.close()
+
+
+def test_two_clients_share_one_store(service):
+    a = PSClient(service.address, DIM)
+    b = PSClient(service.address, DIM)
+    a.preload({7: np.ones(DIM, np.float32)})
+    assert a.push(0, {7: np.full(DIM, 0.25, np.float32)}, worker_epoch=0)
+    from_b = b.pull([7], worker_epoch=0, worker_id=1)[7]
+    np.testing.assert_allclose(from_b, 1.0 - 0.1, atol=4e-3)
+    a.close()
+    b.close()
+
+
+def test_ssp_withheld_pull_returns_none(rng):
+    ps = AsyncParamServer(dim=DIM, n_workers=2, staleness_threshold=2, seed=0)
+    svc = ParamServerService(ps)
+    try:
+        client = PSClient(svc.address, DIM)
+        g = {1: np.ones(DIM, np.float32)}
+        # worker 0 races ahead; worker 1 stays at epoch 0 -> staleness grows
+        for e in range(6):
+            client.push(0, g, worker_epoch=e)
+        client.push(1, g, worker_epoch=0)
+        assert client.pull([1], worker_epoch=10, worker_id=0) is None
+        assert client.withheld_pulls == 1
+        client.close()
+    finally:
+        svc.close()
+
+
+def test_empty_pull_and_push_are_benign(service):
+    client = PSClient(service.address, DIM)
+    out = client.pull([], worker_epoch=0, worker_id=0)
+    assert out == {}
+    assert client.push(0, {}, worker_epoch=0)
+    client.close()
+
+
+def test_unknown_message_type_raises_not_hangs(service):
+    client = PSClient(service.address, DIM)
+    with pytest.raises(RuntimeError, match="protocol skew"):
+        client._rpc(99, b"junk-free")
+    client.close()
+
+
+def test_close_severs_live_connections(service, rng):
+    client = PSClient(service.address, DIM)
+    client.preload({1: np.ones(DIM, np.float32)})
+    service.close()
+    with pytest.raises((ConnectionError, OSError)):
+        client.pull([1], worker_epoch=0, worker_id=0)
+    client.close()

@@ -23,7 +23,9 @@ def test_native_and_python_codecs_agree(rng):
     b_py = wire._pack_py(vals)
     if bindings.available():
         assert wire.pack_varint(vals) == b_py
-    np.testing.assert_array_equal(wire._unpack_py(b_py, len(vals)), vals)
+    out, consumed = wire._unpack_py(b_py, len(vals))
+    np.testing.assert_array_equal(out, vals)
+    assert consumed == len(b_py)
 
 
 def test_key_stream_roundtrip_and_compaction(rng):

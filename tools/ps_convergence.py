@@ -131,10 +131,16 @@ def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
     template = {k: tuple(v) for k, v in cfg["dense_template"]}
     dense_len = sum(int(np.prod(s)) for s in template.values())
 
-    ps = ShmAsyncParamServer.open(
-        base, n_workers=n_workers, updater=cfg["updater"],
-        learning_rate=cfg["lr"], staleness_threshold=cfg["staleness"],
-    )
+    if cfg.get("transport") == "tcp":
+        # multi-node form: wire-coded pull/push to the PS service
+        from lightctr_tpu.dist.ps_server import PSClient
+
+        ps = PSClient(tuple(cfg["address"]), row_dim)
+    else:
+        ps = ShmAsyncParamServer.open(
+            base, n_workers=n_workers, updater=cfg["updater"],
+            learning_rate=cfg["lr"], staleness_threshold=cfg["staleness"],
+        )
 
     data = payload  # the coordinator ships this worker's shard only
     n = len(data["labels"])
@@ -244,15 +250,22 @@ def run(
     arrays: Dict[str, np.ndarray] = None,
     field_cnt: int = None,
     feature_cnt: int = None,
+    transport: str = "shm",
 ) -> dict:
     """Returns the convergence/parity report (and leaves worker JSONs in
-    ``workdir``).  ``arrays`` overrides ``data_path`` for synthetic tests."""
+    ``workdir``).  ``arrays`` overrides ``data_path`` for synthetic tests.
+    ``transport``: "shm" = one-host shared-memory PS; "tcp" = the
+    multi-node form — workers talk wire-coded pull/push (varint keys +
+    fp16 rows, dist/ps_server.py) to a PS service over sockets."""
     import tempfile
 
     import jax
 
     from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
     from lightctr_tpu.models import widedeep
+
+    if transport not in ("shm", "tcp"):
+        raise ValueError(f"unknown transport {transport!r}")
 
     if arrays is None:
         from lightctr_tpu.data import load_libffm
@@ -276,12 +289,25 @@ def run(
     base = os.path.join(workdir, "ps")
     payload = {k: np.asarray(v) for k, v in arrays.items()}
     n_chunks = (len(dense_vec) + row_dim - 1) // row_dim
-    capacity = 2 * (feature_cnt + n_chunks + 16)
-    ps = ShmAsyncParamServer.create(
-        base, capacity=capacity, dim=row_dim, n_workers=n_workers,
-        updater=updater, learning_rate=lr, staleness_threshold=staleness,
-        seed=seed,
-    )
+    service = None
+    extra_cfg = {"transport": transport}
+    if transport == "tcp":
+        from lightctr_tpu.dist.ps_server import ParamServerService
+        from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+        ps = AsyncParamServer(
+            dim=row_dim, updater=updater, learning_rate=lr,
+            n_workers=n_workers, staleness_threshold=staleness, seed=seed,
+        )
+        service = ParamServerService(ps)
+        extra_cfg["address"] = list(service.address)
+    else:
+        capacity = 2 * (feature_cnt + n_chunks + 16)
+        ps = ShmAsyncParamServer.create(
+            base, capacity=capacity, dim=row_dim, n_workers=n_workers,
+            updater=updater, learning_rate=lr, staleness_threshold=staleness,
+            seed=seed,
+        )
     try:
         return _run_with_ps(
             ps=ps, base=base, workdir=workdir, payload=payload,
@@ -289,18 +315,22 @@ def run(
             n_workers=n_workers, epochs=epochs, batch_size=batch_size,
             D=D, row_dim=row_dim, n_chunks=n_chunks, lr=lr,
             updater=updater, staleness=staleness, seed=seed,
-            feature_cnt=feature_cnt,
+            feature_cnt=feature_cnt, extra_cfg=extra_cfg,
         )
     finally:
-        # close even when a worker dies mid-run: the four mmap handles (and
-        # a waiting SSP puller) must not outlive the failed attempt
-        ps.close()
+        # close even when a worker dies mid-run: the mmap handles / the
+        # listening socket (and a waiting SSP puller) must not outlive the
+        # failed attempt
+        if service is not None:
+            service.close()
+        else:
+            ps.close()
 
 
 def _run_with_ps(
     *, ps, base, workdir, payload, params0, template, dense_vec,
     n_workers, epochs, batch_size, D, row_dim, n_chunks, lr,
-    updater, staleness, seed, feature_cnt,
+    updater, staleness, seed, feature_cnt, extra_cfg=None,
 ):
     import jax
 
@@ -321,6 +351,7 @@ def _run_with_ps(
         "factor_dim": D, "batch_size": batch_size, "epochs": epochs,
         "lr": lr, "updater": updater, "staleness": staleness, "seed": seed,
         "dense_template": [(k, list(v)) for k, v in template.items()],
+        **(extra_cfg or {}),
     }
 
     ctx = mp.get_context("spawn")
@@ -403,6 +434,7 @@ def _run_with_ps(
             "batch_size": batch_size, "factor_dim": D, "lr": lr,
             "updater": updater, "staleness": staleness,
             "rows": int(len(payload["labels"])), "feature_cnt": int(feature_cnt),
+            "transport": (extra_cfg or {}).get("transport", "shm"),
         },
         "wall_time_s": round(wall, 2),
         "workers": curves,
@@ -429,13 +461,17 @@ def main():
     ap.add_argument("--factor-dim", type=int, default=8)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--updater", default="adagrad")
+    ap.add_argument(
+        "--transport", choices=("shm", "tcp"), default="shm",
+        help="shm = one-host shared-memory PS; tcp = wire-coded PS service",
+    )
     ap.add_argument("--out", default="PS_CONVERGENCE.json")
     args = ap.parse_args()
 
     report = run(
         data_path=args.data, n_workers=args.workers, epochs=args.epochs,
         batch_size=args.batch_size, factor_dim=args.factor_dim, lr=args.lr,
-        updater=args.updater,
+        updater=args.updater, transport=args.transport,
     )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
