@@ -210,20 +210,32 @@ def bench_nn(steps):
     rng = np.random.default_rng(1)
     cfg = TrainConfig(learning_rate=0.1, minibatch_size=50)
 
+    # XLA CPU's scan re-materializes loop state each iteration (~3x the
+    # dispatched step cost at LeNet sizes); the host dispatch loop is the
+    # right driver there, the on-device scan everywhere else
+    on_cpu = jax.devices()[0].platform == "cpu"
+
     out = []
     for batch in (50, 100, 200, 400):
         params = cnn.init(jax.random.PRNGKey(0), hidden=100, n_classes=10)
         tr = ClassifierTrainer(params, cnn.logits, cfg, n_classes=10)
-        tr.warmup_steps_scan(feats, labels, steps, batch)
         idx = jax.device_put(jnp.asarray(
             rng.integers(0, len(ds.features), size=(steps, batch)).astype(np.int32)
         ))
         jax.block_until_ready(idx)
+        if on_cpu:
+            # warm the gather-step compile
+            tr.fit_steps_loop(feats, labels, 1, batch, idx=idx[:1])
+        else:
+            tr.warmup_steps_scan(feats, labels, steps, batch)
 
         def one():
             tr.reset(params)
             t0 = time.perf_counter()
-            losses = tr.fit_steps_scan(feats, labels, steps, batch, idx=idx)
+            if on_cpu:
+                losses = tr.fit_steps_loop(feats, labels, steps, batch, idx=idx)
+            else:
+                losses = tr.fit_steps_scan(feats, labels, steps, batch, idx=idx)
             jax.block_until_ready(tr.params)
             dt = time.perf_counter() - t0
             assert np.isfinite(losses[-1]), "diverged"
@@ -262,6 +274,13 @@ def main():
         "quick": args.quick,
         "results": results,
     }
+    if jax.devices()[0].platform == "cpu":
+        payload["note"] = (
+            "FM/FFM cells: native CSR kernels; NN cells: XLA CPU with the "
+            "host dispatch-loop driver (lax.scan on XLA CPU re-materializes "
+            "loop state, ~3x the dispatched step cost). All cells one host "
+            "core."
+        )
     with open("BENCH_MATRIX.json", "w") as f:
         json.dump(payload, f, indent=2)
     print(f"wrote BENCH_MATRIX.json ({len(results)} cells)", file=sys.stderr)
