@@ -47,14 +47,22 @@ def build_parser() -> argparse.ArgumentParser:
                         f" file ({predictor}'s optional score dump)")
         return sp
 
-    for name in ("fm", "ffm", "nfm", "widedeep"):
+    def positive_int(v):
+        n = int(v)
+        if n < 1:
+            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
+        return n
+
+    for name in ("fm", "ffm", "nfm", "widedeep", "deepfm", "dcn"):
         sp = scoreable(common(sub.add_parser(name), lr=0.1, batch=50))  # main.cpp:56-59
         sp.add_argument("--factor", type=int, default=8)
         sp.add_argument("--l2", type=float, default=0.001)
         if name == "nfm":
             sp.add_argument("--hidden", type=int, default=32)
-        if name == "widedeep":
+        if name in ("widedeep", "deepfm", "dcn"):
             sp.add_argument("--hidden", type=int, default=50)
+        if name == "dcn":
+            sp.add_argument("--n-cross", type=positive_int, default=3)
         sp.add_argument("--full-batch", action="store_true",
                         help="train full-batch per epoch (the reference FM mode)")
 
@@ -76,12 +84,6 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--n-classes", type=int, default=1)
 
     # GBM leaf-index -> FTRL-LR stacked model (BASELINE config 5)
-    def positive_int(v):
-        n = int(v)
-        if n < 1:
-            raise argparse.ArgumentTypeError(f"must be >= 1, got {n}")
-        return n
-
     sp = scoreable(common(sub.add_parser("stack"), lr=0.6, batch=0))
     sp.add_argument("--n-trees", type=int, default=10)
     sp.add_argument("--max-depth", type=int, default=6)
@@ -141,8 +143,8 @@ def main(argv=None) -> int:
     )
     report = {"model": args.model}
 
-    if args.model in ("fm", "ffm", "nfm", "widedeep"):
-        from lightctr_tpu.models import fm, ffm, nfm, widedeep
+    if args.model in ("fm", "ffm", "nfm", "widedeep", "deepfm", "dcn"):
+        from lightctr_tpu.models import deepfm, fm, ffm, nfm, widedeep
         from lightctr_tpu.models.ctr_trainer import CTRTrainer
 
         ds = load_libffm(args.data)
@@ -161,13 +163,26 @@ def main(argv=None) -> int:
                 nfm.init(key, ds.feature_cnt, args.factor, args.hidden), nfm.logits,
             )
             fused = nfm.logits_with_l2
+        elif args.model == "deepfm":
+            params, logits = (
+                deepfm.init(key, ds.feature_cnt, ds.field_cnt, args.factor, args.hidden),
+                deepfm.logits,
+            )
+            fused = deepfm.logits_with_l2
+        elif args.model == "dcn":
+            params, logits = (
+                deepfm.dcn_init(key, ds.feature_cnt, ds.field_cnt, args.factor,
+                                n_cross=args.n_cross, hidden=args.hidden),
+                deepfm.dcn_logits,
+            )
+            fused = deepfm.dcn_logits_with_l2
         else:
             params, logits = (
                 widedeep.init(key, ds.feature_cnt, ds.field_cnt, args.factor, args.hidden),
                 widedeep.logits,
             )
         batch = ds.batch_dict()
-        if args.model == "widedeep":
+        if args.model in ("widedeep", "deepfm", "dcn"):
             rep, rep_mask = widedeep.field_representatives(ds.fids, ds.fields, ds.mask, ds.field_cnt)
             batch = widedeep.make_batch(ds, rep, rep_mask)
         tr = CTRTrainer(params, logits, cfg, fused_fn=fused)
@@ -182,7 +197,7 @@ def main(argv=None) -> int:
         if args.eval_data:
             ev = load_libffm(args.eval_data, feature_cnt=ds.feature_cnt, field_cnt=ds.field_cnt)
             evb = ev.batch_dict()
-            if args.model == "widedeep":
+            if args.model in ("widedeep", "deepfm", "dcn"):
                 rep, rep_mask = widedeep.field_representatives(ev.fids, ev.fields, ev.mask, ds.field_cnt)
                 evb = widedeep.make_batch(ev, rep, rep_mask)
             report["eval"] = tr.evaluate(evb)

@@ -66,7 +66,7 @@ def text_file(tmp_path_factory):
     return str(path)
 
 
-@pytest.mark.parametrize("model", ["ffm", "nfm", "widedeep"])
+@pytest.mark.parametrize("model", ["ffm", "nfm", "widedeep", "deepfm", "dcn"])
 def test_cli_ctr_family(capsys, libffm_file, model):
     report = run_cli(
         capsys, model, "--data", libffm_file, "--epochs", "3", "--full-batch"
