@@ -45,8 +45,8 @@ class CTRTrainer:
         the batch — a sum over the batch's touched features, like
         ``fm.l2_penalty`` (per-occurrence L2, train_fm_algo.cpp:108-115) —
         because it is divided by the batch size alongside the mean loss, and
-        under data parallelism (sharded batches or ``compress_bits``) each
-        replica contributes its local sum.  A batch-independent whole-table
+        under data parallelism (sharded batches, ``compress_bits``, or
+        ``zero_sharded``) each replica contributes its local sum.  A batch-independent whole-table
         norm would be over-counted n_devices-fold in the compressed path.
     fused_fn: optional (params, batch) -> (logits, l2) computing both from
         one set of gathers (e.g. fm.logits_with_l2); takes precedence over
@@ -69,6 +69,15 @@ class CTRTrainer:
     compress_range: symmetric quantization range; must bound a single
         device's gradient magnitudes (inputs are pre-divided by the ring size
         so partial sums stay inside it).
+    zero_sharded: cross-replica weight-update sharding (Xu et al. 2020,
+        arXiv:2004.13336 — the ZeRO-1 idea as XLA expresses it): instead of
+        every replica applying the identical full-size optimizer update, the
+        gradient is reduce-scattered over the ``data`` axis, each replica
+        updates only its 1/n shard of the flattened parameters with its 1/n
+        shard of optimizer state, and the new parameters are all-gathered.
+        Same trajectory as replicated data-parallel (tested); optimizer
+        state memory drops to 1/n per device and the update FLOPs shard
+        with it.
     """
 
     def __init__(
@@ -84,6 +93,7 @@ class CTRTrainer:
         compress_bits: Optional[int] = None,
         compress_range: float = 1.0,
         fused_adagrad: bool = False,
+        zero_sharded: bool = False,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -108,6 +118,17 @@ class CTRTrainer:
         self.mesh = mesh
         self.compress_bits = compress_bits
         self.compress_range = compress_range
+        self.zero_sharded = zero_sharded
+        if zero_sharded:
+            if mesh is None:
+                raise ValueError("zero_sharded requires a mesh (it shards the "
+                                 "update over the data axis)")
+            if param_shardings is not None or compress_bits is not None \
+                    or fused_adagrad:
+                raise ValueError(
+                    "zero_sharded composes with replicated params and the "
+                    "plain optax path only"
+                )
         if param_shardings is not None and mesh is None:
             raise ValueError("param_shardings requires a mesh")
         if compress_bits is not None:
@@ -126,6 +147,13 @@ class CTRTrainer:
         )
         if self._param_sharding is not None:
             self.params = jax.device_put(self.params, self._param_sharding)
+        if zero_sharded:
+            from jax.flatten_util import ravel_pytree
+
+            flat, self._zero_unravel = ravel_pytree(self.params)
+            n = mesh.shape["data"]
+            self._zero_len = flat.shape[0]
+            self._zero_pad = ((self._zero_len + n - 1) // n) * n
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
@@ -134,10 +162,13 @@ class CTRTrainer:
         self._scan_cache: Dict[int, Callable] = {}
 
     def _build_step(self):
-        """The training step: plain (XLA inserts psum for sharded batches) or
-        compressed-ring data-parallel when ``compress_bits`` is set."""
+        """The training step: plain (XLA inserts psum for sharded batches),
+        compressed-ring data-parallel when ``compress_bits`` is set, or the
+        sharded-weight-update form when ``zero_sharded`` is set."""
         if self.compress_bits is not None:
             return self._make_compressed_step()
+        if self.zero_sharded:
+            return self._make_zero_step()
         return self._make_step()
 
     def _make_loss_fn(self):
@@ -201,6 +232,55 @@ class CTRTrainer:
 
         return step
 
+    def _make_zero_step(self):
+        """Cross-replica sharded weight update (arXiv:2004.13336 / ZeRO-1):
+        per-device grads -> ``psum_scatter`` (mean reduce-scatter over the
+        data ring) -> each replica applies the optimizer to its 1/n shard of
+        the flattened parameters with its 1/n shard of state ->
+        ``all_gather`` of the new parameters.  One shard_map program; both
+        collectives ride the ICI ring."""
+        from jax.flatten_util import ravel_pytree
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        loss_fn = self._make_loss_fn()
+        tx = self.tx
+        mesh = self.mesh
+        n = mesh.shape["data"]
+        unravel = self._zero_unravel
+        L, Lpad = self._zero_len, self._zero_pad
+        shard_len = Lpad // n
+
+        def local_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            flat_g, _ = ravel_pytree(grads)
+            if Lpad != L:
+                flat_g = jnp.pad(flat_g, (0, Lpad - L))
+            g_shard = jax.lax.psum_scatter(
+                flat_g, "data", scatter_dimension=0, tiled=True
+            ) / n
+            flat_p, _ = ravel_pytree(params)
+            if Lpad != L:
+                flat_p = jnp.pad(flat_p, (0, Lpad - L))
+            idx = jax.lax.axis_index("data")
+            p_shard = jax.lax.dynamic_slice(
+                flat_p, (idx * shard_len,), (shard_len,)
+            )
+            updates, opt_state = tx.update(g_shard, opt_state, p_shard)
+            # same dtype-preserving apply convention as the other step paths
+            p_shard = optim_lib.apply_updates(p_shard, updates)
+            full = jax.lax.all_gather(p_shard, "data", tiled=True)[:L]
+            loss = jax.lax.pmean(loss, "data")
+            return unravel(full), opt_state, loss
+
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P("data"), P()),
+            check_vma=False,
+        )
+
     def _make_compressed_step(self):
         """Data-parallel step whose gradient exchange is an explicit ring
         all-reduce with a quantile codec on every hop (wire-compressed
@@ -261,6 +341,22 @@ class CTRTrainer:
     def _init_opt_state(self, params):
         """Optimizer-state factory — subclasses with non-optax table state
         override this (so no transient full-size optax state is allocated)."""
+        if self.zero_sharded:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            state = self.tx.init(jnp.zeros((self._zero_pad,), jnp.float32))
+            for leaf in jax.tree_util.tree_leaves(state):
+                if getattr(leaf, "shape", None) != (self._zero_pad,):
+                    raise ValueError(
+                        "zero_sharded needs an optimizer whose state is "
+                        "elementwise over the parameters (adagrad/rmsprop/"
+                        f"sgd-style); got a state leaf of shape "
+                        f"{getattr(leaf, 'shape', None)}"
+                    )
+            # 1/n of the flattened state lives on each data replica
+            return jax.device_put(
+                state, NamedSharding(self.mesh, P("data"))
+            )
         return self.tx.init(params)
 
     def _put(self, batch: Dict[str, np.ndarray]):
