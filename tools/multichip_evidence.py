@@ -12,7 +12,7 @@ on any machine — same trick as tests/conftest.py):
 
     python -m tools.multichip_evidence
 
-Writes MULTICHIP_r02.json.  Caveat recorded in the payload: with virtual CPU
+Writes MULTICHIP_r03.json.  Caveat recorded in the payload: with virtual CPU
 devices sharing one host, per-step times validate the sharded program's
 structure (collectives compile + execute), not ICI scaling efficiency — only
 a real multi-chip slice can measure that.
@@ -70,13 +70,14 @@ def embed_shardings(mesh):
     }
 
 
-def run(mesh=None, shardings=None, steps=STEPS):
+def run(mesh=None, shardings=None, steps=STEPS, zero_sharded=False):
     params = widedeep.init(
         jax.random.PRNGKey(0), FEATURE_CNT, FIELD_CNT, DIM, hidden=64
     )
     cfg = TrainConfig(learning_rate=0.05)
     tr = CTRTrainer(
-        params, widedeep.logits, cfg, mesh=mesh, param_shardings=shardings
+        params, widedeep.logits, cfg, mesh=mesh, param_shardings=shardings,
+        zero_sharded=zero_sharded,
     )
     batch = make_batch()
     tr.warmup_fullbatch_scan(batch, steps)
@@ -96,14 +97,19 @@ def main():
 
     runs = {}
     curves = {}
-    for spec_name, spec in (
-        ("data4_embed2", MeshSpec(data=4, embed=2)),
-        ("data8", MeshSpec(data=8)),
-        ("data2_embed4", MeshSpec(data=2, embed=4)),
+    for spec_name, spec, kw in (
+        ("data4_embed2", MeshSpec(data=4, embed=2), {}),
+        ("data8", MeshSpec(data=8), {}),
+        ("data2_embed4", MeshSpec(data=2, embed=4), {}),
+        # ZeRO-1 sharded weight update: same trajectory, 1/8 opt state/replica
+        ("data8_zero_sharded", MeshSpec(data=8), {"zero_sharded": True}),
     ):
         mesh = make_mesh(spec)
         print(f"{spec_name} run...")
-        lk, tk = run(mesh=mesh, shardings=embed_shardings(mesh))
+        if kw.get("zero_sharded"):
+            lk, tk = run(mesh=mesh, zero_sharded=True)
+        else:
+            lk, tk = run(mesh=mesh, shardings=embed_shardings(mesh))
         diff = np.max(np.abs(lk - l1))
         curves[spec_name] = lk
         runs[spec_name] = {
@@ -138,9 +144,9 @@ def main():
             "are validated; ICI scaling efficiency requires a real slice"
         ),
     }
-    with open("MULTICHIP_r02.json", "w") as f:
+    with open("MULTICHIP_r03.json", "w") as f:
         json.dump(payload, f, indent=1)
-    print("wrote MULTICHIP_r02.json")
+    print("wrote MULTICHIP_r03.json")
 
 
 if __name__ == "__main__":
