@@ -116,3 +116,19 @@ def test_close_severs_live_connections(service, rng):
     with pytest.raises((ConnectionError, OSError)):
         client.pull([1], worker_epoch=0, worker_id=0)
     client.close()
+
+
+def test_unrouted_worker_is_refused_over_the_wire(service):
+    """Failure detection reaches the network transport: after the
+    coordinator unroutes a worker (heartbeat-dead), its wire pulls return
+    None and pushes report dropped — master.h:202-262 semantics end to
+    end."""
+    client = PSClient(service.address, DIM)
+    client.preload({5: np.ones(DIM, np.float32)})
+    assert client.pull([5], worker_epoch=0, worker_id=1) is not None
+    service.ps.unroute_worker(1)
+    assert client.pull([5], worker_epoch=0, worker_id=1) is None
+    assert not client.push(1, {5: np.ones(DIM, np.float32)}, worker_epoch=0)
+    service.ps.readmit_worker(1)
+    assert client.pull([5], worker_epoch=0, worker_id=1) is not None
+    client.close()
