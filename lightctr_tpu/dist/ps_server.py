@@ -46,6 +46,11 @@ MSG_PRELOAD = 3
 MSG_SNAPSHOT = 4
 MSG_CLOSE = 5
 
+# One garbage length prefix must not make the server buffer gigabytes before
+# any validation: cap frames well above any real payload (2^20 keys at
+# dim 33 fp32 is ~132 MB).
+MAX_FRAME_BYTES = 256 * 1024 * 1024
+
 
 def _send_msg(sock: socket.socket, msg_type: int, payload: bytes) -> None:
     sock.sendall(struct.pack("<IB", len(payload), msg_type) + payload)
@@ -61,9 +66,19 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket) -> Tuple[int, bytes]:
+def _recv_msg(
+    sock: socket.socket, cap: Optional[int] = None
+) -> Tuple[int, bytes]:
     header = _recv_exact(sock, 5)
     length, msg_type = struct.unpack("<IB", header)
+    if cap is not None and length > cap:
+        # the SERVER rejects oversized inbound requests before allocating;
+        # the client passes no cap — a large snapshot reply (Criteo-scale
+        # vocab x fp32 rows) is legitimate and bounded by the u32 framing
+        raise ConnectionError(
+            f"frame length {length} exceeds cap {cap} "
+            "(corrupt prefix or protocol skew)"
+        )
     return msg_type, _recv_exact(sock, length) if length else b""
 
 
@@ -106,60 +121,66 @@ class ParamServerService:
         dim = self.ps.dim
         try:
             while True:
-                msg_type, payload = _recv_msg(conn)
-                if msg_type == MSG_PULL:
-                    hdr, hdr_len = wire.split_varint(payload, 2)
-                    wid = int(hdr[0]) - 1
-                    epoch = int(hdr[1])
-                    keys = wire.unpack_keys(payload[hdr_len:])
-                    rows = self.ps.pull(
-                        keys.tolist(), worker_epoch=epoch,
-                        worker_id=None if wid < 0 else wid,
-                    )
-                    if rows is None:
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x01")
-                    else:
-                        ordered = (
-                            np.stack([rows[int(k)] for k in keys])
-                            if len(keys)
-                            else np.zeros((0, dim), np.float32)
+                msg_type, payload = _recv_msg(conn, cap=MAX_FRAME_BYTES)
+                try:
+                    if msg_type == MSG_PULL:
+                        hdr, hdr_len = wire.split_varint(payload, 2)
+                        wid = int(hdr[0]) - 1
+                        epoch = int(hdr[1])
+                        keys = wire.unpack_keys(payload[hdr_len:])
+                        rows = self.ps.pull_batch(
+                            keys, worker_epoch=epoch,
+                            worker_id=None if wid < 0 else wid,
                         )
-                        body = (wire.pack_keys(keys)
-                                + ordered.astype(np.float16).tobytes())
+                        if rows is None:
+                            conn.sendall(struct.pack("<IB", 1, 0) + b"\x01")
+                        else:
+                            body = (wire.pack_keys(keys)
+                                    + rows.astype(np.float16).tobytes())
+                            conn.sendall(
+                                struct.pack("<IB", 1 + len(body), 0)
+                                + b"\x00" + body
+                            )
+                    elif msg_type == MSG_PUSH:
+                        hdr, hdr_len = wire.split_varint(payload, 2)
+                        wid, epoch = int(hdr[0]), int(hdr[1])
+                        keys, grads = _keys_and_rows(
+                            payload[hdr_len:], dim, np.float16
+                        )
+                        if len(keys) and not (np.diff(keys) > 0).all():
+                            # duplicate keys would mis-apply under the
+                            # vectorized (fancy-indexed) updater — refuse
+                            # the frame rather than corrupt rows
+                            raise ValueError("push keys must be unique")
+                        ok = self.ps.push_batch(
+                            wid, keys, grads, worker_epoch=epoch
+                        )
                         conn.sendall(
-                            struct.pack("<IB", 1 + len(body), 0) + b"\x00" + body
+                            struct.pack("<IB", 1, 0)
+                            + (b"\x00" if ok else b"\x01")
                         )
-                elif msg_type == MSG_PUSH:
-                    hdr, hdr_len = wire.split_varint(payload, 2)
-                    wid, epoch = int(hdr[0]), int(hdr[1])
-                    keys, grads = _keys_and_rows(
-                        payload[hdr_len:], dim, np.float16
-                    )
-                    ok = self.ps.push(
-                        wid, {int(k): grads[i] for i, k in enumerate(keys)},
-                        worker_epoch=epoch,
-                    )
-                    conn.sendall(
-                        struct.pack("<IB", 1, 0) + (b"\x00" if ok else b"\x01")
-                    )
-                elif msg_type == MSG_PRELOAD:
-                    keys, rows = _keys_and_rows(payload, dim, np.float32)
-                    self.ps.preload(
-                        {int(k): rows[i] for i, k in enumerate(keys)}
-                    )
-                    conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
-                elif msg_type == MSG_SNAPSHOT:
-                    snap = self.ps.snapshot()
-                    keys = np.array(sorted(snap), np.int64)
-                    rows = np.stack([snap[int(k)] for k in keys]) if len(keys) else \
-                        np.zeros((0, dim), np.float32)
-                    body = wire.pack_keys(keys) + rows.astype(np.float32).tobytes()
-                    conn.sendall(struct.pack("<IB", len(body), 0) + body)
-                elif msg_type == MSG_CLOSE:
-                    return
-                else:
-                    # protocol skew must error out, not deadlock the client
+                    elif msg_type == MSG_PRELOAD:
+                        keys, rows = _keys_and_rows(payload, dim, np.float32)
+                        self.ps.preload_batch(keys, rows)
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                    elif msg_type == MSG_SNAPSHOT:
+                        keys, rows = self.ps.snapshot_arrays()
+                        body = (wire.pack_keys(keys)
+                                + rows.astype(np.float32).tobytes())
+                        conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                    elif msg_type == MSG_CLOSE:
+                        return
+                    else:
+                        # protocol skew must error out, not deadlock the client
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                except (ValueError, struct.error):
+                    # malformed frame (truncated varint, row bytes not a
+                    # multiple of dim*n_keys, ...): reply with the protocol
+                    # error byte instead of killing the thread with a raw
+                    # traceback, then drop the connection — the stream can't
+                    # be trusted past a framing error
                     conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                    return
         except (ConnectionError, OSError):
             return
         finally:
@@ -212,20 +233,64 @@ class PSClient:
             )
         return reply
 
-    def pull(
-        self, keys, worker_epoch: int, worker_id: Optional[int] = None
-    ) -> Optional[Dict[int, np.ndarray]]:
+    def pull_arrays(
+        self,
+        keys: np.ndarray,
+        worker_epoch: int,
+        worker_id: Optional[int] = None,
+    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Vectorized pull -> (sorted keys, [n, dim] fp32 rows in that
+        order), or None when SSP-withheld/unrouted.  The hot path: no
+        per-key Python on either side of the wire."""
         hdr = wire.pack_varint(np.array(
             [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
             np.int64,
         ))
-        keys_arr = np.asarray(list(keys), np.int64)
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        if len(keys_arr) > 1 and not (np.diff(keys_arr) >= 0).all():
+            # the wire sorts the key stream (pack_keys), so an unsorted
+            # request would get rows back in a DIFFERENT order than asked —
+            # silent misalignment; fail loud instead
+            raise ValueError("pull_arrays keys must be sorted")
         reply = self._rpc(MSG_PULL, hdr + wire.pack_keys(keys_arr))
         if reply[:1] == b"\x01":
             self.withheld_pulls += 1
             return None
-        skeys, rows = _keys_and_rows(reply[1:], self.dim, np.float16)
+        return _keys_and_rows(reply[1:], self.dim, np.float16)
+
+    def pull(
+        self, keys, worker_epoch: int, worker_id: Optional[int] = None
+    ) -> Optional[Dict[int, np.ndarray]]:
+        out = self.pull_arrays(
+            np.asarray(list(keys), np.int64), worker_epoch, worker_id
+        )
+        if out is None:
+            return None
+        skeys, rows = out
         return {int(k): rows[i] for i, k in enumerate(skeys)}
+
+    def push_arrays(
+        self,
+        worker_id: int,
+        keys: np.ndarray,
+        rows: np.ndarray,
+        worker_epoch: int,
+    ) -> bool:
+        """Vectorized push of [n, dim] grads for SORTED-unique keys (the
+        wire's key stream is sorted; rows must already be in key order)."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        if len(keys_arr) > 1 and not (np.diff(keys_arr) > 0).all():
+            # pack_keys sorts the stream while the row bytes keep caller
+            # order: unsorted/duplicate keys would scatter grads onto the
+            # wrong rows with ok=True
+            raise ValueError("push_arrays keys must be sorted unique")
+        hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
+        payload = hdr + wire.pack_keys(keys_arr) + r.astype(np.float16).tobytes()
+        ok = self._rpc(MSG_PUSH, payload) == b"\x00"
+        if not ok:
+            self.dropped_pushes += 1
+        return ok
 
     def push(
         self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int
@@ -235,12 +300,16 @@ class PSClient:
             np.asarray(grads[int(k)], np.float32).reshape(self.dim)
             for k in keys
         ]) if len(keys) else np.zeros((0, self.dim), np.float32)
-        hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
-        payload = hdr + wire.pack_keys(keys) + rows.astype(np.float16).tobytes()
-        ok = self._rpc(MSG_PUSH, payload) == b"\x00"
-        if not ok:
-            self.dropped_pushes += 1
-        return ok
+        return self.push_arrays(worker_id, keys, rows, worker_epoch)
+
+    def preload_arrays(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Vectorized preload: rows[i] -> keys[i]; keys must be sorted
+        unique (admin op, exact fp32 bytes)."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        if len(keys_arr) > 1 and not (np.diff(keys_arr) > 0).all():
+            raise ValueError("preload_arrays keys must be sorted unique")
+        self._rpc(MSG_PRELOAD, wire.pack_keys(keys_arr) + r.tobytes())
 
     def preload(self, values: Dict[int, np.ndarray]) -> None:
         keys = np.array(sorted(values), np.int64)
@@ -248,11 +317,15 @@ class PSClient:
             np.asarray(values[int(k)], np.float32).reshape(self.dim)
             for k in keys
         ])
-        self._rpc(MSG_PRELOAD, wire.pack_keys(keys) + rows.tobytes())
+        self.preload_arrays(keys, rows)
+
+    def snapshot_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized snapshot -> (sorted keys, [n, dim] fp32 rows)."""
+        reply = self._rpc(MSG_SNAPSHOT, b"")
+        return _keys_and_rows(reply, self.dim, np.float32)
 
     def snapshot(self) -> Dict[int, np.ndarray]:
-        reply = self._rpc(MSG_SNAPSHOT, b"")
-        keys, rows = _keys_and_rows(reply, self.dim, np.float32)
+        keys, rows = self.snapshot_arrays()
         return {int(k): rows[i] for i, k in enumerate(keys)}
 
     def close(self) -> None:

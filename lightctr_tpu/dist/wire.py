@@ -55,6 +55,15 @@ def _unpack_py(buf: bytes, n: int) -> Tuple[np.ndarray, int]:
             if not b & 0x80:
                 break
             shift += 7
+            if shift > 63:
+                # match the native decoder's contract (varint.cpp rc=-2):
+                # a run of >10 continuation bytes is a corrupt stream, not
+                # a numpy OverflowError at assignment time
+                raise ValueError("corrupt varint stream")
+        # a final byte can still set bits >= 64 (shift == 63): the native
+        # decoder's uint64 arithmetic truncates silently, so mask to agree
+        # with it instead of overflowing the int64 assignment below
+        u &= 0xFFFFFFFFFFFFFFFF
         out[i] = (u >> 1) ^ -(u & 1)
     return out, pos
 

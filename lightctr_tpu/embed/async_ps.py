@@ -20,6 +20,13 @@ Reference semantics reproduced from ``distribut/paramserver.h``:
   - lazy param init: first pull of a key creates it ~ N(0,1)*sqrt(1/dim)
     (paramserver.h:315-339).
 
+Storage is slot-contiguous: weights / Adagrad accumulators / DCASGD shadow
+copies live in dense ``[capacity, dim]`` arrays with a key->slot index, so
+pull is one fancy-index gather and push is one vectorized updater step over
+the whole batch — the role the reference fills with lock-free per-key C++
+serving at scale (paramserver.h:138-210).  The per-key dict API is kept as a
+thin wrapper for parity tests; the hot path is ``pull_batch``/``push_batch``.
+
 Workers here are threads or host processes driving device steps; the "wire"
 is in-process numpy (the reference's VarUint+fp16 codec belongs to ZeroMQ
 transport, which has no equivalent need on a single host).
@@ -33,6 +40,53 @@ from typing import Dict, Optional
 import numpy as np
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
+
+
+class _RowView:
+    """Dict-like window onto one slot-contiguous array, keyed by feature id.
+    Exists so parity tests can keep poking ``ps._data[key]`` / setting rows
+    directly, exactly as they could when the store was a dict of rows."""
+
+    def __init__(self, store: "AsyncParamServer", attr: str):
+        self._store = store
+        self._attr = attr  # the backing array is re-allocated on growth;
+        # resolve it by name at every access
+
+    def _arr(self) -> np.ndarray:
+        return getattr(self._store, self._attr)
+
+    def __getitem__(self, key: int) -> np.ndarray:
+        slot = self._store._slot[int(key)]
+        if self._attr == "_shw":
+            return self._arr()[:, slot]
+        return self._arr()[slot]
+
+    def __setitem__(self, key: int, value) -> None:
+        # direct set creates the slot WITHOUT an RNG draw (a plain dict store
+        # would likewise not consume randomness on assignment)
+        slot = self._store._slot_for_set(int(key))
+        if self._attr == "_shw":
+            self._arr()[:, slot] = np.asarray(value, np.float32)
+        else:
+            self._arr()[slot] = np.asarray(value, np.float32).reshape(
+                self._store.dim
+            )
+
+    def __contains__(self, key: int) -> bool:
+        return int(key) in self._store._slot
+
+    def __len__(self) -> int:
+        return self._store._n
+
+    def keys(self):
+        return self._store._slot.keys()
+
+    def items(self):
+        for k, slot in self._store._slot.items():
+            if self._attr == "_shw":
+                yield k, self._arr()[:, slot]
+            else:
+                yield k, self._arr()[slot]
 
 
 class AsyncParamServer:
@@ -62,9 +116,17 @@ class AsyncParamServer:
         self.eps = eps
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
-        self._data: Dict[int, np.ndarray] = {}
-        self._accum: Dict[int, np.ndarray] = {}
-        self._shadow: Dict[int, np.ndarray] = {}  # key -> [n_workers, dim]
+        # slot-contiguous storage + key->slot index
+        self._slot: Dict[int, int] = {}
+        self._n = 0
+        self._cap = 0
+        self._W = np.zeros((0, dim), np.float32)
+        self._acc = np.zeros((0, dim), np.float32)
+        self._shw = np.zeros((n_workers, 0, dim), np.float32)
+        # dict-like parity views (same names the dict-backed store exposed)
+        self._data = _RowView(self, "_W")
+        self._accum = _RowView(self, "_acc")
+        self._shadow = _RowView(self, "_shw")
         self.last_epoch_version = 0
         self.staleness = 0
         self.staleness_worker: Optional[int] = None
@@ -79,19 +141,81 @@ class AsyncParamServer:
 
     # -- storage -----------------------------------------------------------
 
-    def _check_and_find(self, key: int) -> np.ndarray:
-        """Lazy init ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339)."""
-        v = self._data.get(key)
-        if v is None:
-            v = (self._rng.standard_normal(self.dim) * np.sqrt(1.0 / self.dim)).astype(
-                np.float32
+    def _grow(self, need: int) -> None:
+        if need <= self._cap:
+            return
+        cap = max(64, self._cap)
+        while cap < need:
+            cap *= 2
+        for name in ("_W", "_acc"):
+            old = getattr(self, name)
+            new = np.zeros((cap, self.dim), np.float32)
+            new[: self._n] = old[: self._n]
+            setattr(self, name, new)
+        old = self._shw
+        new = np.zeros((self.n_workers, cap, self.dim), np.float32)
+        new[:, : self._n] = old[:, : self._n]
+        self._shw = new
+        self._cap = cap
+
+    def _slot_for_set(self, key: int) -> int:
+        """Slot for a direct row assignment: allocate zero-filled, no RNG."""
+        slot = self._slot.get(key)
+        if slot is None:
+            self._grow(self._n + 1)
+            slot = self._n
+            self._slot[key] = slot
+            self._n += 1
+        return slot
+
+    def _slots_create(self, keys: np.ndarray) -> np.ndarray:
+        """key->slot for a batch, lazily creating missing keys in
+        first-occurrence order ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339).
+        The batch RNG draw consumes the stream in the same order as the old
+        one-key-at-a-time creation, so seeded trajectories are unchanged."""
+        get = self._slot.get
+        slots = np.fromiter(
+            (get(int(k), -1) for k in keys), np.int64, count=len(keys)
+        )
+        miss_idx = np.flatnonzero(slots < 0)
+        if miss_idx.size:
+            miss_keys = keys[miss_idx]
+            uniq, first = np.unique(miss_keys, return_index=True)
+            new_keys = uniq[np.argsort(first)]  # first-occurrence order
+            m = len(new_keys)
+            self._grow(self._n + m)
+            rows = (
+                self._rng.standard_normal((m, self.dim))
+                * np.sqrt(1.0 / self.dim)
+            ).astype(np.float32)
+            sl = np.arange(self._n, self._n + m)
+            self._W[sl] = rows
+            self._acc[sl] = 0.0
+            self._shw[:, sl] = rows  # every worker's shadow starts at init
+            for k, s in zip(new_keys.tolist(), sl.tolist()):
+                self._slot[k] = s
+            self._n += m
+            slots[miss_idx] = np.fromiter(
+                (self._slot[int(k)] for k in miss_keys),
+                np.int64,
+                count=miss_idx.size,
             )
-            self._data[key] = v
-            self._accum[key] = np.zeros(self.dim, np.float32)
-            self._shadow[key] = np.tile(v, (self.n_workers, 1))
-        return v
+        return slots
 
     # -- protocol ----------------------------------------------------------
+
+    def _pull_gate(self, worker_epoch: int, worker_id: Optional[int]) -> bool:
+        """True when the pull may proceed; bumps reject/withhold counters."""
+        if worker_id is not None and worker_id in self._unrouted:
+            self.rejected_pulls += 1
+            return False
+        if (
+            worker_epoch > self.last_epoch_version
+            and self.staleness > self.staleness_threshold
+        ):
+            self.withheld_pulls += 1
+            return False
+        return True
 
     def pull(
         self, keys, worker_epoch: int, worker_id: Optional[int] = None
@@ -105,66 +229,120 @@ class AsyncParamServer:
         id on its connection; this API models that only when told who is
         asking).  Anonymous pulls skip the route check."""
         with self._lock:
-            if worker_id is not None and worker_id in self._unrouted:
-                self.rejected_pulls += 1
+            if not self._pull_gate(worker_epoch, worker_id):
                 return None
-            if (
-                worker_epoch > self.last_epoch_version
-                and self.staleness > self.staleness_threshold
-            ):
-                self.withheld_pulls += 1
+            keys_arr = np.fromiter(
+                (int(k) for k in keys), np.int64
+            ) if not isinstance(keys, np.ndarray) else keys.astype(np.int64)
+            # evaluate _slots_create BEFORE indexing: creation can grow
+            # (reallocate) the backing array
+            slots = self._slots_create(keys_arr)
+            rows = self._W[slots]
+            return {int(k): rows[i] for i, k in enumerate(keys_arr)}
+
+    def pull_batch(
+        self,
+        keys: np.ndarray,
+        worker_epoch: int,
+        worker_id: Optional[int] = None,
+    ) -> Optional[np.ndarray]:
+        """Vectorized pull: ``[n, dim]`` rows in ``keys`` order (a fresh
+        copy), or None when withheld/unrouted.  The network PS hot path."""
+        with self._lock:
+            if not self._pull_gate(worker_epoch, worker_id):
                 return None
-            return {int(k): self._check_and_find(int(k)).copy() for k in keys}
+            keys_arr = np.ascontiguousarray(keys, np.int64)
+            slots = self._slots_create(keys_arr)
+            return self._W[slots]
+
+    def _push_gate(self, worker_id: int, worker_epoch: int) -> bool:
+        """Routing + staleness-ledger bookkeeping (paramserver.h:189-205);
+        True when the push should apply."""
+        if worker_id in self._unrouted:
+            self.rejected_pushes += 1
+            return False
+        behind = self.last_epoch_version - worker_epoch
+        if self.staleness > 0 and worker_id == self.staleness_worker:
+            self.staleness = max(0, behind)
+        if behind > self.staleness:
+            self.staleness = behind
+            self.staleness_worker = worker_id
+        if worker_epoch + self.staleness_threshold < self.last_epoch_version:
+            self.dropped_pushes += 1
+            return False
+        self.last_epoch_version = max(self.last_epoch_version, worker_epoch)
+        return True
+
+    def _apply(
+        self, worker_id: int, slots: np.ndarray, g: np.ndarray
+    ) -> None:
+        """One vectorized updater step over a batch of unique slots
+        (paramserver.h:252-300)."""
+        if self.updater == "sgd":
+            self._W[slots] -= self.lr * g
+        elif self.updater == "adagrad":
+            acc = self._acc[slots] + g * g
+            self._acc[slots] = acc
+            self._W[slots] -= self.lr * g / np.sqrt(acc + self.eps)
+        elif self.updater == "dcasgd":
+            w = self._W[slots]
+            shadow = self._shw[worker_id, slots]
+            w -= self.lr * (
+                g + self.dcasgd_lambda * g * g * (w - shadow)
+            )
+            self._W[slots] = w
+            self._shw[worker_id, slots] = w
+        elif self.updater == "dcasgda":
+            acc = (
+                self.momentum_rate * self._acc[slots]
+                + (1.0 - self.momentum_rate) * g * g
+            )
+            self._acc[slots] = acc
+            w = self._W[slots]
+            shadow = self._shw[worker_id, slots]
+            w -= self.lr * (
+                g
+                + self.dcasgd_lambda
+                * g
+                * g
+                * (w - shadow)
+                / np.sqrt(acc + self.eps)
+            )
+            self._W[slots] = w
+            self._shw[worker_id, slots] = w
 
     def push(self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int) -> bool:
         """Apply per-key grads; returns False when dropped as too stale
         (paramserver.h:201-205) or when the worker is unrouted (heartbeat
         declared it dead).  Grads are batch-summed; they are divided by the
         minibatch size by the caller (we take pre-averaged grads)."""
-        with self._lock:
-            if worker_id in self._unrouted:
-                self.rejected_pushes += 1
-                return False
-            # staleness ledger (paramserver.h:189-200)
-            behind = self.last_epoch_version - worker_epoch
-            if self.staleness > 0 and worker_id == self.staleness_worker:
-                self.staleness = max(0, behind)
-            if behind > self.staleness:
-                self.staleness = behind
-                self.staleness_worker = worker_id
-            if worker_epoch + self.staleness_threshold < self.last_epoch_version:
-                self.dropped_pushes += 1
-                return False
-            self.last_epoch_version = max(self.last_epoch_version, worker_epoch)
+        keys = np.fromiter((int(k) for k in grads), np.int64, count=len(grads))
+        if len(grads):
+            g = np.stack(
+                [np.asarray(v, np.float32).reshape(self.dim)
+                 for v in grads.values()]
+            )
+        else:
+            g = np.zeros((0, self.dim), np.float32)
+        return self.push_batch(worker_id, keys, g, worker_epoch)
 
-            for key, g in grads.items():
-                key = int(key)
-                g = np.asarray(g, np.float32).reshape(self.dim)
-                w = self._check_and_find(key)
-                if self.updater == "sgd":
-                    w -= self.lr * g
-                elif self.updater == "adagrad":
-                    self._accum[key] += g * g
-                    w -= self.lr * g / np.sqrt(self._accum[key] + self.eps)
-                elif self.updater == "dcasgd":
-                    shadow = self._shadow[key][worker_id]
-                    comp = g + self.dcasgd_lambda * g * g * (w - shadow)
-                    w -= self.lr * comp
-                    self._shadow[key][worker_id] = w.copy()
-                elif self.updater == "dcasgda":
-                    self._accum[key] = self.momentum_rate * self._accum[key] + (
-                        1.0 - self.momentum_rate
-                    ) * g * g
-                    shadow = self._shadow[key][worker_id]
-                    comp = g + (
-                        self.dcasgd_lambda
-                        * g
-                        * g
-                        * (w - shadow)
-                        / np.sqrt(self._accum[key] + self.eps)
-                    )
-                    w -= self.lr * comp
-                    self._shadow[key][worker_id] = w.copy()
+    def push_batch(
+        self,
+        worker_id: int,
+        keys: np.ndarray,
+        grads: np.ndarray,
+        worker_epoch: int,
+    ) -> bool:
+        """Vectorized push of ``[n, dim]`` grads for UNIQUE ``keys`` (the
+        wire sends sorted-unique key streams); one fancy-indexed updater
+        step instead of a per-key Python loop."""
+        with self._lock:
+            if not self._push_gate(worker_id, worker_epoch):
+                return False
+            if len(keys):
+                keys_arr = np.ascontiguousarray(keys, np.int64)
+                g = np.asarray(grads, np.float32).reshape(-1, self.dim)
+                self._apply(worker_id, self._slots_create(keys_arr), g)
             return True
 
     # -- liveness routing (master.h:202-262 / network.h:148-151) ------------
@@ -196,17 +374,52 @@ class AsyncParamServer:
         """Coordinator-side deterministic row init BEFORE workers start —
         the master's syncInitializer broadcast (same contract as
         ``ShmAsyncParamServer.preload``)."""
+        keys = np.fromiter(
+            (int(k) for k in values), np.int64, count=len(values)
+        )
+        rows = (
+            np.stack(
+                [np.asarray(v, np.float32).reshape(self.dim)
+                 for v in values.values()]
+            )
+            if len(values)
+            else np.zeros((0, self.dim), np.float32)
+        )
+        self.preload_batch(keys, rows)
+
+    def preload_batch(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Vectorized preload: rows[i] becomes the value of keys[i].
+        Overwrites accum/shadow, not setdefault: a lazily-created key must
+        not keep its stale random shadow/accum after the coordinator
+        re-initializes the row (DCASGD compensation would pull toward the
+        discarded random init)."""
         with self._lock:
-            for k, v in values.items():
-                row = np.asarray(v, np.float32).reshape(self.dim)
-                self._data[int(k)] = row.copy()
-                # overwrite, not setdefault: a lazily-created key must not
-                # keep its stale random shadow/accum after the coordinator
-                # re-initializes the row (DCASGD compensation would pull
-                # toward the discarded random init)
-                self._accum[int(k)] = np.zeros(self.dim, np.float32)
-                self._shadow[int(k)] = np.tile(row, (self.n_workers, 1))
+            keys_arr = np.ascontiguousarray(keys, np.int64)
+            r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+            slots = np.fromiter(
+                (self._slot_for_set(int(k)) for k in keys_arr),
+                np.int64,
+                count=len(keys_arr),
+            )
+            self._W[slots] = r
+            self._acc[slots] = 0.0
+            self._shw[:, slots] = r
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:
-            return {k: v.copy() for k, v in self._data.items()}
+            return {
+                k: self._W[slot].copy() for k, slot in self._slot.items()
+            }
+
+    def snapshot_arrays(self):
+        """Vectorized snapshot -> (sorted int64 keys, [n, dim] rows)."""
+        with self._lock:
+            keys = np.fromiter(
+                self._slot.keys(), np.int64, count=len(self._slot)
+            )
+            order = np.argsort(keys, kind="stable")
+            keys = keys[order]
+            slots = np.fromiter(
+                self._slot.values(), np.int64, count=len(self._slot)
+            )[order]
+            return keys, self._W[slots]

@@ -55,7 +55,7 @@ def test_wire_bytes_are_compact(service, rng):
     sent_before = client.bytes_sent
     client.pull(keys, worker_epoch=0, worker_id=0)
     req_bytes = client.bytes_sent - sent_before
-    assert req_bytes < len(keys) * 4, (req_bytes, len(keys) * 8)
+    assert req_bytes < len(keys) * 4, (req_bytes, len(keys) * 4)
 
     g = {k: rng.normal(size=DIM).astype(np.float32) for k in keys[:500]}
     sent_before = client.bytes_sent
@@ -115,6 +115,54 @@ def test_close_severs_live_connections(service, rng):
     service.close()
     with pytest.raises((ConnectionError, OSError)):
         client.pull([1], worker_epoch=0, worker_id=0)
+    client.close()
+
+
+def test_malformed_frame_gets_protocol_error_not_silence(service):
+    """A syntactically-valid frame with garbage payload (truncated varint /
+    rows not a multiple of dim*n_keys) must come back as the protocol error
+    byte, not an abrupt disconnect from a dead server thread."""
+    client = PSClient(service.address, DIM)
+    with pytest.raises(RuntimeError, match="protocol skew"):
+        client._rpc(2, b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff")
+    client.close()
+
+
+def test_oversized_length_prefix_is_rejected(service):
+    """One garbage length prefix must not make the server buffer GiBs: the
+    connection is dropped before any allocation (ADVICE r3)."""
+    import socket
+    import struct
+
+    from lightctr_tpu.dist import ps_server as mod
+
+    raw = socket.create_connection(service.address)
+    try:
+        raw.sendall(struct.pack("<IB", mod.MAX_FRAME_BYTES + 1, 1))
+        raw.settimeout(5.0)
+        assert raw.recv(1) == b""  # server hung up without buffering
+    finally:
+        raw.close()
+
+
+def test_batch_array_api_matches_dict_api(service, rng):
+    """pull_arrays/push_arrays are the same protocol as pull/push — byte
+    format, ordering, and updater math."""
+    client = PSClient(service.address, DIM)
+    keys = np.array([2, 9, 55, 1 << 19], np.int64)
+    rows = rng.normal(size=(len(keys), DIM)).astype(np.float32)
+    client.preload_arrays(keys, rows)
+
+    skeys, got = client.pull_arrays(keys, worker_epoch=0, worker_id=0)
+    np.testing.assert_array_equal(skeys, keys)
+    np.testing.assert_allclose(got, rows, atol=2e-3)
+
+    g = np.full((len(keys), DIM), 0.5, np.float32)
+    assert client.push_arrays(0, keys, g, worker_epoch=0)
+    after = client.pull(keys.tolist(), worker_epoch=0, worker_id=0)
+    for i, k in enumerate(keys):
+        # adagrad first step: w -= lr * sign(g)
+        np.testing.assert_allclose(after[int(k)], rows[i] - 0.1, atol=4e-3)
     client.close()
 
 

@@ -55,3 +55,21 @@ def test_value_codec_fp16_roundtrip(rng):
     assert len(buf) == v.size * 2  # half the fp32 bytes on the wire
     out = wire.unpack_values(buf, shape)
     np.testing.assert_allclose(out, v, atol=2e-4)
+
+
+def test_python_fallback_malformed_varint_error_contract():
+    """The Python fallback must agree with the native decoder on malformed
+    input: >10 continuation bytes is a defined ValueError (varint.cpp
+    rc=-2), and a 10-byte varint whose final byte sets bits >= 64 truncates
+    through uint64 arithmetic — never a raw OverflowError."""
+    with pytest.raises(ValueError, match="corrupt varint"):
+        wire._unpack_py(b"\xff" * 11, 1)
+    with pytest.raises(ValueError, match="truncated varint"):
+        wire._unpack_py(b"\xff\xff", 1)
+    # shift == 63 with high bits in the final byte: defined (truncated)
+    # value, not OverflowError on the int64 assignment
+    out, consumed = wire._unpack_py(b"\xff" * 9 + b"\x7f", 1)
+    assert consumed == 10
+    if bindings.available():
+        native_out = bindings.varint_unpack_native(b"\xff" * 9 + b"\x7f", 1)
+        np.testing.assert_array_equal(out, native_out)

@@ -109,6 +109,40 @@ def _pull_retry(ps, keys, epoch, worker_id=None, max_wait_s: float = 30.0):
         time.sleep(0.002)
 
 
+def _pull_rows_retry(ps, keys_sorted, epoch, worker_id=None,
+                     max_wait_s: float = 30.0):
+    """Array-form pull with SSP retry -> [n, dim] rows in ``keys_sorted``
+    order.  Rides the vectorized wire path when the PS offers one
+    (PSClient.pull_arrays); the shm PS keeps its dict protocol."""
+    t0 = time.time()
+    use_arrays = hasattr(ps, "pull_arrays")
+    while True:
+        if use_arrays:
+            out = ps.pull_arrays(keys_sorted, worker_epoch=epoch,
+                                 worker_id=worker_id)
+            if out is not None:
+                return out[1]
+        else:
+            d = ps.pull(keys_sorted.tolist(), worker_epoch=epoch,
+                        worker_id=worker_id)
+            if d is not None:
+                return np.stack([d[int(k)] for k in keys_sorted])
+        if time.time() - t0 > max_wait_s:
+            raise TimeoutError("SSP pull withheld for too long")
+        time.sleep(0.002)
+
+
+def _push_rows(ps, worker_id, keys_sorted, rows, epoch) -> bool:
+    """Array-form push of rows[i] -> keys_sorted[i]."""
+    if hasattr(ps, "push_arrays"):
+        return ps.push_arrays(worker_id, keys_sorted, rows, worker_epoch=epoch)
+    return ps.push(
+        worker_id,
+        {int(k): rows[i] for i, k in enumerate(keys_sorted)},
+        worker_epoch=epoch,
+    )
+
+
 # ---------------------------------------------------------------------------
 # worker process
 
@@ -146,6 +180,10 @@ def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
     n = len(data["labels"])
     if n < B:
         raise ValueError(f"worker shard has {n} rows < batch size {B}")
+    if int(data["fids"].max()) >= DENSE_BASE:
+        # the sparse/dense key split relies on DENSE_BASE dwarfing every
+        # fid (keeps all_keys sorted); fail loud, not silently misaligned
+        raise ValueError("feature id >= DENSE_BASE; raise DENSE_BASE")
 
     P = data["fids"].shape[1]
     FLD = data["rep_fids"].shape[1]
@@ -180,14 +218,18 @@ def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
             uw_pad = np.pad(uw, (0, U_w - len(uw)), mode="edge")
             ue_pad = np.pad(ue, (0, U_e - len(ue)), mode="edge")
 
-            keys = sorted(set(uw.tolist()) | set(ue.tolist()))
-            dense_keys = [DENSE_BASE + i
-                          for i in range((dense_len + row_dim - 1) // row_dim)]
-            pulled = _pull_retry(ps, keys + dense_keys, epoch, worker_id)
+            sparse_keys = np.union1d(uw, ue)
+            n_dense = (dense_len + row_dim - 1) // row_dim
+            dense_keys = DENSE_BASE + np.arange(n_dense, dtype=np.int64)
+            # DENSE_BASE dwarfs every fid, so concat stays sorted
+            all_keys = np.concatenate([sparse_keys, dense_keys])
+            rows = _pull_rows_retry(ps, all_keys, epoch, worker_id)
 
-            wide_rows = np.stack([pulled[int(k)] for k in uw_pad])[:, 0]
-            embed_rows = np.stack([pulled[int(k)] for k in ue_pad])[:, 1:]
-            dvec = np.concatenate([pulled[k] for k in dense_keys])[:dense_len]
+            iw = np.searchsorted(sparse_keys, uw_pad)
+            ie = np.searchsorted(sparse_keys, ue_pad)
+            wide_rows = rows[iw, 0]
+            embed_rows = rows[ie, 1:]
+            dvec = rows[len(sparse_keys):].reshape(-1)[:dense_len]
             mlp = _unflatten_dense(dvec, template)
 
             batch = {
@@ -207,16 +249,20 @@ def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
             ep_losses.append(float(loss))
 
             g_w, g_e = np.asarray(g_w), np.asarray(g_e)
-            grads: Dict[int, np.ndarray] = {}
-            for i, k in enumerate(uw):
-                row = grads.setdefault(int(k), np.zeros(row_dim, np.float32))
-                row[0] += g_w[i]
-            for i, k in enumerate(ue):
-                row = grads.setdefault(int(k), np.zeros(row_dim, np.float32))
-                row[1:] += g_e[i]
+            # one [n_keys, row_dim] grad block: wide grads in col 0, embed
+            # grads in cols 1:, dense chunk grads appended.  Grads of padded
+            # (edge-repeated) rows are dropped exactly as before — no batch
+            # position maps past len(uw)/len(ue), so they are identically 0.
+            G = np.zeros((len(all_keys), row_dim), np.float32)
+            # iw/ie prefixes already hold searchsorted(sparse_keys, uw/ue)
+            G[iw[: len(uw)], 0] = g_w[: len(uw)]
+            G[ie[: len(ue)], 1:] = g_e[: len(ue)]
             g_dense = _flatten_dense({"fc1": g_fc1, "fc2": g_fc2})
-            grads.update(_dense_chunks(g_dense, row_dim))
-            ps.push(worker_id, grads, worker_epoch=epoch)
+            pad = n_dense * row_dim - dense_len
+            G[len(sparse_keys):] = np.pad(g_dense, (0, pad)).reshape(
+                n_dense, row_dim
+            )
+            _push_rows(ps, worker_id, all_keys, G, epoch)
         curve.append(float(np.mean(ep_losses)))
 
     with open(os.path.join(out_dir, f"worker_{worker_id}.json"), "w") as f:

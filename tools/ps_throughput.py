@@ -1,0 +1,118 @@
+"""Network-PS micro-benchmark: keys/s, MB/s, request latency percentiles.
+
+The reference's PS is its production serving path — lock-free concurrent
+push/pull at full training throughput (``distribut/paramserver.h:138-210``).
+This tool measures what the repo's network PS (``dist/ps_server.py``, the
+socket transport over the slot-contiguous ``AsyncParamServer`` store)
+actually serves: timed pull and push rounds at Criteo-ish key-batch sizes,
+for the two dims the reference's benchmarks exercise (dim=9 ~ FM row
+1+k8; dim=33 ~ W&D row 1+k32).
+
+Run:  python -m tools.ps_throughput [--out PS_THROUGHPUT.json]
+Emits one JSON artifact with, per (dim, keys-per-request) cell:
+  pull/push keys-per-second, payload MB/s, p50/p99 request latency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _percentiles(lat_s):
+    a = np.asarray(lat_s)
+    return {
+        "p50_us": round(float(np.percentile(a, 50)) * 1e6, 1),
+        "p99_us": round(float(np.percentile(a, 99)) * 1e6, 1),
+    }
+
+
+def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
+    from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=dim, updater="adagrad", learning_rate=0.05,
+                          n_workers=1, seed=seed)
+    svc = ParamServerService(ps)
+    client = PSClient(svc.address, dim)
+    rng = np.random.default_rng(seed)
+
+    # warm the store so pulls hit existing rows (steady-state serving, not
+    # lazy-init cost) and warm both code paths once
+    warm = np.arange(0, vocab, max(1, vocab // keys_per_req))[:keys_per_req]
+    client.pull_arrays(warm, worker_epoch=0, worker_id=0)
+
+    batches = [
+        np.unique(rng.integers(0, vocab, keys_per_req * 2))[:keys_per_req]
+        for _ in range(n_req)
+    ]
+    grads = rng.standard_normal((keys_per_req, dim)).astype(np.float32) * 0.01
+
+    t0 = time.perf_counter()
+    pull_lat = []
+    pulled_keys = 0
+    for keys in batches:
+        t = time.perf_counter()
+        out = client.pull_arrays(keys, worker_epoch=0, worker_id=0)
+        pull_lat.append(time.perf_counter() - t)
+        pulled_keys += len(out[0])
+    pull_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    push_lat = []
+    pushed_keys = 0
+    for e, keys in enumerate(batches):
+        t = time.perf_counter()
+        client.push_arrays(0, keys, grads[: len(keys)], worker_epoch=e)
+        push_lat.append(time.perf_counter() - t)
+        pushed_keys += len(keys)
+    push_wall = time.perf_counter() - t0
+
+    # payload accounting straight from the client's byte counters
+    mb = (client.bytes_sent + client.bytes_received) / 1e6
+    cell = {
+        "dim": dim,
+        "keys_per_request": keys_per_req,
+        "requests": n_req,
+        "pull_keys_per_s": round(pulled_keys / pull_wall),
+        "push_keys_per_s": round(pushed_keys / push_wall),
+        "pull": _percentiles(pull_lat),
+        "push": _percentiles(push_lat),
+        "wire_mb_total": round(mb, 2),
+        "wire_mb_per_s": round(mb / (pull_wall + push_wall), 1),
+    }
+    client.close()
+    svc.close()
+    return cell
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="PS_THROUGHPUT.json")
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--vocab", type=int, default=1 << 20)
+    args = ap.parse_args(argv)
+
+    cells = []
+    for dim in (9, 33):
+        for kpr in (1024, 16384):
+            cell = bench_cell(dim, kpr, args.requests, args.vocab, seed=dim)
+            print(json.dumps(cell))
+            cells.append(cell)
+
+    art = {
+        "tool": "tools.ps_throughput",
+        "transport": "tcp localhost, varint keys + fp16 rows",
+        "store": "slot-contiguous AsyncParamServer (adagrad)",
+        "cells": cells,
+    }
+    with open(args.out, "w") as f:
+        json.dump(art, f, indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
