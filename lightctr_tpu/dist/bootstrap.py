@@ -133,6 +133,14 @@ class HeartbeatMonitor:
                 self._events.append(("recover", worker))
         self._dispatch()
 
+    def forget(self, worker: str) -> None:
+        """Clean departure (the reference's FIN shutdown handshake,
+        master.h:146-190): stop tracking the worker so its silence after a
+        deliberate exit is not declared a death."""
+        with self._lock:
+            self._last.pop(worker, None)
+            self._dead.discard(worker)
+
     def check(self) -> Dict[str, str]:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
         now = self._clock()

@@ -30,6 +30,7 @@ numerics the reference's workers train with.
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
@@ -45,6 +46,9 @@ MSG_PUSH = 2
 MSG_PRELOAD = 3
 MSG_SNAPSHOT = 4
 MSG_CLOSE = 5
+MSG_BEAT = 6
+MSG_STATS = 7
+MSG_FAREWELL = 8
 
 # One garbage length prefix must not make the server buffer gigabytes before
 # any validation: cap frames well above any real payload (2^20 keys at
@@ -96,8 +100,20 @@ class ParamServerService:
     per connection — the reference PS is likewise a concurrent server, its
     per-key consistency guarded by the store's lock."""
 
-    def __init__(self, ps: AsyncParamServer, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        ps: AsyncParamServer,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        monitor=None,
+    ):
+        """``monitor``: optional HeartbeatMonitor; when given, MSG_BEAT
+        frames drive it (workers heartbeat over their PS connection, the
+        reference's heartbeats likewise ride the network — master.h:202)
+        and its death/recovery events should be wired to ``ps`` routing by
+        the caller (``wire_heartbeat``)."""
         self.ps = ps
+        self.monitor = monitor
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._peers = []  # [(thread, conn)] of live connections
@@ -168,6 +184,23 @@ class ParamServerService:
                         body = (wire.pack_keys(keys)
                                 + rows.astype(np.float32).tobytes())
                         conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                    elif msg_type == MSG_BEAT:
+                        wid = int(wire.unpack_varint(payload, 1)[0])
+                        if self.monitor is not None:
+                            self.monitor.beat(str(wid))
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                    elif msg_type == MSG_STATS:
+                        body = json.dumps(self.ps.stats()).encode()
+                        conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                    elif msg_type == MSG_FAREWELL:
+                        # clean departure (FIN, master.h:146-190): stop
+                        # liveness tracking so deliberate exits are not
+                        # declared deaths, and clear any unroute flag
+                        wid = int(wire.unpack_varint(payload, 1)[0])
+                        if self.monitor is not None:
+                            self.monitor.forget(str(wid))
+                        self.ps.readmit_worker(wid)
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_CLOSE:
                         return
                     else:
@@ -327,6 +360,22 @@ class PSClient:
     def snapshot(self) -> Dict[int, np.ndarray]:
         keys, rows = self.snapshot_arrays()
         return {int(k): rows[i] for i, k in enumerate(keys)}
+
+    def beat(self, worker_id: int) -> None:
+        """Heartbeat over the PS connection (master.h:202 topology: liveness
+        rides the same network as parameters)."""
+        self._rpc(MSG_BEAT, wire.pack_varint(np.array([worker_id], np.int64)))
+
+    def stats(self) -> Dict:
+        """Server-side counter snapshot (withheld/dropped/rejected, unrouted
+        set, epoch ledger) — the artifact-facing admin op."""
+        return json.loads(self._rpc(MSG_STATS, b"").decode())
+
+    def farewell(self, worker_id: int) -> None:
+        """Clean departure: deregister from liveness tracking (FIN)."""
+        self._rpc(
+            MSG_FAREWELL, wire.pack_varint(np.array([worker_id], np.int64))
+        )
 
     def close(self) -> None:
         try:

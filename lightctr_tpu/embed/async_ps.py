@@ -411,6 +411,21 @@ class AsyncParamServer:
                 k: self._W[slot].copy() for k, slot in self._slot.items()
             }
 
+    def stats(self) -> Dict:
+        """Counter snapshot for admin/monitoring surfaces (one authoritative
+        implementation; the network PS serves this over MSG_STATS)."""
+        with self._lock:
+            return {
+                "withheld_pulls": self.withheld_pulls,
+                "dropped_pushes": self.dropped_pushes,
+                "rejected_pulls": self.rejected_pulls,
+                "rejected_pushes": self.rejected_pushes,
+                "unrouted": sorted(self._unrouted),
+                "last_epoch_version": self.last_epoch_version,
+                "staleness": self.staleness,
+                "n_keys": self._n,
+            }
+
     def snapshot_arrays(self):
         """Vectorized snapshot -> (sorted int64 keys, [n, dim] rows)."""
         with self._lock:

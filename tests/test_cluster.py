@@ -1,0 +1,96 @@
+"""Composed cluster topology: PS process + streaming workers + heartbeat
+kill/readmit in one launch (the reference's master + PS + worker deployment,
+build.sh:24-26 / master.h:146-262), miniature form of
+tools/cluster_convergence."""
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, wire_heartbeat
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+
+def test_beat_over_the_wire_drives_monitor_and_routing():
+    """MSG_BEAT frames feed the heartbeat monitor: silence unroutes the
+    worker, a returning beat readmits it (master.h:202-262 over sockets)."""
+    import time
+
+    ps = AsyncParamServer(dim=2, n_workers=2)
+    clock = [0.0]
+    monitor = HeartbeatMonitor(
+        stale_after_s=1.0, dead_after_s=2.0, period_s=10.0,
+        clock=lambda: clock[0],
+    )
+    wire_heartbeat(monitor, ps)
+    svc = ParamServerService(ps, monitor=monitor)
+    try:
+        client = PSClient(svc.address, 2)
+        client.beat(0)
+        client.beat(1)
+        assert monitor.check() == {"0": "alive", "1": "alive"}
+
+        clock[0] = 3.0
+        client.beat(0)  # worker 0 keeps beating; worker 1 goes silent
+        monitor.check()
+        time.sleep(0.05)  # server thread applies the beat before asserting
+        assert client.stats()["unrouted"] == [1]
+        assert client.pull([5], worker_epoch=0, worker_id=1) is None
+
+        client.beat(1)  # returning node re-registers (master.h:80-82)
+        time.sleep(0.05)
+        assert client.stats()["unrouted"] == []
+        assert client.pull([5], worker_epoch=0, worker_id=1) is not None
+
+        # clean departure (FIN): worker 0 leaves deliberately; its silence
+        # afterwards is NOT a death and it never lands in unrouted
+        client.farewell(0)
+        clock[0] = 10.0
+        client.beat(1)
+        assert monitor.check() == {"1": "alive"}
+        time.sleep(0.05)
+        assert client.stats()["unrouted"] == []
+        client.close()
+    finally:
+        svc.close()
+
+
+def test_stats_reports_server_side_counters():
+    ps = AsyncParamServer(dim=2, n_workers=1, staleness_threshold=2)
+    svc = ParamServerService(ps)
+    try:
+        client = PSClient(svc.address, 2)
+        client.pull([1, 2, 3], worker_epoch=0, worker_id=0)
+        s = client.stats()
+        assert s["n_keys"] == 3
+        assert s["withheld_pulls"] == 0
+        assert "last_epoch_version" in s and "staleness" in s
+        client.close()
+    finally:
+        svc.close()
+
+
+def test_cluster_kill_readmit_converges(tmp_path):
+    """2-worker miniature of the full-cluster artifact: PS service process,
+    workers streaming per-process disk shards, SIGKILL one mid-run,
+    heartbeat unroutes it, relaunch readmits it, and the PS-trained model
+    still reaches parity-grade AUC."""
+    from tools.cluster_convergence import run
+
+    report = run(
+        data_path=None, n_workers=2, epochs=8, batch_size=50, factor_dim=4,
+        workdir=str(tmp_path), kill_worker=1, out=None,
+    )
+    kinds = [e["event"] for e in report["timeline"]]
+    # the choreography actually happened, in order
+    for ev in ("ps_up", "workers_up", "worker_killed", "unrouted_observed",
+               "worker_relaunched", "readmitted_observed", "workers_done"):
+        assert ev in kinds, (ev, kinds)
+    assert kinds.index("worker_killed") < kinds.index("unrouted_observed")
+    assert (kinds.index("unrouted_observed")
+            < kinds.index("readmitted_observed"))
+    # the cluster still converged to parity with the single-process run
+    assert report["final_ps"]["auc"] > 0.95
+    assert report["parity"]["auc"] < 0.05
+    # the restarted incarnation reported in
+    assert any(w.get("start_epoch", 0) > 0 for w in report["workers"])

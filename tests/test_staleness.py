@@ -1,0 +1,64 @@
+"""Staleness arising organically (VERDICT r3 missing #3): skewed workers
+trip the SSP gates on their own, convergence holds, and delayed
+compensation (DCASGD/DCASGDA, paramserver.h:252-300) measurably recovers
+what plain async loses under exact gradient delay."""
+
+import numpy as np
+
+
+def test_organic_skew_trips_ssp_counters_and_converges(tmp_path):
+    """A throttled worker in the composed cluster makes withheld_pulls and
+    dropped_pushes non-zero with NO hand-set epochs — and the run still
+    reaches parity-grade AUC."""
+    from tools.cluster_convergence import run
+
+    report = run(
+        data_path=None, n_workers=2, epochs=10, batch_size=50, factor_dim=4,
+        staleness=2, updater="adagrad", lr=0.1, seed=0,
+        workdir=str(tmp_path), kill_worker=None, out=None,
+        throttle={0: 0.04},
+    )
+    stats = report["ps_stats"]
+    assert stats["withheld_pulls"] > 0, stats
+    assert stats["dropped_pushes"] > 0, stats
+    assert report["final_ps"]["auc"] > 0.95
+    assert report["parity"]["auc"] < 0.05
+
+
+def test_delayed_compensation_recovers_staleness_loss():
+    """Under a 64-step exact gradient delay, DCASGDA's compensated pushes
+    land a better model than uncompensated async SGD; the delay itself
+    visibly hurts vs fresh gradients (so there is something to recover)."""
+    from tools.staleness_convergence import _delayed_study
+
+    fresh = _delayed_study("sgd", 0, seed=1, epochs=15)
+    stale = _delayed_study("sgd", 64, seed=1, epochs=15)
+    comp = _delayed_study("dcasgda", 64, seed=1, epochs=15, lam=1.0)
+
+    assert stale["logloss"] > fresh["logloss"] + 0.05, (stale, fresh)
+    assert comp["logloss"] < stale["logloss"] - 0.01, (comp, stale)
+
+
+def test_dcasgd_shadow_isolation_under_interleaving():
+    """Two workers interleaving pushes keep per-worker shadows: worker 1's
+    compensation reacts to worker 0's intervening updates, not its own."""
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=1, updater="dcasgd", learning_rate=0.1,
+                          n_workers=2, staleness_threshold=10**6,
+                          dcasgd_lambda=1.0, seed=0)
+    k = np.array([7], np.int64)
+    ps.preload({7: np.zeros(1, np.float32)})
+    g = np.ones((1, 1), np.float32)
+
+    # worker 0 pushes twice; w moves while worker 1's shadow stays at 0
+    ps.push_batch(0, k, g, worker_epoch=0)
+    ps.push_batch(0, k, g, worker_epoch=0)
+    w_before = ps.pull_batch(k, worker_epoch=0)[0, 0]
+    # worker 1's push now carries a non-zero (w - shadow_1) compensation
+    ps.push_batch(1, k, g, worker_epoch=0)
+    w_after = ps.pull_batch(k, worker_epoch=0)[0, 0]
+    plain_step = -0.1 * 1.0
+    comp_step = -0.1 * (1.0 + 1.0 * 1.0 * (w_before - 0.0))
+    np.testing.assert_allclose(w_after - w_before, comp_step, rtol=1e-5)
+    assert abs(w_after - w_before - plain_step) > 1e-3
