@@ -29,8 +29,33 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-REF_SPARSE = "/root/reference/data/train_sparse.csv"
-REF_DENSE = "/root/reference/data/train_dense.csv"
+from lightctr_tpu.data.synth import (  # noqa: E402
+    REFERENCE_DENSE,
+    REFERENCE_SPARSE,
+    resolve_dense_csv,
+    resolve_libffm,
+)
+
+# vs_baseline compares against BASELINE.md timings measured on the
+# reference datasets; on substitute (synthetic) data the ratio is not
+# comparable and is reported as null.  Resolution is lazy (inside each
+# bench) and per-dataset: a partially-mounted reference still yields real
+# vs_baseline numbers for the cells that ran on reference data.
+_RESOLVED = {}
+
+
+def _sparse_data():
+    if "sparse" not in _RESOLVED:
+        path = resolve_libffm()
+        _RESOLVED["sparse"] = (path, path == REFERENCE_SPARSE)
+    return _RESOLVED["sparse"]
+
+
+def _dense_data():
+    if "dense" not in _RESOLVED:
+        path = resolve_dense_csv()
+        _RESOLVED["dense"] = (path, path == REFERENCE_DENSE)
+    return _RESOLVED["dense"]
 
 # reference seconds per full workload (BASELINE.md)
 FM_BASE_S = {8: 9.32, 16: 12.35, 32: 18.14, 64: 29.94}       # 1000 epochs
@@ -66,7 +91,8 @@ def bench_fm(epochs):
     from lightctr_tpu.models import fm
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
 
-    ds, _ = load_libffm(REF_SPARSE).compact()
+    sparse_path, comparable = _sparse_data()
+    ds, _ = load_libffm(sparse_path).compact()
     arrays = ds.batch_dict()
     n_rows = len(arrays["labels"])
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
@@ -122,7 +148,7 @@ def bench_fm(epochs):
             "metric": f"fm_k{k}_train_examples_per_sec",
             "value": round(ex_s, 1),
             "unit": "examples/s",
-            "vs_baseline": round(ex_s / base_ex_s, 3),
+            "vs_baseline": round(ex_s / base_ex_s, 3) if comparable else None,
         })
         print(json.dumps(out[-1]), flush=True)
     return out
@@ -134,7 +160,8 @@ def bench_ffm(epochs):
     from lightctr_tpu.models import ffm
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
 
-    ds, _ = load_libffm(REF_SPARSE).compact()
+    sparse_path, comparable = _sparse_data()
+    ds, _ = load_libffm(sparse_path).compact()
     arrays = ds.batch_dict()
     n_rows = len(arrays["labels"])
     cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
@@ -189,7 +216,7 @@ def bench_ffm(epochs):
             "metric": f"ffm_k{k}_train_examples_per_sec",
             "value": round(ex_s, 1),
             "unit": "examples/s",
-            "vs_baseline": round(ex_s / base_ex_s, 3),
+            "vs_baseline": round(ex_s / base_ex_s, 3) if comparable else None,
         })
         print(json.dumps(out[-1]), flush=True)
     return out
@@ -201,7 +228,8 @@ def bench_nn(steps):
     from lightctr_tpu.models import cnn
     from lightctr_tpu.models.dl_trainer import ClassifierTrainer
 
-    ds = load_dense_csv(REF_DENSE)
+    dense_path, comparable = _dense_data()
+    ds = load_dense_csv(dense_path)
     # pre-transfer data + minibatch schedules once, outside the timed region
     # (same methodology as the FM/FFM cells)
     feats = jax.device_put(jnp.asarray(ds.features))
@@ -248,7 +276,7 @@ def bench_nn(steps):
             "metric": f"nn_batch{batch}_train_examples_per_sec",
             "value": round(ex_s, 1),
             "unit": "examples/s",
-            "vs_baseline": round(ex_s / base_ex_s, 3),
+            "vs_baseline": round(ex_s / base_ex_s, 3) if comparable else None,
         })
         print(json.dumps(out[-1]), flush=True)
     return out
@@ -258,7 +286,17 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true", help="1/10th workload")
     ap.add_argument("--only", choices=["fm", "ffm", "nn"])
+    ap.add_argument(
+        "--out", default=None,
+        help="output JSON (default BENCH_MATRIX.json for full runs; partial "
+             "or --quick runs default to BENCH_MATRIX_partial.json so they "
+             "never clobber the full-matrix artifact)",
+    )
     args = ap.parse_args()
+    out_path = args.out or (
+        "BENCH_MATRIX.json" if not args.quick and args.only is None
+        else "BENCH_MATRIX_partial.json"
+    )
     scale = 10 if args.quick else 1
 
     results = []
@@ -281,9 +319,9 @@ def main():
             "loop state, ~3x the dispatched step cost). All cells one host "
             "core."
         )
-    with open("BENCH_MATRIX.json", "w") as f:
+    with open(out_path, "w") as f:
         json.dump(payload, f, indent=2)
-    print(f"wrote BENCH_MATRIX.json ({len(results)} cells)", file=sys.stderr)
+    print(f"wrote {out_path} ({len(results)} cells)", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -10,7 +10,74 @@ sparse logistic model, so trainers can demonstrably converge on it
 
 from __future__ import annotations
 
+import os
+import tempfile
+
 import numpy as np
+
+REFERENCE_SPARSE = "/root/reference/data/train_sparse.csv"
+REFERENCE_DENSE = "/root/reference/data/train_dense.csv"
+
+
+def resolve_libffm(path: str | None = None, workdir: str | None = None) -> str:
+    """Pick the libffm input for a tool/bench: explicit ``path`` >
+    ``$LIGHTCTR_DATA`` > the reference dataset when mounted > a synthetic
+    learnable file written to ``workdir`` (or a tempdir)."""
+    if path:
+        return path
+    env = os.environ.get("LIGHTCTR_DATA")
+    if env:
+        return env
+    if os.path.exists(REFERENCE_SPARSE):
+        return REFERENCE_SPARSE
+    workdir = workdir or tempfile.mkdtemp(prefix="lightctr_synth_")
+    return write_synthetic_libffm(
+        os.path.join(workdir, "synthetic_train.libffm")
+    )
+
+
+def resolve_dense_csv(path: str | None = None,
+                      workdir: str | None = None) -> str:
+    """Dense (MNIST-style) counterpart of :func:`resolve_libffm`:
+    explicit > ``$LIGHTCTR_DENSE_DATA`` > reference > synthetic."""
+    if path:
+        return path
+    env = os.environ.get("LIGHTCTR_DENSE_DATA")
+    if env:
+        return env
+    if os.path.exists(REFERENCE_DENSE):
+        return REFERENCE_DENSE
+    workdir = workdir or tempfile.mkdtemp(prefix="lightctr_synth_")
+    return write_synthetic_dense_csv(
+        os.path.join(workdir, "synthetic_train_dense.csv")
+    )
+
+
+def write_synthetic_dense_csv(
+    path: str,
+    n_rows: int = 500,
+    n_features: int = 784,
+    n_classes: int = 10,
+    seed: int = 0,
+    noise: float = 0.15,
+) -> str:
+    """Write a learnable ``label,pix,...`` CSV (the reference's image
+    format, dl_algo_abst.h:179-228): each class is a fixed random template
+    in [0, 1] plus noise, so classifiers separate them quickly."""
+    rng = np.random.default_rng(seed)
+    templates = rng.random((n_classes, n_features)).astype(np.float32)
+    labels = rng.integers(0, n_classes, n_rows)
+    feats = np.clip(
+        templates[labels] + noise * rng.standard_normal(
+            (n_rows, n_features)
+        ).astype(np.float32),
+        0.0, 1.0,
+    )
+    with open(path, "w") as f:
+        for i in range(n_rows):
+            f.write(str(int(labels[i])) + ","
+                    + ",".join(f"{x:.4f}" for x in feats[i]) + "\n")
+    return path
 
 
 def write_synthetic_libffm(

@@ -33,9 +33,7 @@ namespace {
 
 // 64-byte-aligned scratch: numpy hands us arbitrarily-offset tables, so
 // [K] rows can straddle cache lines; the hot arrays are copied into
-// aligned storage for the duration of a call.  (Note: this did NOT explain
-// the k=16 oddity — k=16 epochs still run slower than k=32, a codegen
-// quirk left as a known curiosity; k=16 wins its cell regardless.)
+// aligned storage for the duration of a call.
 struct AlignedBuf {
     float* p;
     explicit AlignedBuf(size_t n)
@@ -60,7 +58,17 @@ struct ScopedFtz {
 #endif
 };
 
-// K as a compile-time constant so the j-loops fully unroll and vectorize.
+// K as a compile-time constant so the j-loops vectorize at full width.
+//
+// The j-loops carry `#pragma GCC unroll 1`: without it, gcc completely
+// peels any loop of <= 16 iterations (max-completely-peel-times) BEFORE
+// the loop vectorizer runs, and SLP fails to re-roll the peeled
+// read-modify-write sequences — K<=16 came out as 16 scalar vfmadd213ss
+// per row while K=32 got single-ZMM vmovups/vfmadd132ps.  That inversion
+// was the round-3 "k=16 anomaly" (k=16 absolutely slower than k=32);
+// keeping the loops rolled hands them to the vectorizer and k=16 runs
+// 2.3x faster (6.3 -> 2.7 ms/epoch on the bench shape, phases 1 and 3
+// both vectorized).
 //
 // FID-MAJOR schedule: the batch is constant across a full-batch run, so the
 // slots are re-bucketed BY FEATURE once (counting sort) and each epoch
@@ -104,6 +112,8 @@ int train_k(
     std::vector<float> linear(B), selfsq(B), dz(B);
     // aligned working copies of the row-strided hot arrays (see AlignedBuf)
     AlignedBuf va((size_t)F * K), av((size_t)F * K), s((size_t)B * K);
+    if (!va.p || !av.p || !s.p) return -3;  // alloc failure: clean rc, not
+                                            // a segfault in memcpy below
     std::memcpy(va.p, v, sizeof(float) * (size_t)F * K);
     std::memset(av.p, 0, sizeof(float) * (size_t)F * K);
     const float invB = 1.0f / (float)B;
@@ -122,11 +132,13 @@ int train_k(
             const float* __restrict__ vf = va.p + (size_t)f * K;
             const float wf = w[f];
             float norm2 = 0.0f;
+            #pragma GCC unroll 1
             for (int j = 0; j < K; ++j) norm2 += vf[j] * vf[j];
             l2_total += (double)(hi - lo) * 0.5 * (wf * wf + norm2);
             for (int64_t t = lo; t < hi; ++t) {
                 const float x = slot_x[t];
                 float* __restrict__ sr = s.p + (size_t)slot_row[t] * K;
+                #pragma GCC unroll 1
                 for (int j = 0; j < K; ++j) sr[j] += x * vf[j];
                 linear[slot_row[t]] += wf * x;
                 selfsq[slot_row[t]] += x * x * norm2;
@@ -138,6 +150,7 @@ int train_k(
         for (int64_t i = 0; i < B; ++i) {
             const float* __restrict__ sr = s.p + (size_t)i * K;
             float inter = 0.0f;
+            #pragma GCC unroll 1
             for (int j = 0; j < K; ++j) inter += sr[j] * sr[j];
             const float z = linear[i] + 0.5f * (inter - selfsq[i]);
             const float y = labels[i];
@@ -157,6 +170,7 @@ int train_k(
             float* __restrict__ vf = va.p + (size_t)f * K;
             float* __restrict__ avf = av.p + (size_t)f * K;
             float a[K];
+            #pragma GCC unroll 1
             for (int j = 0; j < K; ++j) a[j] = 0.0f;
             float gw = 0.0f, bsum = 0.0f;
             for (int64_t t = lo; t < hi; ++t) {
@@ -165,6 +179,7 @@ int train_k(
                 const float dzx = dzr * x;
                 const float* __restrict__ sr =
                     s.p + (size_t)slot_row[t] * K;
+                #pragma GCC unroll 1
                 for (int j = 0; j < K; ++j) a[j] += dzx * sr[j];
                 gw += dzx;
                 bsum += dzr * x * x;
@@ -176,12 +191,14 @@ int train_k(
                 w[f] -= lr * gw / std::sqrt(aw[f] + eps);
             }
             const float vscale = occ_reg - bsum;
+            // branchless on purpose: gj == 0 makes both updates exact
+            // no-ops anyway (avf += 0, step = lr*0/sqrt(avf+eps) = 0), and
+            // a branch in the j-loop would block vectorization
+            #pragma GCC unroll 1
             for (int j = 0; j < K; ++j) {
                 const float gj = a[j] + vscale * vf[j];
-                if (gj != 0.0f) {
-                    avf[j] += gj * gj;
-                    vf[j] -= lr * gj / std::sqrt(avf[j] + eps);
-                }
+                avf[j] += gj * gj;
+                vf[j] -= lr * gj / std::sqrt(avf[j] + eps);
             }
         }
     }

@@ -1,0 +1,15 @@
+"""tests_tpu runs against the REAL chip — no platform pinning here.
+
+Exception: ``LIGHTCTR_TPU_TESTS_ON_CPU=1`` is the validation mode (keep the
+gate code green while no chip answers).  The pin must happen before any jax
+import: the axon site hook initializes the backend at interpreter startup,
+and a wedged relay hangs even env-var-pinned runs (see
+utils/devicecheck.pin_cpu_platform).
+"""
+
+import os
+
+if os.environ.get("LIGHTCTR_TPU_TESTS_ON_CPU"):
+    from lightctr_tpu.utils.devicecheck import pin_cpu_platform
+
+    pin_cpu_platform(int(os.environ.get("LIGHTCTR_TPU_TESTS_DEVICES", "1")))

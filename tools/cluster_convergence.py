@@ -59,19 +59,22 @@ DEAD_AFTER_S = 2.0
 
 
 def resolve_data(data_arg, workdir):
-    """--data > $LIGHTCTR_DATA > reference file if mounted > synthetic."""
+    """--data > $LIGHTCTR_DATA > reference file if mounted > synthetic.
+    The synthetic fallback pins the demo's original shape (2000 rows x 10
+    fields over a 4096 vocab) so artifacts stay comparable across rounds."""
+    from lightctr_tpu.data import synth
+
     if data_arg:
         return data_arg
     env = os.environ.get("LIGHTCTR_DATA")
     if env:
         return env
-    ref = "/root/reference/data/train_sparse.csv"
-    if os.path.exists(ref):
-        return ref
-    from lightctr_tpu.data.synth import write_synthetic_libffm
-
-    path = os.path.join(workdir, "synthetic_train.libffm")
-    return write_synthetic_libffm(path, n_rows=2000, n_fields=10, vocab=4096)
+    if os.path.exists(synth.REFERENCE_SPARSE):
+        return synth.REFERENCE_SPARSE
+    return synth.write_synthetic_libffm(
+        os.path.join(workdir, "synthetic_train.libffm"),
+        n_rows=2000, n_fields=10, vocab=4096,
+    )
 
 
 # ---------------------------------------------------------------------------

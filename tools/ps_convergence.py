@@ -45,7 +45,6 @@ from typing import Dict
 import numpy as np
 
 DENSE_BASE = 1 << 30
-REF_SPARSE = "/root/reference/data/train_sparse.csv"
 
 
 # ---------------------------------------------------------------------------
@@ -283,7 +282,7 @@ def _worker(base, worker_id, n_workers, payload, out_dir, cfg):
 
 
 def run(
-    data_path: str = REF_SPARSE,
+    data_path: str = None,
     n_workers: int = 4,
     epochs: int = 30,
     batch_size: int = 50,
@@ -315,8 +314,9 @@ def run(
 
     if arrays is None:
         from lightctr_tpu.data import load_libffm
+        from lightctr_tpu.data.synth import resolve_libffm
 
-        ds, _ = load_libffm(data_path).compact()
+        ds, _ = load_libffm(resolve_libffm(data_path, workdir)).compact()
         feature_cnt, field_cnt = ds.feature_cnt, ds.field_cnt
         rep, rep_mask = widedeep.field_representatives(
             ds.fids, ds.fields, ds.mask, field_cnt
@@ -500,7 +500,11 @@ def main():
     pin_cpu_platform(1)
 
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--data", default=REF_SPARSE)
+    ap.add_argument(
+        "--data", default=None,
+        help="libffm file (default: $LIGHTCTR_DATA, the reference dataset "
+             "when mounted, else synthetic)",
+    )
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--batch-size", type=int, default=50)

@@ -46,7 +46,9 @@ def scan_time(body, carry, label, length):
 
 def main():
     length = int(sys.argv[1]) if len(sys.argv) > 1 else 50
-    ds, _ = load_libffm("/root/reference/data/train_sparse.csv").compact()
+    from lightctr_tpu.data.synth import resolve_libffm
+
+    ds, _ = load_libffm(resolve_libffm()).compact()
     b = {k: jnp.asarray(v) for k, v in ds.batch_dict().items()}
     params = fm.init(jax.random.PRNGKey(0), ds.feature_cnt, 8)
     tx = optim.adagrad(0.1)
