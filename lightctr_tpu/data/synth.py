@@ -53,6 +53,56 @@ def resolve_dense_csv(path: str | None = None,
     )
 
 
+def write_criteo_proxy(
+    path: str,
+    rows: int,
+    seed: int = 0,
+    n_fields: int = 39,
+    n_cat: int = 26,
+    vocab: int = 1 << 20,
+) -> str:
+    """Write a Criteo-shaped libFFM file: 39 one-feature-per-field slots
+    (26 categorical + 13 numeric — the Criteo-Kaggle layout).  Categorical
+    fields draw skewed ids (popularity ~ u^4 — a frequent head, a huge
+    tail, like real Criteo); numeric fields use one fixed id per field with
+    the measurement as the value (the bucketless form).  Labels follow a
+    logistic in two numeric fields plus a head-id effect, so one training
+    pass can provably recover signal through both the wide and the
+    embedding path.  Shared by tools/criteo_scale and tools/criteo_ps_soak."""
+    rng = np.random.default_rng(seed)
+    chunk = 20_000
+    numeric_ids = np.arange(n_cat, n_fields, dtype=np.int64)
+    with open(path, "w") as f:
+        done = 0
+        while done < rows:
+            n = min(chunk, rows - done)
+            u = rng.random(size=(n, n_fields))
+            fids = (u ** 4 * vocab).astype(np.int64)
+            fids[:, n_cat:] = numeric_ids[None, :]
+            vals = np.ones((n, n_fields), np.float32)
+            vals[:, n_cat:] = rng.exponential(
+                1.0, size=(n, n_fields - n_cat)
+            ).astype(np.float32).round(3)
+            z = (
+                (vals[:, n_cat] - 1.0)
+                + (vals[:, n_cat + 1] - 1.0)
+                + (fids[:, 0] % 2).astype(np.float32)
+                - 0.5
+            )
+            p = 1.0 / (1.0 + np.exp(-2.0 * z))
+            labels = (rng.random(n) < p).astype(np.int32)
+            lines = []
+            for i in range(n):
+                feats = " ".join(
+                    f"{j}:{fids[i, j]}:{vals[i, j]:g}"
+                    for j in range(n_fields)
+                )
+                lines.append(f"{labels[i]} {feats}\n")
+            f.writelines(lines)
+            done += n
+    return path
+
+
 def write_synthetic_dense_csv(
     path: str,
     n_rows: int = 500,

@@ -58,6 +58,7 @@ class _RowView:
     def __getitem__(self, key: int) -> np.ndarray:
         slot = self._store._slot[int(key)]
         if self._attr == "_shw":
+            self._store._ensure_shadow()
             return self._arr()[:, slot]
         return self._arr()[slot]
 
@@ -66,6 +67,7 @@ class _RowView:
         # would likewise not consume randomness on assignment)
         slot = self._store._slot_for_set(int(key))
         if self._attr == "_shw":
+            self._store._ensure_shadow()
             self._arr()[:, slot] = np.asarray(value, np.float32)
         else:
             self._arr()[slot] = np.asarray(value, np.float32).reshape(
@@ -82,6 +84,8 @@ class _RowView:
         return self._store._slot.keys()
 
     def items(self):
+        if self._attr == "_shw":
+            self._store._ensure_shadow()
         for k, slot in self._store._slot.items():
             if self._attr == "_shw":
                 yield k, self._arr()[:, slot]
@@ -122,6 +126,11 @@ class AsyncParamServer:
         self._cap = 0
         self._W = np.zeros((0, dim), np.float32)
         self._acc = np.zeros((0, dim), np.float32)
+        # per-worker shadow copies exist for the delayed-compensation
+        # updaters only (paramserver.h:252-300); sgd/adagrad never read
+        # them, and at Criteo vocab an [n_workers, 2^20, dim] block would
+        # dwarf the store itself — allocate lazily on first need
+        self._needs_shadow = updater in ("dcasgd", "dcasgda")
         self._shw = np.zeros((n_workers, 0, dim), np.float32)
         # dict-like parity views (same names the dict-backed store exposed)
         self._data = _RowView(self, "_W")
@@ -152,11 +161,23 @@ class AsyncParamServer:
             new = np.zeros((cap, self.dim), np.float32)
             new[: self._n] = old[: self._n]
             setattr(self, name, new)
-        old = self._shw
-        new = np.zeros((self.n_workers, cap, self.dim), np.float32)
-        new[:, : self._n] = old[:, : self._n]
-        self._shw = new
+        if self._needs_shadow:
+            old = self._shw
+            new = np.zeros((self.n_workers, cap, self.dim), np.float32)
+            new[:, : self._n] = old[:, : self._n]
+            self._shw = new
         self._cap = cap
+
+    def _ensure_shadow(self) -> None:
+        """Allocate the shadow block on demand (a test poking ``_shadow``
+        on an sgd/adagrad store, or a future updater switch).  Later-created
+        rows keep shadow == init via _slots_create; rows that existed
+        before this call get shadow == their CURRENT value — for updaters
+        that never read shadows this is unobservable."""
+        if not self._needs_shadow:
+            self._needs_shadow = True
+            self._shw = np.tile(self._W[None, : self._cap], (self.n_workers, 1, 1)) \
+                if self._cap else np.zeros((self.n_workers, 0, self.dim), np.float32)
 
     def _slot_for_set(self, key: int) -> int:
         """Slot for a direct row assignment: allocate zero-filled, no RNG."""
@@ -191,7 +212,8 @@ class AsyncParamServer:
             sl = np.arange(self._n, self._n + m)
             self._W[sl] = rows
             self._acc[sl] = 0.0
-            self._shw[:, sl] = rows  # every worker's shadow starts at init
+            if self._needs_shadow:
+                self._shw[:, sl] = rows  # every worker's shadow = init
             for k, s in zip(new_keys.tolist(), sl.tolist()):
                 self._slot[k] = s
             self._n += m
@@ -403,7 +425,8 @@ class AsyncParamServer:
             )
             self._W[slots] = r
             self._acc[slots] = 0.0
-            self._shw[:, slots] = r
+            if self._needs_shadow:
+                self._shw[:, slots] = r
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:

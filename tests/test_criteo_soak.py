@@ -1,0 +1,17 @@
+"""Criteo-vocabulary composed-stack soak (miniature): streaming shards ->
+network PS -> jitted Wide&Deep workers, 2^20 key space
+(tools/criteo_ps_soak; reference path distributed_algo_abst.h:176-280)."""
+
+
+def test_criteo_soak_composes_at_vocab_scale(tmp_path):
+    from tools.criteo_ps_soak import run
+
+    payload = run(rows=8192, eval_rows=4096, n_workers=2, batch=1024,
+                  out=None, workdir=str(tmp_path))
+    assert payload["shape"]["vocab"] == 1 << 20
+    # signal recovered through the full network-PS path even on the
+    # miniature row count (the 0.82 bar belongs to the full 98k artifact;
+    # run() itself asserts it only when rows are at artifact scale)
+    assert payload["holdout_auc"] > 0.70, payload["holdout_auc"]
+    assert all(w["steps"] > 0 for w in payload["workers"])
+    assert payload["ps_wire_mb_total"] > 1.0

@@ -53,43 +53,12 @@ BATCH = 4096
 
 
 def synthesize(path: str, rows: int, seed: int = 0) -> None:
-    """Write a Criteo-shaped libFFM file: 39 one-feature-per-field slots.
-    Categorical fields draw skewed ids (popularity ~ u^4 — a frequent head,
-    a huge tail, like real Criteo); numeric fields use one fixed id per
-    field with the measurement as the value (the bucketless form).  Labels
-    follow a logistic in two numeric fields plus a head-id effect, so one
-    training pass can provably recover signal through both the wide and the
-    embedding path."""
-    rng = np.random.default_rng(seed)
-    chunk = 20_000
-    numeric_ids = np.arange(N_CAT, N_FIELDS, dtype=np.int64)  # fixed per field
-    with open(path, "w") as f:
-        done = 0
-        while done < rows:
-            n = min(chunk, rows - done)
-            u = rng.random(size=(n, N_FIELDS))
-            fids = (u ** 4 * VOCAB).astype(np.int64)
-            fids[:, N_CAT:] = numeric_ids[None, :]
-            vals = np.ones((n, N_FIELDS), np.float32)
-            vals[:, N_CAT:] = rng.exponential(1.0, size=(n, N_FIELDS - N_CAT)).astype(
-                np.float32
-            ).round(3)
-            z = (
-                (vals[:, N_CAT] - 1.0)
-                + (vals[:, N_CAT + 1] - 1.0)
-                + (fids[:, 0] % 2).astype(np.float32)
-                - 0.5
-            )
-            p = 1.0 / (1.0 + np.exp(-2.0 * z))
-            labels = (rng.random(n) < p).astype(np.int32)
-            lines = []
-            for i in range(n):
-                feats = " ".join(
-                    f"{j}:{fids[i, j]}:{vals[i, j]:g}" for j in range(N_FIELDS)
-                )
-                lines.append(f"{labels[i]} {feats}\n")
-            f.writelines(lines)
-            done += n
+    """Criteo-shaped libFFM proxy — shared implementation in
+    :func:`lightctr_tpu.data.synth.write_criteo_proxy`."""
+    from lightctr_tpu.data.synth import write_criteo_proxy
+
+    write_criteo_proxy(path, rows, seed=seed, n_fields=N_FIELDS,
+                       n_cat=N_CAT, vocab=VOCAB)
 
 
 def main():
