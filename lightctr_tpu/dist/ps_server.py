@@ -254,17 +254,28 @@ class PSClient:
         self.withheld_pulls = 0
         self.dropped_pushes = 0
 
-    def _rpc(self, msg_type: int, payload: bytes) -> bytes:
+    def _send(self, msg_type: int, payload: bytes) -> None:
+        """Fire a request without waiting for the reply (pipelining
+        primitive — the server answers requests on one connection in
+        order, so N sends followed by N receives is safe)."""
         _send_msg(self._sock, msg_type, payload)
         self.bytes_sent += 5 + len(payload)
+        self._inflight_type = msg_type
+
+    def _recv_reply(self) -> bytes:
         reply_type, reply = _recv_msg(self._sock)
         del reply_type  # replies reuse the length framing; type byte unused
         self.bytes_received += 5 + len(reply)
         if reply == b"\xff":
             raise RuntimeError(
-                f"PS server rejected message type {msg_type} (protocol skew)"
+                f"PS server rejected message type "
+                f"{getattr(self, '_inflight_type', '?')} (protocol skew)"
             )
         return reply
+
+    def _rpc(self, msg_type: int, payload: bytes) -> bytes:
+        self._send(msg_type, payload)
+        return self._recv_reply()
 
     def pull_arrays(
         self,
@@ -383,3 +394,153 @@ class PSClient:
         except OSError:
             pass
         self._sock.close()
+
+
+class ShardedPSClient:
+    """Key-partitioned client over N PS service shards — the reference's
+    scale-out topology (one worker talks to MANY paramserver processes,
+    keys routed by consistent hash, ``consistent_hash.h`` +
+    ``distributed_algo_abst.h:176-280``).  Routing here is ``key % n_shards``
+    (the loaders already fold ids; modulo spreads Criteo's frequent head
+    uniformly, which is what the reference's virtual-node hashing buys).
+
+    Same array protocol surface as :class:`PSClient`; each call splits the
+    sorted key batch per shard, sends every sub-request before reading any
+    reply (the shards work concurrently), and merges the replies back into
+    request order.  Updater math is per-key independent, so a preloaded
+    sharded deployment whose gates never trip is bit-identical to a single
+    store (tested).  As in the reference's real topology, each shard keeps
+    its OWN staleness ledger: a push may be dropped by one shard and
+    applied by another (the return value is False if ANY shard dropped),
+    and a pull withheld by any shard is retried whole.
+    """
+
+    def __init__(self, addresses, dim: int):
+        if not addresses:
+            raise ValueError("need at least one PS shard address")
+        self.dim = dim
+        self.clients = [PSClient(tuple(a), dim) for a in addresses]
+        self.n_shards = len(self.clients)
+
+    # -- accounting (aggregated over shards) --------------------------------
+
+    @property
+    def bytes_sent(self) -> int:
+        return sum(c.bytes_sent for c in self.clients)
+
+    @property
+    def bytes_received(self) -> int:
+        return sum(c.bytes_received for c in self.clients)
+
+    @property
+    def withheld_pulls(self) -> int:
+        return sum(c.withheld_pulls for c in self.clients)
+
+    @property
+    def dropped_pushes(self) -> int:
+        return sum(c.dropped_pushes for c in self.clients)
+
+    def _split(self, keys: np.ndarray):
+        """shard id per key + the per-shard sorted key arrays (sorted input
+        stays sorted within each shard) + scatter indices to merge replies
+        back into request order."""
+        shard = (keys % self.n_shards).astype(np.int64)
+        order = []
+        parts = []
+        for s in range(self.n_shards):
+            idx = np.flatnonzero(shard == s)
+            order.append(idx)
+            parts.append(keys[idx])
+        return parts, order
+
+    def pull_arrays(self, keys, worker_epoch, worker_id=None):
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        parts, order = self._split(keys_arr)
+        hdr = wire.pack_varint(np.array(
+            [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
+            np.int64,
+        ))
+        live = []
+        for c, part, idx in zip(self.clients, parts, order):
+            if len(part):
+                c._send(MSG_PULL, hdr + wire.pack_keys(part))
+                live.append((c, part, idx))
+        rows = np.empty((len(keys_arr), self.dim), np.float32)
+        withheld = False
+        for c, part, idx in live:
+            reply = c._recv_reply()
+            if reply[:1] == b"\x01":
+                # any shard withholding means the whole pull retries — the
+                # reference worker likewise blocks until every PS replies
+                c.withheld_pulls += 1
+                withheld = True
+                continue  # still drain the remaining replies
+            _, r = _keys_and_rows(reply[1:], self.dim, np.float16)
+            rows[idx] = r
+        if withheld:
+            return None
+        return keys_arr, rows
+
+    def push_arrays(self, worker_id, keys, rows, worker_epoch) -> bool:
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        parts, order = self._split(keys_arr)
+        hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
+        live = []
+        for c, part, idx in zip(self.clients, parts, order):
+            if len(part):
+                c._send(
+                    MSG_PUSH,
+                    hdr + wire.pack_keys(part)
+                    + r[idx].astype(np.float16).tobytes(),
+                )
+                live.append(c)
+        ok = True
+        for c in live:
+            if c._recv_reply() != b"\x00":
+                c.dropped_pushes += 1
+                ok = False  # partial application is possible (per-shard
+                # ledgers — see class docstring); caller semantics match
+                # the reference's lossy async pushes
+        return ok
+
+    def preload_arrays(self, keys, rows) -> None:
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        parts, order = self._split(keys_arr)
+        live = []
+        for c, part, idx in zip(self.clients, parts, order):
+            if len(part):
+                c._send(MSG_PRELOAD,
+                        wire.pack_keys(part) + r[idx].tobytes())
+                live.append(c)
+        for c in live:
+            c._recv_reply()
+
+    def snapshot_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        keys_parts, rows_parts = [], []
+        for c in self.clients:
+            k, r = c.snapshot_arrays()
+            keys_parts.append(k)
+            rows_parts.append(r)
+        keys = np.concatenate(keys_parts)
+        rows = np.concatenate(rows_parts) if len(keys) else \
+            np.zeros((0, self.dim), np.float32)
+        order = np.argsort(keys, kind="stable")
+        return keys[order], rows[order]
+
+    def beat(self, worker_id: int) -> None:
+        for c in self.clients:
+            c.beat(worker_id)
+
+    def stats(self):
+        """Per-shard stats list (shard i = addresses[i])."""
+        return [c.stats() for c in self.clients]
+
+    def farewell(self, worker_id: int) -> None:
+        for c in self.clients:
+            c.farewell(worker_id)
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()

@@ -15,3 +15,14 @@ def test_criteo_soak_composes_at_vocab_scale(tmp_path):
     assert payload["holdout_auc"] > 0.70, payload["holdout_auc"]
     assert all(w["steps"] > 0 for w in payload["workers"])
     assert payload["ps_wire_mb_total"] > 1.0
+
+
+def test_criteo_soak_with_sharded_ps(tmp_path):
+    """Same soak over TWO PS shard processes (key % 2 partition) — the
+    reference's many-paramserver scale-out topology, end to end."""
+    from tools.criteo_ps_soak import run
+
+    payload = run(rows=8192, eval_rows=4096, n_workers=2, batch=1024,
+                  ps_shards=2, out=None, workdir=str(tmp_path))
+    assert "2 network PS shard" in payload["topology"]
+    assert payload["holdout_auc"] > 0.70, payload["holdout_auc"]

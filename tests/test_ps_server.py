@@ -180,3 +180,50 @@ def test_unrouted_worker_is_refused_over_the_wire(service):
     service.ps.readmit_worker(1)
     assert client.pull([5], worker_epoch=0, worker_id=1) is not None
     client.close()
+
+
+def test_sharded_ps_client_routes_and_matches_single_store(rng):
+    """Key-partitioned scale-out (consistent_hash.h role): a 2-shard
+    deployment preloaded identically to one store produces bit-identical
+    trained rows (per-key updater math is shard-independent), and keys
+    land on shard key % n."""
+    from lightctr_tpu.dist.ps_server import ShardedPSClient
+
+    stores = [AsyncParamServer(dim=DIM, updater="adagrad",
+                               learning_rate=0.1, n_workers=1, seed=s)
+              for s in (0, 1)]
+    svcs = [ParamServerService(ps) for ps in stores]
+    single = AsyncParamServer(dim=DIM, updater="adagrad",
+                              learning_rate=0.1, n_workers=1, seed=2)
+    try:
+        client = ShardedPSClient([s.address for s in svcs], DIM)
+        keys = np.unique(rng.integers(0, 1 << 18, size=400))
+        rows = rng.normal(size=(len(keys), DIM)).astype(np.float32)
+        client.preload_arrays(keys, rows)
+        single.preload_batch(keys, rows)
+
+        # routing: every key sits on shard key % 2
+        per_shard = client.stats()
+        assert per_shard[0]["n_keys"] == int((keys % 2 == 0).sum())
+        assert per_shard[1]["n_keys"] == int((keys % 2 == 1).sum())
+
+        for step in range(3):
+            g = rng.normal(size=(len(keys), DIM)).astype(np.float32) * 0.1
+            # fp16 the grads once so both sides apply the SAME wire-rounded
+            # values; then trained rows must agree to fp16 ROW precision
+            g16 = g.astype(np.float16).astype(np.float32)
+            assert client.push_arrays(0, keys, g16, worker_epoch=step)
+            single.push_batch(0, keys, g16, worker_epoch=step)
+
+        skeys, srows = client.snapshot_arrays()
+        np.testing.assert_array_equal(skeys, keys)
+        np.testing.assert_array_equal(srows, single.snapshot_arrays()[1])
+
+        # pull merges shard replies back into request order
+        pkeys, prows = client.pull_arrays(keys, worker_epoch=3)
+        np.testing.assert_array_equal(pkeys, keys)
+        np.testing.assert_allclose(prows, srows, atol=2e-3)
+        client.close()
+    finally:
+        for s in svcs:
+            s.close()

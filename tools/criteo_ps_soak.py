@@ -53,13 +53,13 @@ ROW_DIM = 1 + DIM
 # PS process
 
 
-def _ps_proc(conn, n_workers, lr, stop_evt):
+def _ps_proc(conn, n_workers, lr, stop_evt, seed=0):
     from lightctr_tpu.dist.ps_server import ParamServerService
     from lightctr_tpu.embed.async_ps import AsyncParamServer
 
     ps = AsyncParamServer(
         dim=ROW_DIM, updater="adagrad", learning_rate=lr,
-        n_workers=n_workers, staleness_threshold=50, seed=0,
+        n_workers=n_workers, staleness_threshold=50, seed=seed,
     )
     svc = ParamServerService(ps)
     conn.send(svc.address)
@@ -67,11 +67,21 @@ def _ps_proc(conn, n_workers, lr, stop_evt):
     svc.close()
 
 
+def _make_client(addresses, dim):
+    """One PS shard -> plain PSClient; several -> key-partitioned fan-out
+    (the reference's many-paramserver-processes topology)."""
+    from lightctr_tpu.dist.ps_server import PSClient, ShardedPSClient
+
+    if len(addresses) == 1:
+        return PSClient(tuple(addresses[0]), dim)
+    return ShardedPSClient(addresses, dim)
+
+
 # ---------------------------------------------------------------------------
 # worker process
 
 
-def _worker(worker_id, n_workers, address, train_path, cfg, out_dir):
+def _worker(worker_id, n_workers, addresses, train_path, cfg, out_dir):
     batch_size = cfg["batch"]
     from lightctr_tpu.utils.devicecheck import pin_cpu_platform
 
@@ -81,7 +91,6 @@ def _worker(worker_id, n_workers, address, train_path, cfg, out_dir):
     import jax.numpy as jnp
 
     from lightctr_tpu.data.streaming import iter_libffm_batches
-    from lightctr_tpu.dist.ps_server import PSClient
     from lightctr_tpu.models import widedeep
     from lightctr_tpu.ops import losses as losses_lib
 
@@ -90,7 +99,7 @@ def _worker(worker_id, n_workers, address, train_path, cfg, out_dir):
     n_dense = (dense_len + ROW_DIM - 1) // ROW_DIM
     dense_keys = DENSE_BASE + np.arange(n_dense, dtype=np.int64)
 
-    ps = PSClient(address, ROW_DIM)
+    ps = _make_client(addresses, ROW_DIM)
 
     U_w = batch_size * N_FIELDS
     U_e = batch_size * N_FIELDS
@@ -195,13 +204,12 @@ def _worker(worker_id, n_workers, address, train_path, cfg, out_dir):
 
 
 def run(rows=98304, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
-        out="CRITEO_PS_CPU.json", workdir=None):
+        ps_shards=1, out="CRITEO_PS_CPU.json", workdir=None):
     import tempfile
 
     import jax
 
     from lightctr_tpu.data.synth import write_criteo_proxy as synthesize
-    from lightctr_tpu.dist.ps_server import PSClient
     from lightctr_tpu.models import widedeep
     from lightctr_tpu.ops.metrics import auc_exact
 
@@ -233,17 +241,29 @@ def run(rows=98304, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
 
     ctx = mp.get_context("spawn")
     stop_evt = ctx.Event()
-    parent_conn, child_conn = ctx.Pipe()
-    ps_proc = ctx.Process(target=_ps_proc,
-                          args=(child_conn, n_workers, lr, stop_evt))
-    ps_proc.start()
-    if not parent_conn.poll(60):
-        ps_proc.terminate()
-        raise RuntimeError("PS service failed to start within 60s")
-    address = parent_conn.recv()
+    ps_procs, addresses = [], []
+    try:
+        for s in range(ps_shards):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(target=_ps_proc,
+                            args=(child_conn, n_workers, lr, stop_evt, s))
+            p.start()
+            ps_procs.append(p)
+            if not parent_conn.poll(60):
+                raise RuntimeError("PS shard failed to start within 60s")
+            addresses.append(list(parent_conn.recv()))
+    except Exception:
+        # release ALL already-started shards, not just the failing one —
+        # a shard parked in stop_evt.wait() would block process exit
+        stop_evt.set()
+        for p in ps_procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        raise
 
     try:
-        admin = PSClient(address, ROW_DIM)
+        admin = _make_client(addresses, ROW_DIM)
         # master syncInitializer at vocabulary scale: chunked preload of the
         # full [2^20, 33] table (w col 0 + embed cols 1:) and dense chunks
         w0 = np.asarray(params0["w"], np.float32)
@@ -268,7 +288,7 @@ def run(rows=98304, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
         procs = [
             ctx.Process(
                 target=_worker,
-                args=(w, n_workers, address, train_path, cfg, workdir),
+                args=(w, n_workers, addresses, train_path, cfg, workdir),
             )
             for w in range(n_workers)
         ]
@@ -338,8 +358,9 @@ def run(rows=98304, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
         payload = {
             "shape": {"rows": examples, "fields": N_FIELDS, "vocab": VOCAB,
                       "dim": DIM, "batch": batch},
-            "topology": f"{n_workers} worker processes x 1 network PS "
-                        "(TCP, varint keys + fp16 rows)",
+            "topology": f"{n_workers} worker processes x {ps_shards} "
+                        "network PS shard(s) (TCP, varint keys + fp16 "
+                        "rows; key % n_shards partition)",
             "store": "slot-contiguous AsyncParamServer (adagrad), "
                      f"{VOCAB + n_dense} preloaded rows",
             "preload_s": round(preload_s, 1),
@@ -366,7 +387,8 @@ def run(rows=98304, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
         return payload
     finally:
         stop_evt.set()
-        ps_proc.join(timeout=10)
+        for p in ps_procs:
+            p.join(timeout=10)
 
 
 def main():
@@ -379,10 +401,11 @@ def main():
     ap.add_argument("--eval-rows", type=int, default=20000)
     ap.add_argument("--workers", type=int, default=4)
     ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--ps-shards", type=int, default=1)
     ap.add_argument("--out", default="CRITEO_PS_CPU.json")
     args = ap.parse_args()
     run(rows=args.rows, eval_rows=args.eval_rows, n_workers=args.workers,
-        batch=args.batch, out=args.out)
+        batch=args.batch, ps_shards=args.ps_shards, out=args.out)
 
 
 if __name__ == "__main__":
