@@ -129,3 +129,23 @@ def test_async_ps_lazy_init_deterministic():
     ps1 = embed.AsyncParamServer(dim=4, seed=3)
     ps2 = embed.AsyncParamServer(dim=4, seed=3)
     np.testing.assert_array_equal(ps1.pull([5], 0)[5], ps2.pull([5], 0)[5])
+
+
+def test_preload_duplicate_new_keys_do_not_leak_slots():
+    """A preload batch repeating an unseen key maps it to ONE slot — no
+    phantom rows inflating the store (regression: the bulk allocation path
+    must dedup misses like lazy creation does)."""
+    import numpy as np
+
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=2, updater="adagrad", n_workers=1)
+    keys = np.array([5, 5, 9], np.int64)
+    rows = np.array([[1, 1], [2, 2], [3, 3]], np.float32)
+    ps.preload_batch(keys, rows)
+    assert ps._n == 2
+    assert ps.stats()["n_keys"] == 2
+    # last occurrence wins for a duplicated key (fancy-index store order)
+    np.testing.assert_array_equal(ps.pull_batch(
+        np.array([5, 9], np.int64), worker_epoch=0),
+        np.array([[2, 2], [3, 3]], np.float32))
