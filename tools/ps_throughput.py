@@ -30,6 +30,17 @@ def _percentiles(lat_s):
     }
 
 
+def _warm_keys(vocab: int, keys_per_req: int) -> np.ndarray:
+    return np.arange(0, vocab, max(1, vocab // keys_per_req))[:keys_per_req]
+
+
+def _request_batches(rng, vocab: int, keys_per_req: int, n_req: int):
+    return [
+        np.unique(rng.integers(0, vocab, keys_per_req * 2))[:keys_per_req]
+        for _ in range(n_req)
+    ]
+
+
 def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
     from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
     from lightctr_tpu.embed.async_ps import AsyncParamServer
@@ -42,13 +53,10 @@ def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
 
     # warm the store so pulls hit existing rows (steady-state serving, not
     # lazy-init cost) and warm both code paths once
-    warm = np.arange(0, vocab, max(1, vocab // keys_per_req))[:keys_per_req]
-    client.pull_arrays(warm, worker_epoch=0, worker_id=0)
+    client.pull_arrays(_warm_keys(vocab, keys_per_req), worker_epoch=0,
+                       worker_id=0)
 
-    batches = [
-        np.unique(rng.integers(0, vocab, keys_per_req * 2))[:keys_per_req]
-        for _ in range(n_req)
-    ]
+    batches = _request_batches(rng, vocab, keys_per_req, n_req)
     grads = rng.standard_normal((keys_per_req, dim)).astype(np.float32) * 0.01
 
     t0 = time.perf_counter()
@@ -89,6 +97,63 @@ def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
     return cell
 
 
+def bench_concurrent(dim: int, keys_per_req: int, n_req: int, vocab: int,
+                     n_clients: int, seed: int):
+    """Aggregate pull throughput with N clients hammering one service
+    concurrently (the reference PS serves every worker at once,
+    paramserver.h:138-210).  The store lock serializes the numpy work but
+    socket/codec time overlaps; this measures what actually survives."""
+    import threading
+
+    from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=dim, updater="adagrad", learning_rate=0.05,
+                          n_workers=n_clients, seed=seed)
+    svc = ParamServerService(ps)
+    rng = np.random.default_rng(seed)
+    clients = [PSClient(svc.address, dim) for _ in range(n_clients)]
+    clients[0].pull_arrays(_warm_keys(vocab, keys_per_req), worker_epoch=0)
+
+    batches = [_request_batches(rng, vocab, keys_per_req, n_req)
+               for _ in range(n_clients)]
+    done = [0] * n_clients
+    errors = []
+
+    def hammer(i):
+        try:
+            for keys in batches[i]:
+                out = None
+                while out is None:  # withheld pulls retry like a worker
+                    out = clients[i].pull_arrays(
+                        keys, worker_epoch=0, worker_id=i
+                    )
+                done[i] += len(out[0])
+        except Exception as e:  # surfaced after join — a failed thread
+            errors.append((i, e))  # must fail the benchmark, not shrink it
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errors:
+        raise RuntimeError(f"client threads failed: {errors}")
+    cell = {
+        "dim": dim, "keys_per_request": keys_per_req,
+        "requests_per_client": n_req,
+        "concurrent_clients": n_clients,
+        "aggregate_pull_keys_per_s": round(sum(done) / wall),
+    }
+    for c in clients:
+        c.close()
+    svc.close()
+    return cell
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="PS_THROUGHPUT.json")
@@ -102,12 +167,16 @@ def main(argv=None):
             cell = bench_cell(dim, kpr, args.requests, args.vocab, seed=dim)
             print(json.dumps(cell))
             cells.append(cell)
+    conc = bench_concurrent(33, 4096, args.requests // 2, args.vocab,
+                            n_clients=4, seed=1)
+    print(json.dumps(conc))
 
     art = {
         "tool": "tools.ps_throughput",
         "transport": "tcp localhost, varint keys + fp16 rows",
         "store": "slot-contiguous AsyncParamServer (adagrad)",
         "cells": cells,
+        "concurrent": conc,
     }
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
