@@ -1,0 +1,128 @@
+"""Master control-plane role — the reference's standalone master process.
+
+The reference deploys three distinct roles: master (heartbeat + routing
+decisions, ``master.h:146-262``), N paramserver processes (serve keys,
+obey routing, ``network.h:148-151``), M workers.  The repo's mesh path
+subsumes the master with ``jax.distributed``; THIS module is the
+socket-topology form: a small service that owns the
+:class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` and broadcasts its
+death/recovery decisions to every PS shard over the control-plane ops
+(``MSG_UNROUTE``/``MSG_READMIT``).
+
+Workers heartbeat HERE (``PSClient.beat`` against the master address);
+parameter traffic goes straight to the shards — exactly the reference's
+separation, where liveness and data ride different connections to
+different roles.
+"""
+
+from __future__ import annotations
+
+from lightctr_tpu.dist.bootstrap import (
+    DEAD_AFTER_S,
+    HEARTBEAT_PERIOD_S,
+    STALE_AFTER_S,
+    HeartbeatMonitor,
+)
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+
+class MasterService:
+    """Heartbeat/routing authority over a set of PS shards.
+
+    ``beat``/``farewell`` frames arrive on this service's socket; when the
+    monitor declares a worker dead (or sees it return), the decision is
+    pushed to every shard via admin ops.  The local store is a dim-1 dummy
+    — the master serves no parameters (master.h's master holds no table
+    either)."""
+
+    def __init__(
+        self,
+        shard_addresses,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        stale_after_s: float = STALE_AFTER_S,
+        dead_after_s: float = DEAD_AFTER_S,
+        period_s: float = HEARTBEAT_PERIOD_S,
+        shard_rpc_timeout_s: float = 5.0,
+    ):
+        # per-op socket timeout: a wedged shard must raise (and be
+        # retried), not stall heartbeat processing under the dispatch lock
+        self._shard_addresses = [tuple(a) for a in shard_addresses]
+        self._timeout = shard_rpc_timeout_s
+        self._shards = [PSClient(a, 1, timeout=shard_rpc_timeout_s)
+                        for a in self._shard_addresses]
+        self.monitor = HeartbeatMonitor(
+            stale_after_s=stale_after_s,
+            dead_after_s=dead_after_s,
+            period_s=period_s,
+            on_dead=self._broadcast_unroute,
+            on_recover=self._broadcast_readmit,
+        )
+        # dummy store: gives the service something to answer STATS with;
+        # routing state that matters lives on the shards.  Clean departures
+        # (FIN) must clear the departing worker's routes on the SHARDS,
+        # not just here — hence on_farewell.
+        self._store = AsyncParamServer(dim=1, n_workers=1)
+        self._svc = ParamServerService(
+            self._store, host=host, port=port, monitor=self.monitor,
+            on_farewell=self._broadcast_readmit_wid,
+        )
+        self.address = self._svc.address
+        self.monitor.start()
+
+    @staticmethod
+    def _to_wid(worker: str):
+        try:
+            wid = int(worker)
+        except (TypeError, ValueError):
+            return None
+        return wid if wid >= 0 else None
+
+    def _broadcast(self, op: str, wid: int, attempts: int = 3) -> None:
+        """Deliver a routing decision to every shard, reconnecting and
+        retrying on failure: a one-shot swallowed error would leave that
+        shard's routing permanently diverged from the master's view
+        (monitor transitions fire exactly once).  Callbacks run under the
+        monitor's dispatch lock, so the admin clients see one thread at a
+        time."""
+        for i, addr in enumerate(self._shard_addresses):
+            for attempt in range(attempts):
+                try:
+                    getattr(self._shards[i], op)(wid)
+                    break
+                except (ConnectionError, OSError, RuntimeError):
+                    try:
+                        self._shards[i].close()
+                    except OSError:
+                        pass
+                    try:
+                        self._shards[i] = PSClient(
+                            addr, 1, timeout=self._timeout
+                        )
+                    except OSError:
+                        if attempt == attempts - 1:
+                            break  # shard is down; it cannot route
+                            # traffic until it returns anyway
+
+    def _broadcast_unroute(self, worker: str) -> None:
+        wid = self._to_wid(worker)
+        if wid is not None:
+            self._broadcast("unroute", wid)
+
+    def _broadcast_readmit(self, worker: str) -> None:
+        wid = self._to_wid(worker)
+        if wid is not None:
+            self._broadcast("readmit", wid)
+
+    def _broadcast_readmit_wid(self, wid: int) -> None:
+        self._broadcast("readmit", wid)
+
+    def close(self) -> None:
+        self.monitor.stop()
+        for c in self._shards:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._svc.close()

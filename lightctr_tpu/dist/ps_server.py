@@ -49,6 +49,12 @@ MSG_CLOSE = 5
 MSG_BEAT = 6
 MSG_STATS = 7
 MSG_FAREWELL = 8
+# control-plane ops: a MASTER process (owner of the heartbeat monitor)
+# broadcasts routing decisions to PS shards that have no monitor of their
+# own — the reference's master/paramserver role split (master.h:202-262
+# decides, network.h:148-151 the PS obeys)
+MSG_UNROUTE = 9
+MSG_READMIT = 10
 
 # One garbage length prefix must not make the server buffer gigabytes before
 # any validation: cap frames well above any real payload (2^20 keys at
@@ -106,14 +112,18 @@ class ParamServerService:
         host: str = "127.0.0.1",
         port: int = 0,
         monitor=None,
+        on_farewell=None,
     ):
         """``monitor``: optional HeartbeatMonitor; when given, MSG_BEAT
         frames drive it (workers heartbeat over their PS connection, the
         reference's heartbeats likewise ride the network — master.h:202)
         and its death/recovery events should be wired to ``ps`` routing by
-        the caller (``wire_heartbeat``)."""
+        the caller (``wire_heartbeat``).  ``on_farewell(wid)``: extra hook
+        on clean departures — the master role uses it to clear the
+        departing worker's routes on every shard."""
         self.ps = ps
         self.monitor = monitor
+        self.on_farewell = on_farewell
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._peers = []  # [(thread, conn)] of live connections
@@ -192,6 +202,14 @@ class ParamServerService:
                     elif msg_type == MSG_STATS:
                         body = json.dumps(self.ps.stats()).encode()
                         conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                    elif msg_type == MSG_UNROUTE:
+                        wid = int(wire.unpack_varint(payload, 1)[0])
+                        self.ps.unroute_worker(wid)
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                    elif msg_type == MSG_READMIT:
+                        wid = int(wire.unpack_varint(payload, 1)[0])
+                        self.ps.readmit_worker(wid)
+                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_FAREWELL:
                         # clean departure (FIN, master.h:146-190): stop
                         # liveness tracking so deliberate exits are not
@@ -200,6 +218,8 @@ class ParamServerService:
                         if self.monitor is not None:
                             self.monitor.forget(str(wid))
                         self.ps.readmit_worker(wid)
+                        if self.on_farewell is not None:
+                            self.on_farewell(wid)
                         conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_CLOSE:
                         return
@@ -245,9 +265,13 @@ class PSClient:
     Tracks ``bytes_sent``/``bytes_received`` so tests can assert the
     compaction is real."""
 
-    def __init__(self, address: Tuple[str, int], dim: int):
+    def __init__(self, address: Tuple[str, int], dim: int,
+                 timeout: Optional[float] = None):
+        """``timeout``: per-socket-op deadline in seconds (None = block
+        forever).  Control-plane clients (the master's shard admins) set
+        one so a wedged shard raises instead of stalling heartbeats."""
         self.dim = dim
-        self._sock = socket.create_connection(address)
+        self._sock = socket.create_connection(address, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.bytes_sent = 0
         self.bytes_received = 0
@@ -386,6 +410,18 @@ class PSClient:
         """Clean departure: deregister from liveness tracking (FIN)."""
         self._rpc(
             MSG_FAREWELL, wire.pack_varint(np.array([worker_id], np.int64))
+        )
+
+    def unroute(self, worker_id: int) -> None:
+        """Control-plane op (master -> shard): delete the worker's route."""
+        self._rpc(
+            MSG_UNROUTE, wire.pack_varint(np.array([worker_id], np.int64))
+        )
+
+    def readmit(self, worker_id: int) -> None:
+        """Control-plane op (master -> shard): restore the worker's route."""
+        self._rpc(
+            MSG_READMIT, wire.pack_varint(np.array([worker_id], np.int64))
         )
 
     def close(self) -> None:
@@ -544,3 +580,12 @@ class ShardedPSClient:
     def close(self) -> None:
         for c in self.clients:
             c.close()
+
+
+def make_client(addresses, dim: int):
+    """One shard address -> plain PSClient; several -> key-partitioned
+    :class:`ShardedPSClient` (the policy both the cluster launcher and the
+    Criteo soak use)."""
+    if len(addresses) == 1:
+        return PSClient(tuple(addresses[0]), dim)
+    return ShardedPSClient(addresses, dim)

@@ -148,3 +148,83 @@ def test_ps_failover_snapshot_restore(rng):
         client2.close()
     finally:
         svc2.close()
+
+
+def test_master_broadcasts_routing_to_all_shards():
+    """The three-role split (master.h decides, network.h the PS obeys):
+    a worker that stops beating the MASTER is unrouted on EVERY shard via
+    the control-plane ops; its returning beat readmits it everywhere."""
+    import time
+
+    from lightctr_tpu.dist.master import MasterService
+
+    shards = [AsyncParamServer(dim=2, n_workers=2) for _ in range(2)]
+    svcs = [ParamServerService(ps) for ps in shards]
+    master = MasterService(
+        [s.address for s in svcs],
+        stale_after_s=0.2, dead_after_s=0.4, period_s=0.1,
+    )
+    try:
+        beat = PSClient(master.address, 1)
+        beat.beat(0)
+        beat.beat(1)
+        # worker 1 goes silent; worker 0 keeps beating
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            beat.beat(0)
+            if all(1 in ps._unrouted for ps in shards):
+                break
+            time.sleep(0.05)
+        assert all(1 in ps._unrouted for ps in shards)
+        assert all(0 not in ps._unrouted for ps in shards)
+
+        # returning beat -> readmitted on every shard
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            beat.beat(1)
+            if all(1 not in ps._unrouted for ps in shards):
+                break
+            time.sleep(0.05)
+        assert all(1 not in ps._unrouted for ps in shards)
+        beat.close()
+    finally:
+        master.close()
+        for s in svcs:
+            s.close()
+
+
+def test_master_farewell_clears_shard_routes():
+    """A clean FIN to the master clears the departing worker's routes on
+    the SHARDS (not just the master's dummy store)."""
+    from lightctr_tpu.dist.master import MasterService
+
+    shards = [AsyncParamServer(dim=2, n_workers=2) for _ in range(2)]
+    svcs = [ParamServerService(ps) for ps in shards]
+    master = MasterService([s.address for s in svcs], period_s=10.0)
+    try:
+        for ps in shards:
+            ps.unroute_worker(1)
+        client = PSClient(master.address, 1)
+        client.farewell(1)
+        assert all(1 not in ps._unrouted for ps in shards)
+        client.close()
+    finally:
+        master.close()
+        for s in svcs:
+            s.close()
+
+
+def test_unroute_readmit_wire_ops():
+    """MSG_UNROUTE / MSG_READMIT drive the store's routing directly."""
+    ps = AsyncParamServer(dim=2, n_workers=2)
+    svc = ParamServerService(ps)
+    try:
+        client = PSClient(svc.address, 2)
+        client.preload({3: np.ones(2, np.float32)})
+        client.unroute(1)
+        assert client.pull([3], worker_epoch=0, worker_id=1) is None
+        client.readmit(1)
+        assert client.pull([3], worker_epoch=0, worker_id=1) is not None
+        client.close()
+    finally:
+        svc.close()

@@ -81,10 +81,10 @@ def resolve_data(data_arg, workdir):
 # PS process
 
 
-def _ps_proc(conn, dim, n_workers, updater, lr, staleness, seed, stop_evt):
-    """Own process for the PS service + heartbeat monitor (the reference's
-    paramserver binary)."""
-    from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, wire_heartbeat
+def _shard_proc(conn, dim, n_workers, updater, lr, staleness, seed,
+                stop_evt):
+    """One PS shard process (the reference's paramserver binary): serves
+    keys and OBEYS routing — the master decides (network.h:148-151)."""
     from lightctr_tpu.dist.ps_server import ParamServerService
     from lightctr_tpu.embed.async_ps import AsyncParamServer
 
@@ -92,17 +92,25 @@ def _ps_proc(conn, dim, n_workers, updater, lr, staleness, seed, stop_evt):
         dim=dim, updater=updater, learning_rate=lr, n_workers=n_workers,
         staleness_threshold=staleness, seed=seed,
     )
-    monitor = HeartbeatMonitor(
+    svc = ParamServerService(ps)
+    conn.send(svc.address)
+    stop_evt.wait()
+    svc.close()
+
+
+def _master_proc(conn, shard_addresses, stop_evt):
+    """The master role (master.h:146-262): owns the heartbeat monitor,
+    broadcasts unroute/readmit decisions to every shard."""
+    from lightctr_tpu.dist.master import MasterService
+
+    m = MasterService(
+        [tuple(a) for a in shard_addresses],
         stale_after_s=STALE_AFTER_S, dead_after_s=DEAD_AFTER_S,
         period_s=BEAT_PERIOD_S,
     )
-    wire_heartbeat(monitor, ps)
-    svc = ParamServerService(ps, monitor=monitor)
-    monitor.start()
-    conn.send(svc.address)
+    conn.send(m.address)
     stop_evt.wait()
-    monitor.stop()
-    svc.close()
+    m.close()
 
 
 # ---------------------------------------------------------------------------
@@ -127,8 +135,8 @@ def _beat_loop(address, worker_id, stop):
             pass
 
 
-def _cluster_worker(worker_id, n_workers, address, data_path, meta, cfg,
-                    out_dir, start_epoch, throttle_s):
+def _cluster_worker(worker_id, n_workers, shard_addresses, master_address,
+                    data_path, meta, cfg, out_dir, start_epoch, throttle_s):
     from lightctr_tpu.utils.devicecheck import pin_cpu_platform
 
     pin_cpu_platform(1)
@@ -137,7 +145,7 @@ def _cluster_worker(worker_id, n_workers, address, data_path, meta, cfg,
     import jax.numpy as jnp
 
     from lightctr_tpu.data.streaming import iter_libffm_batches
-    from lightctr_tpu.dist.ps_server import PSClient
+    from lightctr_tpu.dist.ps_server import make_client
     from lightctr_tpu.models import widedeep
     from lightctr_tpu.ops import losses as losses_lib
 
@@ -150,10 +158,11 @@ def _cluster_worker(worker_id, n_workers, address, data_path, meta, cfg,
     field_cnt = meta["field_cnt"]
     max_nnz = meta["max_nnz"]
 
-    ps = PSClient(address, row_dim)
+    ps = make_client(shard_addresses, row_dim)
     stop_beat = threading.Event()
     beat_t = threading.Thread(
-        target=_beat_loop, args=(address, worker_id, stop_beat), daemon=True
+        target=_beat_loop, args=(master_address, worker_id, stop_beat),
+        daemon=True,
     )
     beat_t.start()
 
@@ -244,7 +253,11 @@ def _cluster_worker(worker_id, n_workers, address, data_path, meta, cfg,
         }, f)
     stop_beat.set()
     beat_t.join(timeout=2.0)
-    ps.farewell(worker_id)  # FIN: a deliberate exit is not a death
+    from lightctr_tpu.dist.ps_server import PSClient
+
+    fin = PSClient(tuple(master_address), 1)
+    fin.farewell(worker_id)  # FIN to the MASTER: deliberate exit != death
+    fin.close()
     ps.close()
 
 
@@ -254,15 +267,16 @@ def _cluster_worker(worker_id, n_workers, address, data_path, meta, cfg,
 
 def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
         lr=0.1, updater="adagrad", staleness=10, seed=0, workdir=None,
-        kill_worker=1, throttle=None, out="CLUSTER_CONVERGENCE.json"):
-    """throttle: optional {worker_id: seconds-per-batch} skew injection."""
+        kill_worker=1, throttle=None, ps_shards=1,
+        out="CLUSTER_CONVERGENCE.json"):
+    """throttle: optional {worker_id: seconds-per-batch} skew injection.
+    ps_shards: number of PS shard processes (key % n partition)."""
     import tempfile
 
     import jax
 
     from lightctr_tpu import TrainConfig
     from lightctr_tpu.data import load_libffm
-    from lightctr_tpu.dist.ps_server import PSClient
     from lightctr_tpu.models import widedeep
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
     from lightctr_tpu.ops import metrics as metrics_lib
@@ -304,48 +318,58 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
     def mark(kind, **kw):
         events.append({"t": round(time.time() - t0, 2), "event": kind, **kw})
 
-    # -- 1. PS service process
+    # -- 1. the three-role control/data plane: N PS shard processes, then
+    # one MASTER process owning the heartbeat monitor (master.h topology)
     stop_evt = ctx.Event()
-    parent_conn, child_conn = ctx.Pipe()
-    ps_proc = ctx.Process(
-        target=_ps_proc,
-        args=(child_conn, row_dim, n_workers, updater, lr, staleness, seed,
-              stop_evt),
-    )
     t0 = time.time()
-    ps_proc.start()
-    if not parent_conn.poll(60):
-        # a dead PS child (e.g. spawn could not re-import __main__) must
-        # fail loudly, not block recv() forever
-        ps_proc.terminate()
-        raise RuntimeError("PS service failed to start within 60s")
-    address = parent_conn.recv()
-    mark("ps_up", address=list(address))
+    role_procs, addresses = [], []
+    try:
+        for s in range(ps_shards):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(
+                target=_shard_proc,
+                args=(child_conn, row_dim, n_workers, updater, lr,
+                      staleness, seed + s, stop_evt),
+            )
+            p.start()
+            role_procs.append(p)
+            if not parent_conn.poll(60):
+                raise RuntimeError("PS shard failed to start within 60s")
+            addresses.append(list(parent_conn.recv()))
+        parent_conn, child_conn = ctx.Pipe()
+        master_proc = ctx.Process(
+            target=_master_proc, args=(child_conn, addresses, stop_evt)
+        )
+        master_proc.start()
+        role_procs.append(master_proc)
+        if not parent_conn.poll(60):
+            raise RuntimeError("master failed to start within 60s")
+        master_address = list(parent_conn.recv())
+    except Exception:
+        stop_evt.set()
+        for p in role_procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        raise
+    mark("ps_up", shards=addresses)
+    mark("master_up", address=master_address)
 
-    admin = PSClient(address, row_dim)
-    # master syncInitializer: deterministic start for every worker
-    w0 = np.asarray(params0["w"])
-    e0 = np.asarray(params0["embed"])
-    rows0 = np.concatenate([w0[:, None], e0], axis=1).astype(np.float32)
-    admin.preload_arrays(np.arange(feature_cnt, dtype=np.int64), rows0)
-    chunks = _dense_chunks(dense_vec, row_dim)
-    ck = np.array(sorted(chunks), np.int64)
-    admin.preload_arrays(ck, np.stack([chunks[int(k)] for k in ck]))
+    admin = None
+    procs = {}
+
+    from lightctr_tpu.dist.ps_server import make_client
 
     throttle = throttle or {}
 
     def spawn_worker(w, start_epoch=0):
         p = ctx.Process(
             target=_cluster_worker,
-            args=(w, n_workers, address, data_path, meta, cfg, workdir,
-                  start_epoch, float(throttle.get(w, 0.0))),
+            args=(w, n_workers, addresses, master_address, data_path, meta,
+                  cfg, workdir, start_epoch, float(throttle.get(w, 0.0))),
         )
         p.start()
         return p
-
-    # -- 2. workers, each streaming its own disk shard
-    procs = {w: spawn_worker(w) for w in range(n_workers)}
-    mark("workers_up", n=n_workers)
 
     def wait_until(cond, what, watch=(), timeout_s=120.0, sleep_s=0.1):
         """Poll ``cond``; fail loudly on timeout or if a watched child dies
@@ -362,16 +386,46 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 raise TimeoutError(f"timed out waiting for {what}")
             time.sleep(sleep_s)
 
+    def agg_stats():
+        """Aggregate shard stats (single shard -> dict; sharded -> list)."""
+        s = admin.stats()
+        if isinstance(s, dict):
+            return s
+        return {
+            "last_epoch_version": max(x["last_epoch_version"] for x in s),
+            "staleness": max(x["staleness"] for x in s),
+            "unrouted": sorted({w for x in s for w in x["unrouted"]}),
+            "withheld_pulls": sum(x["withheld_pulls"] for x in s),
+            "dropped_pushes": sum(x["dropped_pushes"] for x in s),
+            "rejected_pulls": sum(x["rejected_pulls"] for x in s),
+            "rejected_pushes": sum(x["rejected_pushes"] for x in s),
+            "n_keys": sum(x["n_keys"] for x in s),
+            "per_shard": s,
+        }
+
     report_fail = None
     try:
+        admin = make_client(addresses, row_dim)
+        # master syncInitializer: deterministic start for every worker
+        w0 = np.asarray(params0["w"])
+        e0 = np.asarray(params0["embed"])
+        rows0 = np.concatenate([w0[:, None], e0], axis=1).astype(np.float32)
+        admin.preload_arrays(np.arange(feature_cnt, dtype=np.int64), rows0)
+        chunks = _dense_chunks(dense_vec, row_dim)
+        ck = np.array(sorted(chunks), np.int64)
+        admin.preload_arrays(ck, np.stack([chunks[int(k)] for k in ck]))
+
+        procs.update({w: spawn_worker(w) for w in range(n_workers)})
+        mark("workers_up", n=n_workers)
+
         if kill_worker is not None:
             # -- 3. mid-run failure injection: SIGKILL, observe unroute
             # (rejected counters / unrouted set), relaunch, observe readmit
             target_epoch = max(2, epochs // 4)
             wait_until(
-                lambda: admin.stats()["last_epoch_version"] >= target_epoch,
+                lambda: agg_stats()["last_epoch_version"] >= target_epoch,
                 f"epoch ledger to reach {target_epoch}",
-                watch=[ps_proc, *procs.values()], sleep_s=0.2,
+                watch=[*role_procs, *procs.values()], sleep_s=0.2,
             )
             victim = procs[kill_worker]
             os.kill(victim.pid, signal.SIGKILL)
@@ -379,11 +433,11 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
             mark("worker_killed", worker=kill_worker)
 
             wait_until(
-                lambda: kill_worker in admin.stats()["unrouted"],
+                lambda: kill_worker in agg_stats()["unrouted"],
                 f"heartbeat to unroute worker {kill_worker}",
-                watch=[ps_proc],
+                watch=role_procs,
             )
-            s = admin.stats()
+            s = agg_stats()
             mark("unrouted_observed", worker=kill_worker,
                  stats={k: s[k] for k in
                         ("rejected_pulls", "rejected_pushes", "unrouted")})
@@ -396,9 +450,9 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                  start_epoch=resume_epoch)
 
             wait_until(
-                lambda: kill_worker not in admin.stats()["unrouted"],
+                lambda: kill_worker not in agg_stats()["unrouted"],
                 f"readmission of worker {kill_worker}",
-                watch=[ps_proc, procs[kill_worker]],
+                watch=[*role_procs, procs[kill_worker]],
             )
             mark("readmitted_observed", worker=kill_worker)
 
@@ -410,7 +464,7 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
         wall = time.time() - t0
         mark("workers_done")
 
-        final_stats = admin.stats()
+        final_stats = agg_stats()
 
         # -- 4. PS-trained model vs single-process baseline
         _, w_fin = admin.pull_arrays(
@@ -474,6 +528,7 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 "data": data_path, "rows": int(len(payload["labels"])),
                 "feature_cnt": int(feature_cnt),
                 "killed_worker": kill_worker,
+                "ps_shards": ps_shards,
                 "throttle": {str(k): v for k, v in throttle.items()},
                 "heartbeat": {"period_s": BEAT_PERIOD_S,
                               "stale_s": STALE_AFTER_S,
@@ -493,9 +548,13 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 json.dump(report, f, indent=1)
         return report
     finally:
-        admin.close()
+        if admin is not None:
+            admin.close()
         stop_evt.set()
-        ps_proc.join(timeout=10)
+        for p in role_procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
         for p in procs.values():
             if p.is_alive():
                 p.terminate()
@@ -516,6 +575,7 @@ def main():
     ap.add_argument("--updater", default="adagrad")
     ap.add_argument("--staleness", type=int, default=10)
     ap.add_argument("--kill-worker", type=int, default=1)
+    ap.add_argument("--ps-shards", type=int, default=1)
     ap.add_argument("--no-kill", action="store_true")
     ap.add_argument("--out", default="CLUSTER_CONVERGENCE.json")
     args = ap.parse_args()
@@ -525,7 +585,7 @@ def main():
         batch_size=args.batch_size, factor_dim=args.factor_dim, lr=args.lr,
         updater=args.updater, staleness=args.staleness,
         kill_worker=None if args.no_kill else args.kill_worker,
-        out=args.out,
+        ps_shards=args.ps_shards, out=args.out,
     )
     print(json.dumps({
         "timeline": report["timeline"],

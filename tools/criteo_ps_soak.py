@@ -68,13 +68,10 @@ def _ps_proc(conn, n_workers, lr, stop_evt, seed=0):
 
 
 def _make_client(addresses, dim):
-    """One PS shard -> plain PSClient; several -> key-partitioned fan-out
-    (the reference's many-paramserver-processes topology)."""
-    from lightctr_tpu.dist.ps_server import PSClient, ShardedPSClient
+    """Shared shard-count policy — lightctr_tpu.dist.ps_server.make_client."""
+    from lightctr_tpu.dist.ps_server import make_client
 
-    if len(addresses) == 1:
-        return PSClient(tuple(addresses[0]), dim)
-    return ShardedPSClient(addresses, dim)
+    return make_client(addresses, dim)
 
 
 # ---------------------------------------------------------------------------
