@@ -1,0 +1,12 @@
+"""Ring-AllReduce across REAL process boundaries (the reference's
+build_ring.sh deployment): two jax.distributed processes form one 4-member
+ppermute ring and train to bit-parity with a single-process oracle
+(tools/ring_cluster; ring_collect.h:48-218 counterpart)."""
+
+
+def test_cross_process_ring_matches_single(tmp_path):
+    from tools.ring_cluster import run
+
+    report = run(epochs=10, out=None, workdir=str(tmp_path), variants=(0,))
+    assert report["exact_ring"]["max_param_diff_vs_single"] < 1e-4
+    assert report["exact_ring"]["ring"] == 4
