@@ -227,3 +227,36 @@ def test_sharded_ps_client_routes_and_matches_single_store(rng):
     finally:
         for s in svcs:
             s.close()
+
+
+def test_sharded_pull_withheld_on_one_shard_drains_cleanly(rng):
+    """If ANY shard withholds (SSP gate), the sharded pull returns None —
+    and the pipelined replies from the other shards are fully drained so
+    the next request isn't misaligned with a stale reply."""
+    from lightctr_tpu.dist.ps_server import ShardedPSClient
+
+    stores = [AsyncParamServer(dim=DIM, n_workers=2, staleness_threshold=2,
+                               seed=s) for s in (0, 1)]
+    svcs = [ParamServerService(ps) for ps in stores]
+    try:
+        client = ShardedPSClient([s.address for s in svcs], DIM)
+        keys = np.arange(10, dtype=np.int64)
+        client.preload_arrays(keys, np.ones((10, DIM), np.float32))
+
+        # trip the SSP gate on shard 0 only (even keys live there)
+        g = np.ones((1, DIM), np.float32)
+        for e in range(6):
+            stores[0].push_batch(0, np.array([2], np.int64), g,
+                                 worker_epoch=e)
+        stores[0].push_batch(1, np.array([2], np.int64), g, worker_epoch=0)
+
+        assert client.pull_arrays(keys, worker_epoch=10,
+                                  worker_id=0) is None
+        assert client.withheld_pulls == 1
+        # the connection stream is still aligned: a normal pull succeeds
+        out = client.pull_arrays(keys, worker_epoch=0, worker_id=0)
+        assert out is not None and len(out[0]) == 10
+        client.close()
+    finally:
+        for s in svcs:
+            s.close()
