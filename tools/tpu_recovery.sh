@@ -15,6 +15,12 @@ echo "== 2/7 TPU compiled-kernel gates =="
 timeout -k 30 1800 python -m pytest tests_tpu -q || { echo "tests_tpu FAILED"; FAIL=1; }
 
 echo "== 3/7 pallas kernel bench (PALLAS_BENCH.json) =="
+# This step also settles the fused-Adagrad keep/delete decision (open
+# since r2): read the fused_adagrad cells' "speedup" (XLA time / Pallas
+# time) at n=2^20 and 2^24.  Rule: speedup >= 1.1 at either size -> KEEP
+# the kernel and the CTRTrainer(fused_adagrad=...) flag; below 1.1 at
+# both -> DELETE the flag and kernel (XLA fusion already saturates HBM for
+# this op) and record the numbers in the round STATUS.
 timeout -k 30 1800 python -m tools.bench_pallas || { echo "bench_pallas FAILED"; FAIL=1; }
 
 echo "== 4/7 full benchmark matrix (FM/FFM/NN) =="
