@@ -136,10 +136,20 @@ class HeartbeatMonitor:
     def forget(self, worker: str) -> None:
         """Clean departure (the reference's FIN shutdown handshake,
         master.h:146-190): stop tracking the worker so its silence after a
-        deliberate exit is not declared a death."""
-        with self._lock:
-            self._last.pop(worker, None)
-            self._dead.discard(worker)
+        deliberate exit is not declared a death.
+
+        Takes _dispatch_lock FIRST (the same dispatch->state order
+        _dispatch uses): a ('dead', w) event already popped but not yet
+        delivered would otherwise fire after this purge and re-unroute the
+        departed worker; waiting for the in-flight delivery keeps the
+        caller's subsequent readmit broadcast strictly after it."""
+        with self._dispatch_lock:
+            with self._lock:
+                self._last.pop(worker, None)
+                self._dead.discard(worker)
+                # also purge queued transitions enqueued by a racing
+                # check() sweep but not yet dispatched
+                self._events = [e for e in self._events if e[1] != worker]
 
     def check(self) -> Dict[str, str]:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""

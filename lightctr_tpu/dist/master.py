@@ -17,6 +17,9 @@ different roles.
 
 from __future__ import annotations
 
+import logging
+import threading
+
 from lightctr_tpu.dist.bootstrap import (
     DEAD_AFTER_S,
     HEARTBEAT_PERIOD_S,
@@ -50,8 +53,18 @@ class MasterService:
         # retried), not stall heartbeat processing under the dispatch lock
         self._shard_addresses = [tuple(a) for a in shard_addresses]
         self._timeout = shard_rpc_timeout_s
-        self._shards = [PSClient(a, 1, timeout=shard_rpc_timeout_s)
-                        for a in self._shard_addresses]
+        # admin connections are LAZY (None until first use, re-None'd on
+        # failure): a shard that is down at master startup — or dies later —
+        # must degrade to queued decisions, not crash the control plane
+        self._shards: list = [None] * len(self._shard_addresses)
+        # per-shard queue of routing decisions the shard missed, replayed
+        # in order on next successful contact (see _broadcast)
+        self._pending = [[] for _ in self._shard_addresses]
+        # serializes ALL admin traffic: _broadcast arrives from the
+        # monitor's dispatch thread AND per-connection farewell handlers,
+        # and flush_pending from arbitrary callers — the admin PSClients'
+        # sockets and the pending queues are not thread-safe
+        self._admin_lock = threading.Lock()
         self.monitor = HeartbeatMonitor(
             stale_after_s=stale_after_s,
             dead_after_s=dead_after_s,
@@ -79,31 +92,66 @@ class MasterService:
             return None
         return wid if wid >= 0 else None
 
-    def _broadcast(self, op: str, wid: int, attempts: int = 3) -> None:
-        """Deliver a routing decision to every shard, reconnecting and
-        retrying on failure: a one-shot swallowed error would leave that
-        shard's routing permanently diverged from the master's view
-        (monitor transitions fire exactly once).  Callbacks run under the
-        monitor's dispatch lock, so the admin clients see one thread at a
-        time."""
-        for i, addr in enumerate(self._shard_addresses):
-            for attempt in range(attempts):
-                try:
-                    getattr(self._shards[i], op)(wid)
-                    break
-                except (ConnectionError, OSError, RuntimeError):
+    def _deliver(self, i: int, op: str, wid: int, attempts: int = 3) -> bool:
+        """Try an admin op against shard ``i`` up to ``attempts`` times,
+        reconnecting between tries (so every reconnect is followed by an
+        op retry, never wasted on the final slot)."""
+        for attempt in range(attempts):
+            try:
+                if self._shards[i] is None:
+                    self._shards[i] = PSClient(
+                        self._shard_addresses[i], 1, timeout=self._timeout
+                    )
+                getattr(self._shards[i], op)(wid)
+                return True
+            except (ConnectionError, OSError, RuntimeError):
+                if self._shards[i] is not None:
                     try:
                         self._shards[i].close()
                     except OSError:
                         pass
-                    try:
-                        self._shards[i] = PSClient(
-                            addr, 1, timeout=self._timeout
-                        )
-                    except OSError:
-                        if attempt == attempts - 1:
-                            break  # shard is down; it cannot route
-                            # traffic until it returns anyway
+                    self._shards[i] = None
+                if attempt == attempts - 1:
+                    return False
+        return False
+
+    def _replay(self, i: int) -> bool:
+        """Drain shard ``i``'s missed-decision queue in order, stopping at
+        the first failed delivery.  True iff the queue emptied.  Caller
+        holds _admin_lock."""
+        pending = self._pending[i]
+        while pending:
+            p_op, p_wid = pending[0]
+            if not self._deliver(i, p_op, p_wid):
+                return False
+            pending.pop(0)
+        return True
+
+    def _broadcast(self, op: str, wid: int) -> None:
+        """Deliver a routing decision to every shard; decisions a shard
+        misses (down OR wedged) are queued per shard and replayed in order
+        on the next successful contact — monitor transitions fire exactly
+        once, so an abandoned delivery would leave that shard's routing
+        permanently diverged from the master's view."""
+        with self._admin_lock:
+            for i in range(len(self._shards)):
+                # missed decisions first: order matters
+                if not self._replay(i) or not self._deliver(i, op, wid):
+                    self._pending[i].append((op, wid))
+                    logging.getLogger(__name__).warning(
+                        "PS shard %s unreachable: queued %s(%d) for replay "
+                        "(%d pending)",
+                        self._shard_addresses[i], op, wid,
+                        len(self._pending[i]),
+                    )
+
+    def flush_pending(self) -> int:
+        """Replay queued routing decisions against every shard (call after
+        a shard restart/restore).  Returns the number still undelivered."""
+        with self._admin_lock:
+            for i in range(len(self._shards)):
+                self._replay(i)
+            return sum(len(p) for p in self._pending)
 
     def _broadcast_unroute(self, worker: str) -> None:
         wid = self._to_wid(worker)
@@ -121,8 +169,9 @@ class MasterService:
     def close(self) -> None:
         self.monitor.stop()
         for c in self._shards:
-            try:
-                c.close()
-            except OSError:
-                pass
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
         self._svc.close()

@@ -436,9 +436,11 @@ class ShardedPSClient:
     """Key-partitioned client over N PS service shards — the reference's
     scale-out topology (one worker talks to MANY paramserver processes,
     keys routed by consistent hash, ``consistent_hash.h`` +
-    ``distributed_algo_abst.h:176-280``).  Routing here is ``key % n_shards``
-    (the loaders already fold ids; modulo spreads Criteo's frequent head
-    uniformly, which is what the reference's virtual-node hashing buys).
+    ``distributed_algo_abst.h:176-280``).  Routing policy is pluggable
+    (dist/partition.py): ``"modulo"`` — ``key % n_shards``, uniform for
+    folded ids but remaps ~everything on a shard-count change — or
+    ``"ring"`` — the reference's virtual-node consistent-hash ring,
+    vectorized, remapping only ~1/n keys when a shard joins/leaves.
 
     Same array protocol surface as :class:`PSClient`; each call splits the
     sorted key batch per shard, sends every sub-request before reading any
@@ -451,12 +453,15 @@ class ShardedPSClient:
     and a pull withheld by any shard is retried whole.
     """
 
-    def __init__(self, addresses, dim: int):
+    def __init__(self, addresses, dim: int, partition: str = "modulo"):
         if not addresses:
             raise ValueError("need at least one PS shard address")
         self.dim = dim
         self.clients = [PSClient(tuple(a), dim) for a in addresses]
         self.n_shards = len(self.clients)
+        from .partition import make_partition
+
+        self.partition = make_partition(partition, self.n_shards)
 
     # -- accounting (aggregated over shards) --------------------------------
 
@@ -477,10 +482,11 @@ class ShardedPSClient:
         return sum(c.dropped_pushes for c in self.clients)
 
     def _split(self, keys: np.ndarray):
-        """shard id per key + the per-shard sorted key arrays (sorted input
-        stays sorted within each shard) + scatter indices to merge replies
-        back into request order."""
-        shard = (keys % self.n_shards).astype(np.int64)
+        """shard id per key (partition policy: modulo or consistent-hash
+        ring) + the per-shard sorted key arrays (sorted input stays sorted
+        within each shard) + scatter indices to merge replies back into
+        request order."""
+        shard = self.partition.shard_of(keys)
         order = []
         parts = []
         for s in range(self.n_shards):
@@ -489,8 +495,41 @@ class ShardedPSClient:
             parts.append(keys[idx])
         return parts, order
 
+    @staticmethod
+    def _check_sorted(keys_arr: np.ndarray, *, unique: bool, op: str) -> None:
+        """Same loud-failure contract as PSClient: pack_keys sorts the wire
+        key stream while row bytes keep caller order, so unsorted (or, for
+        row-carrying ops, duplicate) keys would silently misalign rows.
+        The per-shard split preserves order, so checking the full batch
+        once covers every shard."""
+        if len(keys_arr) > 1:
+            d = np.diff(keys_arr)
+            if not ((d > 0).all() if unique else (d >= 0).all()):
+                kind = "sorted unique" if unique else "sorted"
+                raise ValueError(f"{op} keys must be {kind}")
+
+    @staticmethod
+    def _drain(pending, handle) -> None:
+        """Receive every pending shard reply even when one errors — a
+        protocol-error reply from shard i must not leave shards i+1..n
+        undrained (a caller that catches and retries would read stale
+        replies, silently desynced).  Re-raises the first error after the
+        drain."""
+        err = None
+        for item in pending:
+            try:
+                handle(item)
+            except (RuntimeError, OSError, ValueError) as e:
+                # ValueError: a malformed reply payload (_keys_and_rows
+                # reshape/varint skew) must also not abort the drain
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
     def pull_arrays(self, keys, worker_epoch, worker_id=None):
         keys_arr = np.ascontiguousarray(keys, np.int64)
+        self._check_sorted(keys_arr, unique=False, op="pull_arrays")
         parts, order = self._split(keys_arr)
         hdr = wire.pack_varint(np.array(
             [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
@@ -502,24 +541,29 @@ class ShardedPSClient:
                 c._send(MSG_PULL, hdr + wire.pack_keys(part))
                 live.append((c, part, idx))
         rows = np.empty((len(keys_arr), self.dim), np.float32)
-        withheld = False
-        for c, part, idx in live:
+        state = {"withheld": False}
+
+        def handle(item):
+            c, part, idx = item
             reply = c._recv_reply()
             if reply[:1] == b"\x01":
                 # any shard withholding means the whole pull retries — the
                 # reference worker likewise blocks until every PS replies
                 c.withheld_pulls += 1
-                withheld = True
-                continue  # still drain the remaining replies
+                state["withheld"] = True
+                return  # still drain the remaining replies
             _, r = _keys_and_rows(reply[1:], self.dim, np.float16)
             rows[idx] = r
-        if withheld:
+
+        self._drain(live, handle)
+        if state["withheld"]:
             return None
         return keys_arr, rows
 
     def push_arrays(self, worker_id, keys, rows, worker_epoch) -> bool:
         keys_arr = np.ascontiguousarray(keys, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        self._check_sorted(keys_arr, unique=True, op="push_arrays")
         parts, order = self._split(keys_arr)
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
         live = []
@@ -531,18 +575,22 @@ class ShardedPSClient:
                     + r[idx].astype(np.float16).tobytes(),
                 )
                 live.append(c)
-        ok = True
-        for c in live:
+        state = {"ok": True}
+
+        def handle(c):
             if c._recv_reply() != b"\x00":
                 c.dropped_pushes += 1
-                ok = False  # partial application is possible (per-shard
-                # ledgers — see class docstring); caller semantics match
-                # the reference's lossy async pushes
-        return ok
+                state["ok"] = False  # partial application is possible
+                # (per-shard ledgers — see class docstring); caller
+                # semantics match the reference's lossy async pushes
+
+        self._drain(live, handle)
+        return state["ok"]
 
     def preload_arrays(self, keys, rows) -> None:
         keys_arr = np.ascontiguousarray(keys, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        self._check_sorted(keys_arr, unique=True, op="preload_arrays")
         parts, order = self._split(keys_arr)
         live = []
         for c, part, idx in zip(self.clients, parts, order):
@@ -550,8 +598,7 @@ class ShardedPSClient:
                 c._send(MSG_PRELOAD,
                         wire.pack_keys(part) + r[idx].tobytes())
                 live.append(c)
-        for c in live:
-            c._recv_reply()
+        self._drain(live, lambda c: c._recv_reply())
 
     def snapshot_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         keys_parts, rows_parts = [], []
@@ -582,10 +629,11 @@ class ShardedPSClient:
             c.close()
 
 
-def make_client(addresses, dim: int):
+def make_client(addresses, dim: int, partition: str = "modulo"):
     """One shard address -> plain PSClient; several -> key-partitioned
     :class:`ShardedPSClient` (the policy both the cluster launcher and the
-    Criteo soak use)."""
+    Criteo soak use).  ``partition`` picks the key->shard policy
+    ("modulo" or consistent-hash "ring", see dist/partition.py)."""
     if len(addresses) == 1:
         return PSClient(tuple(addresses[0]), dim)
-    return ShardedPSClient(addresses, dim)
+    return ShardedPSClient(addresses, dim, partition=partition)
