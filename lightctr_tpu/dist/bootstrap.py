@@ -151,6 +151,25 @@ class HeartbeatMonitor:
                 # check() sweep but not yet dispatched
                 self._events = [e for e in self._events if e[1] != worker]
 
+    def peek(self) -> Dict[str, str]:
+        """READ-ONLY view of worker -> 'alive' | 'stale' | 'dead', computed
+        from beat ages without recording transitions or dispatching
+        callbacks — the STATS wire op's view (transitions belong to the
+        period thread's check() sweeps, never to a request thread)."""
+        now = self._clock()
+        out = {}
+        with self._lock:
+            for w, t in self._last.items():
+                age = now - t
+                out[w] = ("dead" if age >= self.dead_after_s else
+                          "stale" if age >= self.stale_after_s else "alive")
+        return out
+
+    def dead_workers(self) -> set:
+        """Copy of the declared-dead set (the master's routing view)."""
+        with self._lock:
+            return set(self._dead)
+
     def check(self) -> Dict[str, str]:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
         now = self._clock()

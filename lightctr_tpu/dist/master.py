@@ -30,6 +30,12 @@ from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 
 
+# Beats with ids at/above this base are PS-SHARD liveness (shard i beats
+# with SHARD_ID_BASE + i), disjoint from worker ids — the reference master
+# monitors every registered node kind in one ledger (master.h:202-262).
+SHARD_ID_BASE = 1 << 20
+
+
 class MasterService:
     """Heartbeat/routing authority over a set of PS shards.
 
@@ -37,7 +43,12 @@ class MasterService:
     monitor declares a worker dead (or sees it return), the decision is
     pushed to every shard via admin ops.  The local store is a dim-1 dummy
     — the master serves no parameters (master.h's master holds no table
-    either)."""
+    either).
+
+    SHARDS heartbeat here too (ids ``SHARD_ID_BASE + shard_index``): a dead
+    shard shows up as ``dead`` in the STATS liveness map (the ops plane
+    reads it to trigger relaunch+restore), and a returning shard's first
+    beat auto-replays every routing decision it missed while down."""
 
     def __init__(
         self,
@@ -90,7 +101,16 @@ class MasterService:
             wid = int(worker)
         except (TypeError, ValueError):
             return None
-        return wid if wid >= 0 else None
+        # shard liveness ids are not workers: no routing broadcast for them
+        return wid if 0 <= wid < SHARD_ID_BASE else None
+
+    @staticmethod
+    def _to_shard(worker: str):
+        try:
+            wid = int(worker)
+        except (TypeError, ValueError):
+            return None
+        return wid - SHARD_ID_BASE if wid >= SHARD_ID_BASE else None
 
     def _deliver(self, i: int, op: str, wid: int, attempts: int = 3) -> bool:
         """Try an admin op against shard ``i`` up to ``attempts`` times,
@@ -157,11 +177,40 @@ class MasterService:
         wid = self._to_wid(worker)
         if wid is not None:
             self._broadcast("unroute", wid)
+            return
+        shard = self._to_shard(worker)
+        if shard is not None:
+            logging.getLogger(__name__).warning(
+                "PS shard %d declared dead (heartbeat silence)", shard
+            )
 
     def _broadcast_readmit(self, worker: str) -> None:
         wid = self._to_wid(worker)
         if wid is not None:
             self._broadcast("readmit", wid)
+            return
+        shard = self._to_shard(worker)
+        if shard is not None:
+            self._resync_shard(shard)
+
+    def _resync_shard(self, shard: int) -> None:
+        """A (re)joining shard may be a FRESH process whose store lost
+        every routing decision delivered to its predecessor — replaying
+        only queued (undelivered) decisions is not enough.  Push the
+        master's entire current dead-set to THAT shard as unroutes, then
+        replay anything still queued for every shard."""
+        if not (0 <= shard < len(self._shards)):
+            return
+        with self._admin_lock:
+            for w in sorted(self.monitor.dead_workers()):
+                wid = self._to_wid(w)
+                if wid is not None:
+                    self._deliver(shard, "unroute", wid)
+        left = self.flush_pending()
+        logging.getLogger(__name__).warning(
+            "PS shard %d returned; resynced dead-set + replayed missed "
+            "decisions (%d still pending)", shard, left,
+        )
 
     def _broadcast_readmit_wid(self, wid: int) -> None:
         self._broadcast("readmit", wid)

@@ -200,7 +200,17 @@ class ParamServerService:
                             self.monitor.beat(str(wid))
                         conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_STATS:
-                        body = json.dumps(self.ps.stats()).encode()
+                        stats = self.ps.stats()
+                        if self.monitor is not None:
+                            # liveness map rides the stats op, so the
+                            # launcher/ops plane can read the master's view
+                            # of every beating node (master.h:202 ledger).
+                            # peek(), not check(): a stats request must stay
+                            # read-only — transitions (and their blocking
+                            # broadcast callbacks) belong to the monitor's
+                            # period thread, not this connection's thread
+                            stats["liveness"] = self.monitor.peek()
+                        body = json.dumps(stats).encode()
                         conn.sendall(struct.pack("<IB", len(body), 0) + body)
                     elif msg_type == MSG_UNROUTE:
                         wid = int(wire.unpack_varint(payload, 1)[0])
@@ -241,7 +251,16 @@ class ParamServerService:
 
     def close(self):
         self._stop.set()
+        # shutdown() BEFORE close(): the accept thread blocked in accept()
+        # holds the kernel's open file description, so close() alone leaves
+        # the port listening (and accepting!) until that syscall returns —
+        # shutdown wakes it with an error instead
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._listener.close()
+        self._accept_thread.join(timeout=2.0)
         # sever live connections so "closed" really stops serving, then
         # reap the per-connection threads
         for t, conn in self._peers:
@@ -272,6 +291,16 @@ class PSClient:
         one so a wedged shard raises instead of stalling heartbeats."""
         self.dim = dim
         self._sock = socket.create_connection(address, timeout=timeout)
+        if self._sock.getsockname() == self._sock.getpeername():
+            # Linux TCP self-connect: a connect() to a FREE port in the
+            # ephemeral range can be assigned that same port as its source
+            # and succeed against itself — observed when reconnecting to a
+            # dead shard's old address; the "server" would then be this
+            # client's own echo.  Treat it as the refusal it really is.
+            self._sock.close()
+            raise ConnectionRefusedError(
+                f"self-connect to {address} (no listener)"
+            )
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.bytes_sent = 0
         self.bytes_received = 0
@@ -457,29 +486,66 @@ class ShardedPSClient:
         if not addresses:
             raise ValueError("need at least one PS shard address")
         self.dim = dim
-        self.clients = [PSClient(tuple(a), dim) for a in addresses]
+        self.addresses = [tuple(a) for a in addresses]
+        self.clients = [PSClient(a, dim) for a in self.addresses]
         self.n_shards = len(self.clients)
         from .partition import make_partition
 
         self.partition = make_partition(partition, self.n_shards)
+        # shard-failure tolerance: a dead shard's client slot goes None and
+        # every data op attempts one reconnect per call (the reference
+        # worker likewise reconnects to a relaunched paramserver); counters
+        # of discarded clients accumulate here so accounting survives
+        self.reconnects = 0
+        self._base = {"bytes_sent": 0, "bytes_received": 0,
+                      "withheld_pulls": 0, "dropped_pushes": 0}
+
+    # -- shard liveness -----------------------------------------------------
+
+    def _mark_down(self, i: int) -> None:
+        c = self.clients[i]
+        if c is not None:
+            for k in self._base:
+                self._base[k] += getattr(c, k)
+            try:
+                c.close()
+            except OSError:
+                pass
+            self.clients[i] = None
+
+    def _ensure(self, i: int):
+        """Client for shard i, attempting one reconnect if it is down.
+        Returns None while the shard stays unreachable."""
+        if self.clients[i] is None:
+            try:
+                self.clients[i] = PSClient(self.addresses[i], self.dim)
+                self.reconnects += 1
+            except OSError:
+                return None
+        return self.clients[i]
 
     # -- accounting (aggregated over shards) --------------------------------
 
+    def _sum(self, attr: str) -> int:
+        return self._base[attr] + sum(
+            getattr(c, attr) for c in self.clients if c is not None
+        )
+
     @property
     def bytes_sent(self) -> int:
-        return sum(c.bytes_sent for c in self.clients)
+        return self._sum("bytes_sent")
 
     @property
     def bytes_received(self) -> int:
-        return sum(c.bytes_received for c in self.clients)
+        return self._sum("bytes_received")
 
     @property
     def withheld_pulls(self) -> int:
-        return sum(c.withheld_pulls for c in self.clients)
+        return self._sum("withheld_pulls")
 
     @property
     def dropped_pushes(self) -> int:
-        return sum(c.dropped_pushes for c in self.clients)
+        return self._sum("dropped_pushes")
 
     def _split(self, keys: np.ndarray):
         """shard id per key (partition policy: modulo or consistent-hash
@@ -536,16 +602,32 @@ class ShardedPSClient:
             np.int64,
         ))
         live = []
-        for c, part, idx in zip(self.clients, parts, order):
-            if len(part):
+        state = {"withheld": False, "failed": False}
+        for i, (part, idx) in enumerate(zip(parts, order)):
+            if not len(part):
+                continue
+            c = self._ensure(i)
+            if c is None:
+                # shard down: same retry contract as a withheld pull — the
+                # caller backs off and retries until the shard returns
+                state["failed"] = True
+                continue
+            try:
                 c._send(MSG_PULL, hdr + wire.pack_keys(part))
-                live.append((c, part, idx))
+                live.append((i, c, idx))
+            except (ConnectionError, OSError):
+                self._mark_down(i)
+                state["failed"] = True
         rows = np.empty((len(keys_arr), self.dim), np.float32)
-        state = {"withheld": False}
 
         def handle(item):
-            c, part, idx = item
-            reply = c._recv_reply()
+            i, c, idx = item
+            try:
+                reply = c._recv_reply()
+            except (ConnectionError, OSError):
+                self._mark_down(i)  # died between send and reply
+                state["failed"] = True
+                return
             if reply[:1] == b"\x01":
                 # any shard withholding means the whole pull retries — the
                 # reference worker likewise blocks until every PS replies
@@ -556,7 +638,7 @@ class ShardedPSClient:
             rows[idx] = r
 
         self._drain(live, handle)
-        if state["withheld"]:
+        if state["withheld"] or state["failed"]:
             return None
         return keys_arr, rows
 
@@ -567,18 +649,36 @@ class ShardedPSClient:
         parts, order = self._split(keys_arr)
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
         live = []
-        for c, part, idx in zip(self.clients, parts, order):
-            if len(part):
+        state = {"ok": True}
+        for i, (part, idx) in enumerate(zip(parts, order)):
+            if not len(part):
+                continue
+            c = self._ensure(i)
+            if c is None:
+                # shard down: that slice of the push is lost — the
+                # reference's async pushes are likewise lossy
+                state["ok"] = False
+                continue
+            try:
                 c._send(
                     MSG_PUSH,
                     hdr + wire.pack_keys(part)
                     + r[idx].astype(np.float16).tobytes(),
                 )
-                live.append(c)
-        state = {"ok": True}
+                live.append((i, c))
+            except (ConnectionError, OSError):
+                self._mark_down(i)
+                state["ok"] = False
 
-        def handle(c):
-            if c._recv_reply() != b"\x00":
+        def handle(item):
+            i, c = item
+            try:
+                reply = c._recv_reply()
+            except (ConnectionError, OSError):
+                self._mark_down(i)
+                state["ok"] = False
+                return
+            if reply != b"\x00":
                 c.dropped_pushes += 1
                 state["ok"] = False  # partial application is possible
                 # (per-shard ledgers — see class docstring); caller
@@ -588,22 +688,62 @@ class ShardedPSClient:
         return state["ok"]
 
     def preload_arrays(self, keys, rows) -> None:
+        """Admin op: fails LOUD (ConnectionError) when any owning shard is
+        unreachable — a silently partial preload would corrupt a restore."""
         keys_arr = np.ascontiguousarray(keys, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
         self._check_sorted(keys_arr, unique=True, op="preload_arrays")
         parts, order = self._split(keys_arr)
         live = []
-        for c, part, idx in zip(self.clients, parts, order):
-            if len(part):
-                c._send(MSG_PRELOAD,
-                        wire.pack_keys(part) + r[idx].tobytes())
-                live.append(c)
-        self._drain(live, lambda c: c._recv_reply())
+        err = None
+        for i, (part, idx) in enumerate(zip(parts, order)):
+            if not len(part):
+                continue
+            c = self._ensure(i)
+            if c is None:
+                err = err or ConnectionError(
+                    f"PS shard {i} ({self.addresses[i]}) unreachable"
+                )
+                continue
+            try:
+                c._send(MSG_PRELOAD, wire.pack_keys(part) + r[idx].tobytes())
+                live.append((i, c))
+            except (ConnectionError, OSError) as e:
+                self._mark_down(i)
+                err = err or e
+
+        def handle(item):
+            i, c = item
+            try:
+                c._recv_reply()
+            except (ConnectionError, OSError):
+                self._mark_down(i)
+                raise
+
+        try:
+            self._drain(live, handle)
+        except (RuntimeError, OSError, ValueError) as e:
+            err = err or e
+        if err is not None:
+            raise err
+
+    def snapshot_shard(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Snapshot ONE shard (the backup agent's op).  Loud on failure."""
+        c = self._ensure(i)
+        if c is None:
+            raise ConnectionError(
+                f"PS shard {i} ({self.addresses[i]}) unreachable"
+            )
+        try:
+            return c.snapshot_arrays()
+        except (ConnectionError, OSError):
+            self._mark_down(i)
+            raise
 
     def snapshot_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         keys_parts, rows_parts = [], []
-        for c in self.clients:
-            k, r = c.snapshot_arrays()
+        for i in range(self.n_shards):
+            k, r = self.snapshot_shard(i)
             keys_parts.append(k)
             rows_parts.append(r)
         keys = np.concatenate(keys_parts)
@@ -612,21 +752,44 @@ class ShardedPSClient:
         order = np.argsort(keys, kind="stable")
         return keys[order], rows[order]
 
+    def _best_effort(self, fn) -> None:
+        """Run a liveness/courtesy op against every reachable shard,
+        marking unreachable ones down instead of raising."""
+        for i in range(self.n_shards):
+            c = self._ensure(i)
+            if c is None:
+                continue
+            try:
+                fn(c)
+            except (ConnectionError, OSError, RuntimeError):
+                self._mark_down(i)
+
     def beat(self, worker_id: int) -> None:
-        for c in self.clients:
-            c.beat(worker_id)
+        self._best_effort(lambda c: c.beat(worker_id))
 
     def stats(self):
-        """Per-shard stats list (shard i = addresses[i])."""
-        return [c.stats() for c in self.clients]
+        """Per-shard stats list (shard i = addresses[i]); a down shard's
+        slot is None."""
+        out = []
+        for i in range(self.n_shards):
+            c = self._ensure(i)
+            if c is None:
+                out.append(None)
+                continue
+            try:
+                out.append(c.stats())
+            except (ConnectionError, OSError, RuntimeError):
+                self._mark_down(i)
+                out.append(None)
+        return out
 
     def farewell(self, worker_id: int) -> None:
-        for c in self.clients:
-            c.farewell(worker_id)
+        self._best_effort(lambda c: c.farewell(worker_id))
 
     def close(self) -> None:
         for c in self.clients:
-            c.close()
+            if c is not None:
+                c.close()
 
 
 def make_client(addresses, dim: int, partition: str = "modulo"):

@@ -231,8 +231,22 @@ class ShmAsyncParamServer:
         (ring_collect.h:74-79 / master.h:146-190).  Rows written here are
         never lazy-inited by workers, so every process trains from the same
         deterministic start."""
-        for k, v in values.items():
-            self._data.set(int(k), np.asarray(v, np.float32).reshape(self.dim))
+        keys = np.array(sorted(values), np.int64)
+        if not len(keys):
+            return
+        rows = np.stack([
+            np.asarray(values[int(k)], np.float32).reshape(self.dim)
+            for k in keys
+        ])
+        self.preload_batch(keys, rows)
+
+    def preload_batch(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Vectorized coordinator-side preload: rows[i] -> keys[i] in one
+        native set_batch call."""
+        self._data.set_batch(
+            np.ascontiguousarray(keys, np.int64).astype(np.uint64),
+            np.ascontiguousarray(rows, np.float32),
+        )
 
     def _lazy_init(self, key: int) -> np.ndarray:
         """First touch creates ~ N(0,1)*sqrt(1/dim) (paramserver.h:315-339)
@@ -246,28 +260,68 @@ class ShmAsyncParamServer:
             v = self._data.get(key)
         return v
 
-    def pull(
-        self, keys, worker_epoch: int, worker_id: Optional[int] = None
-    ) -> Optional[Dict[int, np.ndarray]]:
-        """key->value, or None when SSP-withheld (too far ahead of the
-        slowest routed worker) or the caller is unrouted."""
+    def _rows_create(self, keys_arr: np.ndarray) -> np.ndarray:
+        """Vectorized get + lazy init: one get_batch, one add_batch for the
+        missing keys (first-touch random init via atomic add — racing
+        initializers sum, same tolerance as the scalar path), one re-read.
+        ``keys_arr`` must be unique (callers dedupe)."""
+        ks = np.ascontiguousarray(keys_arr, np.int64).astype(np.uint64)
+        rows, found = self._data.get_batch(ks)
+        missing = ~found
+        if missing.any():
+            miss = ks[missing]
+            init = (
+                self._rng.standard_normal((len(miss), self.dim))
+                * np.sqrt(1.0 / self.dim)
+            ).astype(np.float32)
+            self._data.add_batch(miss, init)
+            rows[missing] = self._data.get_batch(miss)[0]
+        return rows
+
+    def _pull_gate(self, worker_epoch: int, worker_id: Optional[int]) -> bool:
         if worker_id is not None:
             if not self._routed(worker_id):
-                return None
+                return False
             self.advance_epoch(worker_id, worker_epoch)
         epochs, routed = self._ledger()
         if routed.any():
             slowest = float(epochs[routed].min())
             if worker_epoch - slowest > self.staleness_threshold:
                 self.withheld_pulls += 1
-                return None
-        return {int(k): self._lazy_init(int(k)).copy() for k in keys}
+                return False
+        return True
 
-    def push(
-        self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int
-    ) -> bool:
-        """Apply per-key grads with atomic float-CAS adds; False = dropped
-        (stale beyond threshold, or unrouted)."""
+    def pull_batch(
+        self,
+        keys: np.ndarray,
+        worker_epoch: int,
+        worker_id: Optional[int] = None,
+    ) -> Optional[np.ndarray]:
+        """Vectorized pull: ``[n, dim]`` fp32 rows in ``keys`` order (unique
+        keys required), or None when SSP-withheld/unrouted — the same array
+        contract as ``AsyncParamServer.pull_batch``, carried by ONE
+        get_batch/add_batch crossing instead of 2-3 C calls per key."""
+        if not self._pull_gate(worker_epoch, worker_id):
+            return None
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        if len(keys_arr) > 1 and not (np.diff(np.sort(keys_arr)) > 0).all():
+            raise ValueError("pull_batch keys must be unique")
+        return self._rows_create(keys_arr)
+
+    def pull(
+        self, keys, worker_epoch: int, worker_id: Optional[int] = None
+    ) -> Optional[Dict[int, np.ndarray]]:
+        """key->value, or None when SSP-withheld (too far ahead of the
+        slowest routed worker) or the caller is unrouted."""
+        keys_list = [int(k) for k in keys]
+        uniq = np.array(sorted(set(keys_list)), np.int64)
+        rows = self.pull_batch(uniq, worker_epoch, worker_id)
+        if rows is None:
+            return None
+        by_key = {int(k): rows[i] for i, k in enumerate(uniq)}
+        return {k: by_key[k].copy() for k in keys_list}
+
+    def _push_gate(self, worker_id: int, worker_epoch: int) -> bool:
         if not self._routed(worker_id):
             return False
         epochs, routed = self._ledger()
@@ -278,35 +332,70 @@ class ShmAsyncParamServer:
             self.dropped_pushes += 1
             return False
         self.advance_epoch(worker_id, max(worker_epoch, 0))
-        for key, g in grads.items():
-            key = int(key)
-            if key >= (1 << _WORKER_SHIFT):
-                raise ValueError(f"key {key} >= 2^{_WORKER_SHIFT} (shadow keyspace)")
-            g = np.asarray(g, np.float32).reshape(self.dim)
-            w = self._lazy_init(key)
-            if self.updater == "sgd":
-                self._data.add(key, -self.lr * g)
-            elif self.updater == "adagrad":
-                self._accum.add(key, g * g)
-                acc = self._accum.get(key)
-                self._data.add(key, -self.lr * g / np.sqrt(acc + self.eps))
-            else:
-                skey = (int(worker_id) << _WORKER_SHIFT) | key
-                shadow = self._shadow.get(skey)
-                if shadow is None:
-                    shadow = w.copy()
-                if self.updater == "dcasgd":
-                    comp = g + self.dcasgd_lambda * g * g * (w - shadow)
-                else:  # dcasgda
-                    acc = self._accum.get(key)
-                    acc = np.zeros(self.dim, np.float32) if acc is None else acc
-                    acc = self.momentum_rate * acc + (1.0 - self.momentum_rate) * g * g
-                    self._accum.set(key, acc)
-                    comp = g + (
-                        self.dcasgd_lambda * g * g * (w - shadow)
-                        / np.sqrt(acc + self.eps)
-                    )
-                self._data.add(key, -self.lr * comp)
-                new_w = self._data.get(key)
-                self._shadow.set(skey, new_w)
         return True
+
+    def push_batch(
+        self,
+        worker_id: int,
+        keys: np.ndarray,
+        grads: np.ndarray,
+        worker_epoch: int,
+    ) -> bool:
+        """Vectorized push of ``[n, dim]`` grads for UNIQUE keys; False =
+        dropped (stale beyond threshold, or unrouted).  Updater math is
+        identical to the scalar path, but each store is touched a constant
+        number of times per BATCH: sgd = one add_batch; adagrad = one fused
+        native call over (data, accum); dcasgd(a) = batched shadow/accum
+        reads + one add_batch + batched shadow write."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        if len(keys_arr) and int(keys_arr.max()) >= (1 << _WORKER_SHIFT):
+            raise ValueError(
+                f"key {int(keys_arr.max())} >= 2^{_WORKER_SHIFT} "
+                "(shadow keyspace)"
+            )
+        if len(keys_arr) > 1 and not (np.diff(np.sort(keys_arr)) > 0).all():
+            raise ValueError("push_batch keys must be unique")
+        if not self._push_gate(worker_id, worker_epoch):
+            return False
+        if not len(keys_arr):
+            return True
+        g = np.ascontiguousarray(grads, np.float32).reshape(-1, self.dim)
+        ks = keys_arr.astype(np.uint64)
+        # first-touch init BEFORE the update, as the scalar path does
+        w = self._rows_create(keys_arr)
+        if self.updater == "sgd":
+            self._data.add_batch(ks, -self.lr * g)
+        elif self.updater == "adagrad":
+            self._data.adagrad_batch(self._accum, ks, g, self.lr, self.eps)
+        else:
+            skeys = (np.uint64(worker_id) << np.uint64(_WORKER_SHIFT)) | ks
+            shadow, sfound = self._shadow.get_batch(skeys)
+            shadow[~sfound] = w[~sfound]
+            if self.updater == "dcasgd":
+                comp = g + self.dcasgd_lambda * g * g * (w - shadow)
+            else:  # dcasgda
+                acc = self._accum.get_batch(ks)[0]  # zeros when missing
+                acc = (
+                    self.momentum_rate * acc
+                    + (1.0 - self.momentum_rate) * g * g
+                )
+                self._accum.set_batch(ks, acc)
+                comp = g + (
+                    self.dcasgd_lambda * g * g * (w - shadow)
+                    / np.sqrt(acc + self.eps)
+                )
+            self._data.add_batch(ks, -self.lr * comp)
+            self._shadow.set_batch(skeys, self._data.get_batch(ks)[0])
+        return True
+
+    def push(
+        self, worker_id: int, grads: Dict[int, np.ndarray], worker_epoch: int
+    ) -> bool:
+        """Apply per-key grads with atomic float-CAS adds; False = dropped
+        (stale beyond threshold, or unrouted)."""
+        keys = np.array(sorted(grads), np.int64)
+        rows = np.stack([
+            np.asarray(grads[int(k)], np.float32).reshape(self.dim)
+            for k in keys
+        ]) if len(keys) else np.zeros((0, self.dim), np.float32)
+        return self.push_batch(worker_id, keys, rows, worker_epoch)

@@ -116,6 +116,19 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint8),
     ]
+    for name in ("shmkv_set_batch", "shmkv_add_batch"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+    lib.shmkv_adagrad_batch.restype = ctypes.c_int
+    lib.shmkv_adagrad_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_long, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_float, ctypes.c_float,
+    ]
     lib.shmkv_sync.restype = ctypes.c_int
     lib.shmkv_sync.argtypes = [ctypes.c_void_p]
     lib.shmkv_close.restype = None
@@ -349,6 +362,44 @@ class ShmKV:
             len(ks), _fptr(out), found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         )
         return out, found.astype(bool)
+
+    def _batch_args(self, keys: np.ndarray, rows: np.ndarray, what: str):
+        ks = np.ascontiguousarray(keys, np.uint64)
+        if len(ks) and int(ks.max()) >= self._SENTINEL:
+            raise ValueError(f"key {int(ks.max())} out of range [0, 2^64-1)")
+        r = np.ascontiguousarray(rows, np.float32)
+        if r.shape != (len(ks), self.dim):
+            raise ValueError(
+                f"{what} shape {r.shape} != ({len(ks)}, {self.dim})"
+            )
+        return ks, ks.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), r
+
+    def set_batch(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """rows[i] -> keys[i] in one native call (insert if absent)."""
+        ks, kp, r = self._batch_args(keys, rows, "rows")
+        if lib().shmkv_set_batch(self._handle, kp, len(ks), _fptr(r)) == -2:
+            raise RuntimeError("store full")
+
+    def add_batch(self, keys: np.ndarray, deltas: np.ndarray) -> None:
+        """Atomic float-CAS adds of deltas[i] into keys[i], one native call
+        for the whole batch (the shm push hot path)."""
+        ks, kp, r = self._batch_args(keys, deltas, "deltas")
+        if lib().shmkv_add_batch(self._handle, kp, len(ks), _fptr(r)) == -2:
+            raise RuntimeError("store full")
+
+    def adagrad_batch(self, accum: "ShmKV", keys: np.ndarray,
+                      grads: np.ndarray, lr: float, eps: float) -> None:
+        """Fused sparse-Adagrad over (self=data, accum) stores — see
+        shmkv_adagrad_batch in shm_kv.cpp."""
+        ks, kp, g = self._batch_args(keys, grads, "grads")
+        rc = lib().shmkv_adagrad_batch(
+            self._handle, accum._handle, kp, len(ks), _fptr(g),
+            float(lr), float(eps),
+        )
+        if rc == -2:
+            raise RuntimeError("store full")
+        if rc == -4:
+            raise ValueError("data/accum dim mismatch")
 
     def sync(self) -> None:
         lib().shmkv_sync(self._handle)

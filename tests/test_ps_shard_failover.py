@@ -1,0 +1,195 @@
+"""PS-shard failure handling, miniature of the composed cluster drill:
+shard dies -> client degrades (retry semantics) -> master's liveness ledger
+notices -> relaunch on the same address + snapshot restore -> client
+reconnects and parity holds.
+
+Reference: the master monitors every registered node (master.h:202-262);
+PS disk backup is the reference's acknowledged gap (paramserver.h:309) —
+the snapshot/restore composition here exceeds it.
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.dist.master import SHARD_ID_BASE, MasterService
+from lightctr_tpu.dist.ps_server import (
+    ParamServerService,
+    PSClient,
+    ShardedPSClient,
+)
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+DIM = 5
+
+
+def _mk_store(seed):
+    return AsyncParamServer(dim=DIM, updater="adagrad", learning_rate=0.1,
+                            n_workers=2, seed=seed)
+
+
+def test_shard_death_restore_reconnect(rng):
+    svcs = [ParamServerService(_mk_store(s)) for s in (0, 1)]
+    client = ShardedPSClient([s.address for s in svcs], DIM,
+                             partition="ring")
+    try:
+        keys = np.arange(200, dtype=np.int64)
+        rows = rng.normal(size=(200, DIM)).astype(np.float32)
+        client.preload_arrays(keys, rows)
+
+        # ops-plane backup of shard 0 (the launcher's backup agent op)
+        bkeys, brows = client.snapshot_shard(0)
+        assert len(bkeys) > 0
+
+        # train one step so post-restore state is distinguishable from init
+        g = np.full((200, DIM), 0.25, np.float32)
+        g16 = g.astype(np.float16).astype(np.float32)
+        assert client.push_arrays(0, keys, g16, worker_epoch=0)
+        bkeys, brows = client.snapshot_shard(0)  # newest backup
+        s1_before = client.clients[1].snapshot_arrays()
+
+        # SIGKILL equivalent: the service vanishes mid-run
+        host, port = svcs[0].address
+        svcs[0].close()
+
+        # degraded mode: pulls say retry (None), pushes are lossy — the
+        # reachable shard's slice still applies (partial application, the
+        # reference's async-push semantics) while the call reports False
+        assert client.pull_arrays(keys, worker_epoch=1, worker_id=0) is None
+        assert client.push_arrays(0, keys, g16, worker_epoch=1) is False
+        assert client.clients[0] is None  # marked down, not raised
+
+        # relaunch on the SAME address, restore from the backup
+        svcs[0] = ParamServerService(_mk_store(7), host=host, port=port)
+        client.preload_arrays(bkeys, brows)  # routes only to shard 0
+        assert client.reconnects >= 1
+
+        # shard 0 == its backup exactly (fp32 preload); shard 1 advanced
+        # one extra step during the outage (lossy-push partial application)
+        k0, r0 = client.snapshot_shard(0)
+        np.testing.assert_array_equal(k0, bkeys)
+        np.testing.assert_array_equal(r0, brows)
+        k1, r1 = client.clients[1].snapshot_arrays()
+        np.testing.assert_array_equal(k1, s1_before[0])
+        assert np.abs(r1 - s1_before[1]).max() > 1e-3
+
+        # the healed cluster serves and trains end-to-end again
+        out = client.pull_arrays(keys, worker_epoch=1, worker_id=0)
+        assert out is not None and len(out[0]) == len(keys)
+        assert client.push_arrays(0, keys, g16, worker_epoch=2)
+        client.close()
+    finally:
+        for s in svcs:
+            s.close()
+
+
+def test_master_detects_shard_death_and_recovery():
+    """Shards heartbeat to the master under SHARD_ID_BASE ids; silence
+    flips the liveness ledger to dead (visible over the STATS wire), a
+    returning beat flips it back and auto-replays missed decisions."""
+    svc = ParamServerService(_mk_store(0))
+    master = MasterService([svc.address], stale_after_s=0.2,
+                           dead_after_s=0.4, period_s=0.05)
+    admin = None
+    try:
+        admin = PSClient(tuple(master.address), 1)
+        sid = SHARD_ID_BASE + 0
+        admin.beat(sid)
+
+        def liveness():
+            return admin.stats().get("liveness", {}).get(str(sid))
+
+        assert liveness() == "alive"
+        deadline = time.time() + 5.0
+        while liveness() != "dead":
+            assert time.time() < deadline, "master never declared shard dead"
+            time.sleep(0.05)
+
+        # while the shard is "dead", a worker decision queues for replay
+        master._broadcast("unroute", 1)
+
+        admin.beat(sid)  # shard returns -> recover event -> flush_pending
+        deadline = time.time() + 5.0
+        while liveness() != "alive":
+            assert time.time() < deadline, "master never saw the shard back"
+            time.sleep(0.05)
+        deadline = time.time() + 5.0
+        while master.flush_pending() != 0:
+            assert time.time() < deadline, "missed decisions never replayed"
+            time.sleep(0.05)
+        assert svc.ps._unrouted == {1}
+    finally:
+        if admin is not None:
+            admin.close()
+        master.close()
+        svc.close()
+
+
+def test_fresh_relaunched_shard_gets_dead_set_resync():
+    """Routing decisions delivered to a shard's PREVIOUS incarnation die
+    with that process; on the replacement's first beat the master must
+    push its entire current dead-set, not just queued decisions —
+    otherwise a fenced-out zombie worker's pushes land on the fresh shard
+    only (silent per-shard routing divergence)."""
+    svc = ParamServerService(_mk_store(0))
+    host, port = svc.address
+    master = MasterService([(host, port)], stale_after_s=0.2,
+                           dead_after_s=0.4, period_s=0.05)
+    admin = None
+    try:
+        admin = PSClient(tuple(master.address), 1)
+        sid = SHARD_ID_BASE + 0
+        admin.beat(sid)
+        admin.beat(3)  # worker 3 exists...
+        deadline = time.time() + 5.0
+        while svc.ps._unrouted != {3}:  # ...then goes silent -> unrouted
+            assert time.time() < deadline, "worker 3 never unrouted"
+            time.sleep(0.05)
+            admin.beat(sid)  # keep the shard alive meanwhile
+
+        # shard dies (process gone: decisions delivered to it are lost)
+        svc.close()
+        deadline = time.time() + 5.0
+        while admin.stats()["liveness"].get(str(sid)) != "dead":
+            assert time.time() < deadline, "shard never declared dead"
+            time.sleep(0.05)
+
+        # FRESH incarnation on the same address: empty unrouted set
+        svc2 = ParamServerService(_mk_store(9), host=host, port=port)
+        try:
+            assert svc2.ps._unrouted == set()
+            admin.beat(sid)  # first beat -> recover -> dead-set resync
+            deadline = time.time() + 5.0
+            while svc2.ps._unrouted != {3}:
+                assert time.time() < deadline, "dead-set never resynced"
+                time.sleep(0.05)
+                admin.beat(sid)
+        finally:
+            svc2.close()
+    finally:
+        if admin is not None:
+            admin.close()
+        master.close()
+        svc.close()
+
+
+def test_sharded_client_down_shard_stats_and_accounting(rng):
+    """stats() marks a down shard None instead of raising; byte counters
+    survive the client-slot teardown."""
+    svcs = [ParamServerService(_mk_store(s)) for s in (0, 1)]
+    client = ShardedPSClient([s.address for s in svcs], DIM)
+    try:
+        keys = np.arange(50, dtype=np.int64)
+        client.preload_arrays(keys, np.ones((50, DIM), np.float32))
+        sent_before = client.bytes_sent
+        assert sent_before > 0
+        svcs[1].close()
+        st = client.stats()
+        assert st[0] is not None and st[1] is None
+        assert client.bytes_sent >= sent_before  # accumulated, not lost
+        client.close()
+    finally:
+        for s in svcs:
+            s.close()

@@ -81,10 +81,20 @@ def resolve_data(data_arg, workdir):
 # PS process
 
 
-def _shard_proc(conn, dim, n_workers, updater, lr, staleness, seed,
-                stop_evt):
+def _shard_proc(conn, shard_index, dim, n_workers, updater, lr, staleness,
+                seed, port=0):
     """One PS shard process (the reference's paramserver binary): serves
-    keys and OBEYS routing — the master decides (network.h:148-151)."""
+    keys and OBEYS routing — the master decides (network.h:148-151).
+    Beats to the master (id ``SHARD_ID_BASE + shard_index``) once the
+    launcher sends the master address over the pipe; a relaunched shard
+    binds its predecessor's ``port`` so worker clients reconnect to the
+    address they already hold.
+
+    Shutdown rides the per-process PIPE (any message or launcher-side
+    close), NOT a shared mp.Event: this role gets SIGKILLed mid-run by the
+    failure drill, and a kill landing inside Event.wait()'s lock window
+    would poison the shared semaphore for every later set()."""
+    from lightctr_tpu.dist.master import SHARD_ID_BASE
     from lightctr_tpu.dist.ps_server import ParamServerService
     from lightctr_tpu.embed.async_ps import AsyncParamServer
 
@@ -92,15 +102,34 @@ def _shard_proc(conn, dim, n_workers, updater, lr, staleness, seed,
         dim=dim, updater=updater, learning_rate=lr, n_workers=n_workers,
         staleness_threshold=staleness, seed=seed,
     )
-    svc = ParamServerService(ps)
+    svc = ParamServerService(ps, port=port)
     conn.send(svc.address)
-    stop_evt.wait()
+    try:
+        msg = conn.recv()  # master address, once the master is up
+    except EOFError:
+        msg = "stop"
+    if msg == "stop":  # startup aborted before the master came up
+        svc.close()
+        return
+    stop_beat = threading.Event()
+    beat_t = threading.Thread(
+        target=_beat_loop,
+        args=(tuple(msg), SHARD_ID_BASE + shard_index, stop_beat),
+        daemon=True,
+    )
+    beat_t.start()
+    try:
+        conn.recv()  # blocks until the launcher says stop (or dies: EOF)
+    except EOFError:
+        pass
+    stop_beat.set()
     svc.close()
 
 
-def _master_proc(conn, shard_addresses, stop_evt):
+def _master_proc(conn, shard_addresses):
     """The master role (master.h:146-262): owns the heartbeat monitor,
-    broadcasts unroute/readmit decisions to every shard."""
+    broadcasts unroute/readmit decisions to every shard.  Pipe-based stop,
+    same rationale as _shard_proc."""
     from lightctr_tpu.dist.master import MasterService
 
     m = MasterService(
@@ -109,7 +138,10 @@ def _master_proc(conn, shard_addresses, stop_evt):
         period_s=BEAT_PERIOD_S,
     )
     conn.send(m.address)
-    stop_evt.wait()
+    try:
+        conn.recv()
+    except EOFError:
+        pass
     m.close()
 
 
@@ -119,16 +151,26 @@ def _master_proc(conn, shard_addresses, stop_evt):
 
 def _beat_loop(address, worker_id, stop):
     """Heartbeat thread: its OWN connection (PSClient is not thread-safe),
-    so a long pull can never starve liveness."""
+    so a long pull can never starve liveness.  Reconnects on failure — a
+    single transient beat error must not silence liveness forever (for a
+    shard that would read as a death and trigger a destructive
+    relaunch+restore of a healthy store)."""
     from lightctr_tpu.dist.ps_server import PSClient
 
-    client = PSClient(address, 1)
-    try:
-        while not stop.wait(BEAT_PERIOD_S):
+    client = None
+    while not stop.wait(BEAT_PERIOD_S):
+        try:
+            if client is None:
+                client = PSClient(address, 1)
             client.beat(worker_id)
-    except (ConnectionError, OSError, RuntimeError):
-        pass
-    finally:
+        except (ConnectionError, OSError, RuntimeError):
+            if client is not None:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                client = None
+    if client is not None:
         try:
             client.close()
         except OSError:
@@ -158,7 +200,8 @@ def _cluster_worker(worker_id, n_workers, shard_addresses, master_address,
     field_cnt = meta["field_cnt"]
     max_nnz = meta["max_nnz"]
 
-    ps = make_client(shard_addresses, row_dim)
+    ps = make_client(shard_addresses, row_dim,
+                     partition=cfg.get("partition", "modulo"))
     stop_beat = threading.Event()
     beat_t = threading.Thread(
         target=_beat_loop, args=(master_address, worker_id, stop_beat),
@@ -267,10 +310,17 @@ def _cluster_worker(worker_id, n_workers, shard_addresses, master_address,
 
 def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
         lr=0.1, updater="adagrad", staleness=10, seed=0, workdir=None,
-        kill_worker=1, throttle=None, ps_shards=1,
+        kill_worker=1, throttle=None, ps_shards=1, kill_shard=None,
+        partition="modulo", snapshot_period_s=0.5,
         out="CLUSTER_CONVERGENCE.json"):
     """throttle: optional {worker_id: seconds-per-batch} skew injection.
-    ps_shards: number of PS shard processes (key % n partition)."""
+    ps_shards: number of PS shard processes; partition: key->shard policy
+    ("modulo" | consistent-hash "ring").  kill_shard: SIGKILL that PS
+    shard mid-run — master detects via shard heartbeats, the launcher
+    relaunches it on the same port and restores the backup agent's latest
+    snapshot (the reference's PS has NO disk backup, paramserver.h:309;
+    this composes the failover path that exceeds it), worker clients
+    reconnect and the cluster converges."""
     import tempfile
 
     import jax
@@ -309,6 +359,7 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
     cfg = {
         "factor_dim": D, "batch_size": batch_size, "epochs": epochs,
         "lr": lr, "updater": updater, "staleness": staleness, "seed": seed,
+        "partition": partition,
         "dense_template": [(k, list(v)) for k, v in template.items()],
     }
 
@@ -316,38 +367,61 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
     events = []
 
     def mark(kind, **kw):
-        events.append({"t": round(time.time() - t0, 2), "event": kind, **kw})
+        ev = {"t": round(time.time() - t0, 2), "event": kind, **kw}
+        events.append(ev)
+        print(f"[cluster] {ev}", file=sys.stderr, flush=True)
 
     # -- 1. the three-role control/data plane: N PS shard processes, then
-    # one MASTER process owning the heartbeat monitor (master.h topology)
-    stop_evt = ctx.Event()
+    # one MASTER process owning the heartbeat monitor (master.h topology).
+    # Role shutdown is per-process pipes (see _shard_proc docstring).
     t0 = time.time()
     role_procs, addresses = [], []
+    shard_procs, shard_pipes = {}, {}
+    master_pipe = None
+
+    def spawn_shard(s, port=0):
+        parent_conn, child_conn = ctx.Pipe()
+        p = ctx.Process(
+            target=_shard_proc,
+            args=(child_conn, s, row_dim, n_workers, updater, lr,
+                  staleness, seed + s, port),
+        )
+        p.start()
+        if not parent_conn.poll(60):
+            raise RuntimeError("PS shard failed to start within 60s")
+        addr = list(parent_conn.recv())
+        shard_procs[s] = p
+        shard_pipes[s] = parent_conn
+        return addr
+
+    def stop_roles():
+        for conn in [master_pipe, *shard_pipes.values()]:
+            if conn is None:
+                continue
+            try:
+                conn.send("stop")
+            except (OSError, BrokenPipeError):
+                pass  # already dead (e.g. the drill's victim)
+
     try:
         for s in range(ps_shards):
-            parent_conn, child_conn = ctx.Pipe()
-            p = ctx.Process(
-                target=_shard_proc,
-                args=(child_conn, row_dim, n_workers, updater, lr,
-                      staleness, seed + s, stop_evt),
-            )
-            p.start()
-            role_procs.append(p)
-            if not parent_conn.poll(60):
-                raise RuntimeError("PS shard failed to start within 60s")
-            addresses.append(list(parent_conn.recv()))
+            addresses.append(spawn_shard(s))
         parent_conn, child_conn = ctx.Pipe()
         master_proc = ctx.Process(
-            target=_master_proc, args=(child_conn, addresses, stop_evt)
+            target=_master_proc, args=(child_conn, addresses)
         )
         master_proc.start()
         role_procs.append(master_proc)
         if not parent_conn.poll(60):
             raise RuntimeError("master failed to start within 60s")
         master_address = list(parent_conn.recv())
+        master_pipe = parent_conn
+        # shards learn the master address and start beating to it
+        for s in range(ps_shards):
+            shard_pipes[s].send(master_address)
     except Exception:
-        stop_evt.set()
-        for p in role_procs:
+        stop_roles()
+        for p in [*role_procs, *shard_procs.values()]:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
@@ -387,25 +461,59 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
             time.sleep(sleep_s)
 
     def agg_stats():
-        """Aggregate shard stats (single shard -> dict; sharded -> list)."""
+        """Aggregate shard stats (single shard -> dict; sharded -> list).
+        A down shard's slot is None — aggregate over the survivors."""
         s = admin.stats()
         if isinstance(s, dict):
             return s
+        live = [x for x in s if x is not None]
+        if not live:
+            raise ConnectionError("no PS shard reachable")
         return {
-            "last_epoch_version": max(x["last_epoch_version"] for x in s),
-            "staleness": max(x["staleness"] for x in s),
-            "unrouted": sorted({w for x in s for w in x["unrouted"]}),
-            "withheld_pulls": sum(x["withheld_pulls"] for x in s),
-            "dropped_pushes": sum(x["dropped_pushes"] for x in s),
-            "rejected_pulls": sum(x["rejected_pulls"] for x in s),
-            "rejected_pushes": sum(x["rejected_pushes"] for x in s),
-            "n_keys": sum(x["n_keys"] for x in s),
+            "last_epoch_version": max(x["last_epoch_version"] for x in live),
+            "staleness": max(x["staleness"] for x in live),
+            "unrouted": sorted({w for x in live for w in x["unrouted"]}),
+            "withheld_pulls": sum(x["withheld_pulls"] for x in live),
+            "dropped_pushes": sum(x["dropped_pushes"] for x in live),
+            "rejected_pulls": sum(x["rejected_pulls"] for x in live),
+            "rejected_pushes": sum(x["rejected_pushes"] for x in live),
+            "n_keys": sum(x["n_keys"] for x in live),
+            "down_shards": [i for i, x in enumerate(s) if x is None],
             "per_shard": s,
         }
 
+    _liveness_client = {"c": None}
+
+    def master_liveness():
+        """The master's view of every beating node (STATS liveness map).
+        One persistent admin connection, reconnected on failure — the
+        drill's 10Hz polls must not churn a connection per call."""
+        from lightctr_tpu.dist.ps_server import PSClient
+
+        try:
+            if _liveness_client["c"] is None:
+                _liveness_client["c"] = PSClient(tuple(master_address), 1)
+            return _liveness_client["c"].stats().get("liveness", {})
+        except (ConnectionError, OSError, RuntimeError):
+            if _liveness_client["c"] is not None:
+                try:
+                    _liveness_client["c"].close()
+                except OSError:
+                    pass
+                _liveness_client["c"] = None
+            return {}  # poll loops retry
+
+    def shard_status(s):
+        from lightctr_tpu.dist.master import SHARD_ID_BASE
+
+        return master_liveness().get(str(SHARD_ID_BASE + s))
+
     report_fail = None
+    backup_stop = threading.Event()
+    backup_thread = None
+    backups = {}  # shard -> {"keys", "rows", "t"} latest good snapshot
     try:
-        admin = make_client(addresses, row_dim)
+        admin = make_client(addresses, row_dim, partition=partition)
         # master syncInitializer: deterministic start for every worker
         w0 = np.asarray(params0["w"])
         e0 = np.asarray(params0["embed"])
@@ -414,6 +522,28 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
         chunks = _dense_chunks(dense_vec, row_dim)
         ck = np.array(sorted(chunks), np.int64)
         admin.preload_arrays(ck, np.stack([chunks[int(k)] for k in ck]))
+
+        if kill_shard is not None:
+            # -- backup agent: the ops-plane loop that gives the PS the
+            # disk-backup story the reference lacks (paramserver.h:309's
+            # TODO): periodically SNAPSHOT every shard over the admin op;
+            # the latest good copy seeds a relaunched shard's restore.
+            backup_client = make_client(addresses, row_dim,
+                                        partition=partition)
+
+            def backup_loop():
+                while not backup_stop.wait(snapshot_period_s):
+                    for s in range(ps_shards):
+                        try:
+                            k, r = backup_client.snapshot_shard(s)
+                            backups[s] = {"keys": k, "rows": r,
+                                          "t": time.time()}
+                        except (ConnectionError, OSError, RuntimeError):
+                            pass  # shard down: keep the last good copy
+                backup_client.close()
+
+            backup_thread = threading.Thread(target=backup_loop, daemon=True)
+            backup_thread.start()
 
         procs.update({w: spawn_worker(w) for w in range(n_workers)})
         mark("workers_up", n=n_workers)
@@ -425,7 +555,8 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
             wait_until(
                 lambda: agg_stats()["last_epoch_version"] >= target_epoch,
                 f"epoch ledger to reach {target_epoch}",
-                watch=[*role_procs, *procs.values()], sleep_s=0.2,
+                watch=[*role_procs, *shard_procs.values(), *procs.values()],
+                sleep_s=0.2,
             )
             victim = procs[kill_worker]
             os.kill(victim.pid, signal.SIGKILL)
@@ -435,7 +566,7 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
             wait_until(
                 lambda: kill_worker in agg_stats()["unrouted"],
                 f"heartbeat to unroute worker {kill_worker}",
-                watch=role_procs,
+                watch=[*role_procs, *shard_procs.values()],
             )
             s = agg_stats()
             mark("unrouted_observed", worker=kill_worker,
@@ -455,6 +586,71 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 watch=[*role_procs, procs[kill_worker]],
             )
             mark("readmitted_observed", worker=kill_worker)
+
+        if kill_shard is not None:
+            # -- 3b. PS-SHARD failure drill: kill a shard, master detects
+            # via shard heartbeats, relaunch on the same port, restore the
+            # backup agent's latest snapshot, workers reconnect and resume.
+            # (The reference master monitors every registered node incl.
+            # PS, master.h:202-262; PS disk backup is its acknowledged gap,
+            # paramserver.h:309 — this composes the path that closes it.)
+            survivors = [p for s, p in shard_procs.items() if s != kill_shard]
+            shard_kill_epoch = min(
+                max(agg_stats()["last_epoch_version"] + 2, epochs // 2),
+                epochs - 5,
+            )
+            wait_until(
+                lambda: agg_stats()["last_epoch_version"]
+                >= shard_kill_epoch,
+                f"epoch ledger to reach {shard_kill_epoch} (shard drill)",
+                watch=[*role_procs, *shard_procs.values(), *procs.values()],
+                sleep_s=0.2,
+            )
+            wait_until(
+                lambda: kill_shard in backups,
+                "backup agent to capture the victim shard",
+                watch=[*role_procs, *shard_procs.values()],
+            )
+            victim = shard_procs[kill_shard]
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.join()
+            mark("ps_killed", shard=kill_shard,
+                 address=addresses[kill_shard])
+
+            wait_until(
+                lambda: shard_status(kill_shard) == "dead",
+                f"master to declare shard {kill_shard} dead",
+                watch=[*role_procs, *survivors],
+            )
+            mark("ps_dead_detected", shard=kill_shard,
+                 liveness=master_liveness())
+
+            # relaunch on the SAME port (worker clients reconnect to the
+            # address they already hold), then restore the newest backup
+            addr = spawn_shard(kill_shard, port=addresses[kill_shard][1])
+            assert tuple(addr) == tuple(addresses[kill_shard])
+            shard_pipes[kill_shard].send(master_address)
+            snap = backups[kill_shard]
+            for attempt in range(5):
+                try:
+                    admin.preload_arrays(snap["keys"], snap["rows"])
+                    break
+                except (ConnectionError, OSError):
+                    # first attempt may ride the pre-kill broken socket;
+                    # _ensure reconnects on the next one
+                    if attempt == 4:
+                        raise
+                    time.sleep(0.2)
+            mark("ps_restored", shard=kill_shard,
+                 restored_keys=int(len(snap["keys"])),
+                 backup_age_s=round(time.time() - snap["t"], 2))
+
+            wait_until(
+                lambda: shard_status(kill_shard) == "alive",
+                f"master to see shard {kill_shard} return",
+                watch=[*role_procs, *shard_procs.values(), *procs.values()],
+            )
+            mark("ps_recovered_observed", shard=kill_shard)
 
         for w, p in procs.items():
             p.join()
@@ -528,6 +724,9 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 "data": data_path, "rows": int(len(payload["labels"])),
                 "feature_cnt": int(feature_cnt),
                 "killed_worker": kill_worker,
+                "killed_shard": kill_shard,
+                "partition": partition,
+                "snapshot_period_s": snapshot_period_s,
                 "ps_shards": ps_shards,
                 "throttle": {str(k): v for k, v in throttle.items()},
                 "heartbeat": {"period_s": BEAT_PERIOD_S,
@@ -548,10 +747,18 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
                 json.dump(report, f, indent=1)
         return report
     finally:
+        backup_stop.set()
+        if backup_thread is not None:
+            backup_thread.join(timeout=5)
+        if _liveness_client["c"] is not None:
+            try:
+                _liveness_client["c"].close()
+            except OSError:
+                pass
         if admin is not None:
             admin.close()
-        stop_evt.set()
-        for p in role_procs:
+        stop_roles()
+        for p in [*role_procs, *shard_procs.values()]:
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
@@ -576,6 +783,12 @@ def main():
     ap.add_argument("--staleness", type=int, default=10)
     ap.add_argument("--kill-worker", type=int, default=1)
     ap.add_argument("--ps-shards", type=int, default=1)
+    ap.add_argument("--kill-shard", type=int, default=None,
+                    help="SIGKILL this PS shard mid-run; master detects, "
+                    "launcher relaunches + restores latest snapshot")
+    ap.add_argument("--partition", default="modulo",
+                    choices=("modulo", "ring"),
+                    help="key->shard routing policy (dist/partition.py)")
     ap.add_argument("--no-kill", action="store_true")
     ap.add_argument("--out", default="CLUSTER_CONVERGENCE.json")
     args = ap.parse_args()
@@ -585,7 +798,8 @@ def main():
         batch_size=args.batch_size, factor_dim=args.factor_dim, lr=args.lr,
         updater=args.updater, staleness=args.staleness,
         kill_worker=None if args.no_kill else args.kill_worker,
-        ps_shards=args.ps_shards, out=args.out,
+        ps_shards=args.ps_shards, kill_shard=args.kill_shard,
+        partition=args.partition, out=args.out,
     )
     print(json.dumps({
         "timeline": report["timeline"],

@@ -111,16 +111,23 @@ def _pull_retry(ps, keys, epoch, worker_id=None, max_wait_s: float = 30.0):
 def _pull_rows_retry(ps, keys_sorted, epoch, worker_id=None,
                      max_wait_s: float = 30.0):
     """Array-form pull with SSP retry -> [n, dim] rows in ``keys_sorted``
-    order.  Rides the vectorized wire path when the PS offers one
-    (PSClient.pull_arrays); the shm PS keeps its dict protocol."""
+    order.  Rides the vectorized path of whichever PS it's given:
+    PSClient/ShardedPSClient.pull_arrays (wire) or
+    ShmAsyncParamServer.pull_batch (one native get/add crossing)."""
     t0 = time.time()
     use_arrays = hasattr(ps, "pull_arrays")
+    use_batch = hasattr(ps, "pull_batch")
     while True:
         if use_arrays:
             out = ps.pull_arrays(keys_sorted, worker_epoch=epoch,
                                  worker_id=worker_id)
             if out is not None:
                 return out[1]
+        elif use_batch:
+            rows = ps.pull_batch(keys_sorted, worker_epoch=epoch,
+                                 worker_id=worker_id)
+            if rows is not None:
+                return rows
         else:
             d = ps.pull(keys_sorted.tolist(), worker_epoch=epoch,
                         worker_id=worker_id)
@@ -135,6 +142,8 @@ def _push_rows(ps, worker_id, keys_sorted, rows, epoch) -> bool:
     """Array-form push of rows[i] -> keys_sorted[i]."""
     if hasattr(ps, "push_arrays"):
         return ps.push_arrays(worker_id, keys_sorted, rows, worker_epoch=epoch)
+    if hasattr(ps, "push_batch"):
+        return ps.push_batch(worker_id, keys_sorted, rows, worker_epoch=epoch)
     return ps.push(
         worker_id,
         {int(k): rows[i] for i, k in enumerate(keys_sorted)},
