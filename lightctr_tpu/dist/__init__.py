@@ -1,5 +1,6 @@
 from lightctr_tpu.dist.collectives import (
     all_to_all_exchange,
+    ef_residual_init,
     ring_all_reduce,
     ring_broadcast,
     psum_all_reduce,
@@ -8,6 +9,7 @@ from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, initialize_multihost
 
 __all__ = [
     "all_to_all_exchange",
+    "ef_residual_init",
     "ring_all_reduce",
     "ring_broadcast",
     "psum_all_reduce",

@@ -40,10 +40,23 @@ def _ring_all_reduce_local(
     n: int,
     average: bool,
     compress_bits: int | None = None,
-    compress_range: float = 1.0,
-) -> jax.Array:
+    compress_range: float | str = 1.0,
+    residual: jax.Array | None = None,
+    compress_mode: str = "uniform",
+):
     """Runs per-device under shard_map.  ``flat`` is this device's full-length
-    gradient vector, pre-padded to a multiple of n."""
+    gradient vector, pre-padded to a multiple of n.
+
+    ``residual``: optional same-shape error-feedback carry (EF-SGD).  Every
+    value this member ENCODES during the exchange is first compensated with
+    the residual of the step before, and the fresh quantization error is
+    returned for the caller to carry into the next step — the bias of the
+    codec becomes a delayed contribution instead of a loss.  Each segment
+    slot is encoded exactly once per call (reduce phase sends slots
+    idx, idx-1, ..., idx-(n-2); the gather phase encodes the remaining
+    own=(idx+1)%n slot), so one [n, seg] buffer carries the whole state.
+    Returns ``(reduced, new_residual)`` when a residual is given, else just
+    ``reduced``."""
     idx = jax.lax.axis_index(axis_name)
     perm = _ring_perm(n)
     segs = flat.reshape(n, -1)
@@ -51,15 +64,36 @@ def _ring_all_reduce_local(
     if compress_bits is not None:
         from lightctr_tpu.ops import quantize
 
+        if compress_range == "dynamic":
+            # ring-global gradient magnitude: ONE fp32 scalar pmax per call
+            # (negligible next to the coded segments).  The codec's
+            # resolution then TRACKS the gradient scale as training
+            # converges — a fixed range turns late-training small gradients
+            # into pure bucket noise, which is exactly what dragged the
+            # int8 ring's accuracy (the reference rebuilds its
+            # QuantileCompress tables from the data it ships,
+            # quantile_compress.h:71-107; this is that policy as one
+            # collective).  1.05 headroom keeps exact-max values (plus an
+            # EF residual of at most half a bucket) off the clip boundary.
+            rng = 1.05 * jax.lax.pmax(jnp.max(jnp.abs(segs)), axis_name)
+            rng = jnp.maximum(rng, 1e-12)
+            if not average:
+                rng = rng * n  # partial SUMS must fit, not partial means
+        else:
+            rng = compress_range
         table = quantize.build_table(
-            -compress_range, compress_range, bits=compress_bits, mode="uniform"
+            -rng, rng, bits=compress_bits, mode=compress_mode,
         )
+        use_ef = residual is not None
+        res = (residual.reshape(n, -1) if use_ef
+               else jnp.zeros_like(segs))
 
         if average:
             # pre-divide by n so every partial sum in the reduce phase is a
             # partial MEAN, bounded by max|g| — otherwise mid-ring sums grow
             # toward n*max|g| and saturate the table (systematic clipping,
             # not noise).  The final /n below is skipped in this mode.
+            # The residual lives in this same /n domain across steps.
             segs = segs / n
 
         # The hop payload is the uint8/uint16 CODES — decode happens on the
@@ -68,13 +102,26 @@ def _ring_all_reduce_local(
         # ships (ring_collect.h + buffer.h:140-149).  extract(compress(x)) is
         # deterministic, so decoding receiver-side is bit-identical to the
         # sender's own decoded view.
-        def rs_step(i, segs):
+        def rs_step(i, carry):
+            segs, res = carry
             send_idx = (idx - i) % n
-            codes = quantize.compress(table, jnp.take(segs, send_idx, axis=0))
+            val = jnp.take(segs, send_idx, axis=0)
+            if use_ef:
+                val = val + jnp.take(res, send_idx, axis=0)
+            codes = quantize.compress(table, val)
+            if use_ef:
+                res = res.at[send_idx].set(
+                    val - quantize.extract(table, codes)
+                )
             recv = jax.lax.ppermute(codes, axis_name, perm)
-            return segs.at[(idx - i - 1) % n].add(quantize.extract(table, recv))
+            segs = segs.at[(idx - i - 1) % n].add(
+                quantize.extract(table, recv)
+            )
+            return segs, res
 
-        segs = jax.lax.fori_loop(0, n - 1, rs_step, segs)  # reduce-scatter
+        segs, res = jax.lax.fori_loop(
+            0, n - 1, rs_step, (segs, res)
+        )  # reduce-scatter
         # rank idx now owns fully-reduced segment (idx + 1) % n.  The
         # all-gather circulates CODES end to end: the owner encodes once and
         # every rank (owner included) reconstructs through the same table, so
@@ -83,10 +130,16 @@ def _ring_all_reduce_local(
         # uninitialized slots never ride the wire.
         own = (idx + 1) % n
         code_dtype = jnp.uint8 if compress_bits <= 8 else jnp.uint16
+        own_val = jnp.take(segs, own, axis=0)
+        if use_ef:
+            own_val = own_val + jnp.take(res, own, axis=0)
+        own_codes = quantize.compress(table, own_val)
+        if use_ef:
+            res = res.at[own].set(
+                own_val - quantize.extract(table, own_codes)
+            )
         codes = jnp.zeros(segs.shape, code_dtype)
-        codes = codes.at[own].set(
-            quantize.compress(table, jnp.take(segs, own, axis=0))
-        )
+        codes = codes.at[own].set(own_codes)
 
         def ag_step(i, codes):
             send_idx = (idx + 1 - i) % n
@@ -95,7 +148,10 @@ def _ring_all_reduce_local(
             return codes.at[(idx - i) % n].set(recv)
 
         codes = jax.lax.fori_loop(0, n - 1, ag_step, codes)  # all-gather
-        return quantize.extract(table, codes).reshape(-1)
+        out = quantize.extract(table, codes).reshape(-1)
+        if use_ef:
+            return out, res.reshape(-1)
+        return out
 
     def rs_step(i, segs):
         send_idx = (idx - i) % n
@@ -119,13 +175,29 @@ def _ring_all_reduce_local(
     return out
 
 
+def ef_residual_init(mesh, stacked_tree, axis: str = "data"):
+    """Zero error-feedback carry for :func:`ring_all_reduce`'s EF mode:
+    one padded flat vector per ring member, stacked on the ring axis."""
+    import numpy as np
+
+    n = mesh.shape[axis]
+    length = sum(
+        int(np.prod(x.shape[1:]))
+        for x in jax.tree_util.tree_leaves(stacked_tree)
+    )
+    padded = ((length + n - 1) // n) * n
+    return jnp.zeros((n, padded), jnp.float32)
+
+
 def ring_all_reduce(
     mesh: Mesh,
     stacked_tree,
     axis: str = "data",
     average: bool = True,
     compress_bits: int | None = None,
-    compress_range: float = 1.0,
+    compress_range: float | str = 1.0,
+    compress_mode: str = "uniform",
+    residual=None,
 ):
     """Explicit ring all-reduce of per-device gradient pytrees.
 
@@ -142,7 +214,19 @@ def ring_all_reduce(
     In ``average`` mode inputs are pre-divided by the ring size so partial
     sums stay within ``compress_range`` as long as it bounds a single
     gradient's magnitude; in ``average=False`` (sum) mode ``compress_range``
-    must bound the FULL n-way sum or values clip.
+    must bound the FULL n-way sum or values clip.  Pass the string
+    ``"dynamic"`` to measure the range per call instead (one ring-global
+    scalar ``pmax``): the table then tracks the gradient scale through
+    training, which is what keeps a low-bit codec accurate once gradients
+    shrink far below any fixed range.
+
+    ``residual``: optional per-member error-feedback carry (EF-SGD; build
+    the initial zeros with :func:`ef_residual_init`).  When given, every
+    encoded segment is compensated with the previous step's quantization
+    error and the call returns ``(reduced_tree, new_residual)`` — carry the
+    residual through the training loop.  The reference ships every ring
+    Buffer through its codec and still reports ~1.0 accuracy
+    (4_node_ring.png); EF is how a low-bit codec earns that.
 
     The whole exchange — BufferFusion flatten, padded ring schedule, codec,
     unflatten — runs per-device INSIDE one ``shard_map``, so the call is a
@@ -151,8 +235,11 @@ def ring_all_reduce(
     template, not just the bench artifact.
     """
     n = mesh.shape[axis]
+    use_ef = residual is not None
+    if use_ef and compress_bits is None:
+        raise ValueError("error-feedback residual needs compress_bits")
 
-    def local(tree):
+    def local(tree, res):
         # per-device slice: leaves arrive as [1, ...]
         per_dev = jax.tree_util.tree_map(lambda x: x[0], tree)
         # BufferFusion (buffer_fusion.h:53-65): one contiguous vector
@@ -161,15 +248,30 @@ def ring_all_reduce(
         padded = ((length + n - 1) // n) * n
         if padded != length:
             flat = jnp.pad(flat, (0, padded - length))
-        flat = _ring_all_reduce_local(
-            flat, axis, n, average,
-            compress_bits=compress_bits, compress_range=compress_range,
-        )
+        if use_ef:
+            flat, new_res = _ring_all_reduce_local(
+                flat, axis, n, average,
+                compress_bits=compress_bits, compress_range=compress_range,
+                residual=res[0], compress_mode=compress_mode,
+            )
+        else:
+            flat = _ring_all_reduce_local(
+                flat, axis, n, average,
+                compress_bits=compress_bits, compress_range=compress_range,
+                compress_mode=compress_mode,
+            )
+            new_res = res[0]
         out = unravel(flat[:length])
-        return jax.tree_util.tree_map(lambda x: x[None], out)
+        return (jax.tree_util.tree_map(lambda x: x[None], out),
+                new_res[None])
 
-    fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
-    return fn(stacked_tree)
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(axis), P(axis)))
+    res_in = residual if use_ef else jnp.zeros((n, 1), jnp.float32)
+    out, new_res = fn(stacked_tree, res_in)
+    if use_ef:
+        return out, new_res
+    return out
 
 
 def ring_broadcast(mesh: Mesh, stacked_tree, axis: str = "data"):

@@ -41,7 +41,11 @@ def build_table(
     elif mode == "log":
         # log-spaced quantiles, sign-symmetric around 0 like the reference's
         # LOG mode for gradient-ish distributions
-        mags = jnp.geomspace(1e-8, max(abs(min_val), abs(max_val)), n // 2 + 1)
+        # jnp.maximum keeps this tracer-safe: collectives build tables from
+        # a per-call measured range (dist/collectives.py dynamic mode)
+        mags = jnp.geomspace(
+            1e-8, jnp.maximum(jnp.abs(min_val), jnp.abs(max_val)), n // 2 + 1
+        )
         edges = jnp.concatenate([-mags[::-1], mags[1:]])
     elif mode == "normal":
         p = jnp.linspace(1e-6, 1 - 1e-6, n + 1)

@@ -39,11 +39,20 @@ sys.path.insert(0, REPO_ROOT)
 N_PROC = 2
 LOCAL_DEVICES = 2
 RING = N_PROC * LOCAL_DEVICES
-# codec range must bound the LARGEST per-member mean gradient (early
-# logistic grads here reach ~0.5 before the /RING pre-division): too small
-# clips systematically, too large wastes resolution.  0.5 measured best
-# across {0.25, 0.5, 1.0} on this workload; override via RING_CRANGE.
-CRANGE = float(os.environ.get("RING_CRANGE", "0.5"))
+# codec range: "dynamic" (the default) measures the ring-global gradient
+# magnitude per call (one scalar pmax) so the table TRACKS the gradient
+# scale through training — any fixed range turns late-training small
+# gradients into pure bucket noise (measured on this workload: fixed 0.5
+# normal-table int8 lands logloss 0.082 vs 0.023 dynamic).  A float value
+# pins a fixed range instead; it must bound the largest per-member mean
+# gradient.  Override via RING_CRANGE.
+_crange_env = os.environ.get("RING_CRANGE", "dynamic")
+CRANGE = _crange_env if _crange_env == "dynamic" else float(_crange_env)
+# codec table shape: "normal" concentrates bucket resolution near zero,
+# where gradients live — the reference's QuantileCompress ships exactly
+# such CDF tables (quantile_compress.h:38-107); "uniform" is the naive
+# fixed-step comparison.  Override via RING_CMODE.
+CMODE = os.environ.get("RING_CMODE", "normal")
 
 
 # ---------------------------------------------------------------------------
@@ -52,6 +61,15 @@ CRANGE = float(os.environ.get("RING_CRANGE", "0.5"))
 
 def worker_main(pid: int, port: int, data_path: str, out_dir: str,
                 epochs: int, compress_bits: int, lr: float):
+    if os.environ.get("LIGHTCTR_RING_DEBUG"):
+        import faulthandler
+
+        faulthandler.dump_traceback_later(120, exit=True)
+
+    def dbg(msg):
+        if os.environ.get("LIGHTCTR_RING_DEBUG"):
+            print(f"[ring w{pid}] {msg}", file=sys.stderr, flush=True)
+
     # env (JAX_PLATFORMS/XLA_FLAGS/PALLAS_AXON_POOL_IPS) is set by the
     # coordinator BEFORE this interpreter started; jax imports are safe here
     import jax
@@ -97,8 +115,16 @@ def worker_main(pid: int, port: int, data_path: str, out_dir: str,
                 + cfg.lambda_l2 * l2 * RING / n_rows)
 
     bits = compress_bits if compress_bits > 0 else None
+    # int8 hops run with ERROR FEEDBACK (EF-SGD): each member carries its
+    # per-segment quantization error into the next step's encode, so the
+    # codec's bias becomes a delayed contribution instead of a loss — how
+    # the reference's fully-coded ring wire still lands ~1.0 accuracy
+    # (4_node_ring.png, quantile_compress.h:38-107).  16-bit hops stay
+    # plain: the fp16-policy comparison point is already parity-grade.
+    use_ef = (bits is not None and bits <= 8
+              and os.environ.get("RING_EF", "1") != "0")
 
-    def local(p_s, opt_s, batch_shard):
+    def local(p_s, opt_s, res_s, batch_shard):
         # every ring member holds its OWN param replica (stacked leaves,
         # leading dim 1 per device — exactly the reference's N independent
         # workers): grads stay per-member and the EXPLICIT neighbor ring
@@ -114,20 +140,30 @@ def worker_main(pid: int, port: int, data_path: str, out_dir: str,
         padded = ((length + RING - 1) // RING) * RING
         if padded != length:
             flat = jnp.pad(flat, (0, padded - length))
-        flat = _ring_all_reduce_local(
-            flat, "data", RING, True,
-            compress_bits=bits, compress_range=CRANGE,
-        )
+        mode = CMODE if (bits is not None and bits <= 8) else "uniform"
+        if use_ef:
+            flat, new_res = _ring_all_reduce_local(
+                flat, "data", RING, True,
+                compress_bits=bits, compress_range=CRANGE,
+                residual=res_s[0], compress_mode=mode,
+            )
+        else:
+            flat = _ring_all_reduce_local(
+                flat, "data", RING, True,
+                compress_bits=bits, compress_range=CRANGE,
+                compress_mode=mode,
+            )
+            new_res = res_s[0]
         g = unravel(flat[:length])
         upd, new_opt = tx.update(g, opt, p)
         new_p = optax.apply_updates(p, upd)
         expand = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-        return expand(new_p), expand(new_opt)
+        return expand(new_p), expand(new_opt), new_res[None]
 
     step = jax.jit(shard_map(
         local, mesh=mesh,
-        in_specs=(P("data"), P("data"), P("data")),
-        out_specs=(P("data"), P("data")),
+        in_specs=(P("data"), P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data")),
     ))
 
     def replicate(tree):
@@ -153,15 +189,33 @@ def worker_main(pid: int, port: int, data_path: str, out_dir: str,
             tree,
         )
 
+    dbg("distributed up; building global arrays")
     gp = replicate(params)
     gopt = replicate(opt_state)
     gbatch = shard_batch(arrays)
+    dbg("global arrays built")
+    # per-member EF residual carry: zeros [RING, padded_grad_len] sharded
+    # over the ring (unused-but-threaded when EF is off)
+    flat_len = sum(int(np.prod(np.asarray(v).shape)) for v in params.values())
+    padded_len = ((flat_len + RING - 1) // RING) * RING if use_ef else 1
+    gres = multihost_utils.host_local_array_to_global_array(
+        np.zeros((LOCAL_DEVICES, padded_len), np.float32), mesh, P("data")
+    )
 
     losses = []
     t0 = time.perf_counter()
-    for _ in range(epochs):
-        gp, gopt = step(gp, gopt, gbatch)
+    for e in range(epochs):
+        gp, gopt, gres = step(gp, gopt, gres, gbatch)
+        if (e + 1) % 8 == 0:
+            # bound the async-dispatch depth: two processes racing dozens
+            # of un-awaited multi-output collective programs can deadlock
+            # the cross-process execution queues (observed at 60 epochs x
+            # 3 outputs); an occasional sync keeps them in lockstep
+            jax.block_until_ready(gres)
+        if e == 0:
+            dbg("first step dispatched")
     jax.block_until_ready(gp)
+    dbg("steps done")
     wall = time.perf_counter() - t0
 
     if pid == 0:
@@ -179,7 +233,8 @@ def worker_main(pid: int, port: int, data_path: str, out_dir: str,
                                f"ring_meta_b{compress_bits}.json"),
                   "w") as f:
             json.dump({"wall_s": round(wall, 2), "epochs": epochs,
-                       "rows": n_rows, "ring": RING}, f)
+                       "rows": n_rows, "ring": RING,
+                       "error_feedback": use_ef}, f)
     # all processes must stay alive until proc 0 finished its fetch
     multihost_utils.sync_global_devices("ring_cluster_done")
 
@@ -358,9 +413,19 @@ def run(data_path=None, epochs=60, lr=0.1, out="RING_CLUSTER.json",
         assert abs(report["int16_ring"]["auc"]
                    - report["single_process"]["auc"]) < 0.01
     if 8 in results:
-        # 8-bit hops: converge, but adagrad accumulates the quantization
-        # noise as signal — slower by construction; bound loosely
-        assert report["int8_ring"]["auc"] > 0.75
+        if report["int8_ring"].get("error_feedback"):
+            # 8-bit hops + error feedback + dynamic range: the codec's
+            # bias is carried, not lost — the int8 ring must land in the
+            # exact ring's AUC neighborhood (the reference's fully-coded
+            # wire bar)
+            assert abs(report["int8_ring"]["auc"]
+                       - report["single_process"]["auc"]) < 0.01, \
+                report["int8_ring"]["auc"]
+        else:
+            # RING_EF=0 A/B baseline: memoryless codec noise feeds the
+            # accumulator — converges, but slower by construction
+            assert report["int8_ring"]["auc"] > 0.75, \
+                report["int8_ring"]["auc"]
     if out:
         with open(out, "w") as f:
             json.dump(report, f, indent=1)
