@@ -67,12 +67,18 @@ def _send_msg(sock: socket.socket, msg_type: int, payload: bytes) -> None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: one kernel->user copy per chunk and
+    # one final bytes() snapshot, instead of a bytearray.extend per chunk
+    # (which re-copies the accumulated prefix as it grows — quadratic-ish
+    # on the soak's multi-MB row payloads)
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed mid-message")
-        buf.extend(chunk)
+        got += r
     return bytes(buf)
 
 
@@ -95,8 +101,11 @@ def _recv_msg(
 def _keys_and_rows(payload: bytes, dim: int, dtype) -> Tuple[np.ndarray, np.ndarray]:
     """Split a payload framed as pack_keys(keys) ++ rows into both parts."""
     keys, consumed = wire.split_keys(payload)
-    rows = np.frombuffer(payload[consumed:], dtype)
-    rows = rows.reshape(len(keys), dim).astype(np.float32)
+    if dtype is np.float16:
+        rows = wire.unpack_values(payload[consumed:], (len(keys), dim))
+    else:
+        rows = np.frombuffer(payload[consumed:], dtype)
+        rows = rows.reshape(len(keys), dim).astype(np.float32)
     return keys, rows
 
 
@@ -162,7 +171,7 @@ class ParamServerService:
                             conn.sendall(struct.pack("<IB", 1, 0) + b"\x01")
                         else:
                             body = (wire.pack_keys(keys)
-                                    + rows.astype(np.float16).tobytes())
+                                    + wire.pack_values(rows)[0])
                             conn.sendall(
                                 struct.pack("<IB", 1 + len(body), 0)
                                 + b"\x00" + body
@@ -383,7 +392,7 @@ class PSClient:
             # wrong rows with ok=True
             raise ValueError("push_arrays keys must be sorted unique")
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
-        payload = hdr + wire.pack_keys(keys_arr) + r.astype(np.float16).tobytes()
+        payload = hdr + wire.pack_keys(keys_arr) + wire.pack_values(r)[0]
         ok = self._rpc(MSG_PUSH, payload) == b"\x00"
         if not ok:
             self.dropped_pushes += 1
@@ -663,7 +672,7 @@ class ShardedPSClient:
                 c._send(
                     MSG_PUSH,
                     hdr + wire.pack_keys(part)
-                    + r[idx].astype(np.float16).tobytes(),
+                    + wire.pack_values(r[idx])[0],
                 )
                 live.append((i, c))
             except (ConnectionError, OSError):

@@ -14,7 +14,7 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [
     "libffm_parser.cpp", "shm_kv.cpp", "varint.cpp", "fm_cpu.cpp",
-    "ffm_cpu.cpp",
+    "ffm_cpu.cpp", "ps_rows.cpp",
 ]
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -128,6 +128,22 @@ def _build() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_long, ctypes.POINTER(ctypes.c_float),
         ctypes.c_float, ctypes.c_float,
+    ]
+    lib.rows_adagrad.restype = None
+    lib.rows_adagrad.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.f32_to_f16.restype = None
+    lib.f32_to_f16.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_uint16),
+        ctypes.c_int64,
+    ]
+    lib.f16_to_f32.restype = None
+    lib.f16_to_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
     ]
     lib.shmkv_sync.restype = ctypes.c_int
     lib.shmkv_sync.argtypes = [ctypes.c_void_p]
@@ -449,6 +465,58 @@ def varint_unpack_native(buf: bytes, n: int, return_consumed: bool = False):
     if rc == -2:
         raise ValueError("corrupt varint stream (value overflows 64 bits)")
     return (out, int(rc)) if return_consumed else out
+
+
+def rows_adagrad_native(W: np.ndarray, acc: np.ndarray, slots: np.ndarray,
+                        g: np.ndarray, lr: float, eps: float) -> None:
+    """Fused in-place sparse-Adagrad over slot-indexed rows of ``W``/``acc``
+    (ps_rows.cpp): one memory pass instead of numpy _apply's five.  Caller
+    must hold the store's lock; arrays must be C-contiguous fp32."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    s = np.ascontiguousarray(slots, np.int64)
+    gg = np.ascontiguousarray(g, np.float32)
+    fptr = ctypes.POINTER(ctypes.c_float)
+    l_.rows_adagrad(
+        W.ctypes.data_as(fptr), acc.ctypes.data_as(fptr),
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        gg.ctypes.data_as(fptr), len(s), W.shape[1],
+        ctypes.c_float(lr), ctypes.c_float(eps),
+    )
+
+
+def f16_encode_native(v: np.ndarray) -> np.ndarray:
+    """fp32 -> fp16 bit pattern via the host's hardware converters
+    (ps_rows.cpp); returns a uint16 array aliasing nothing."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    src = np.ascontiguousarray(v, np.float32)
+    out = np.empty(src.size, np.uint16)
+    l_.f32_to_f16(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), src.size,
+    )
+    return out
+
+
+def f16_decode_native(buf, n: int) -> np.ndarray:
+    """fp16 bytes/uint16 array -> fp32 array of ``n`` values (hardware
+    converters, ps_rows.cpp)."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    src = np.frombuffer(buf, np.uint16) if isinstance(buf, (bytes, bytearray, memoryview)) \
+        else np.ascontiguousarray(buf, np.uint16)
+    if src.size != n:
+        raise ValueError(f"expected {n} fp16 values, got {src.size}")
+    out = np.empty(n, np.float32)
+    l_.f16_to_f32(
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+    )
+    return out
 
 
 def _csr_flatten(arrays: dict, feature_cnt: int, with_fields: bool = False):

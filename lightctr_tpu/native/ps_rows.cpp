@@ -1,0 +1,81 @@
+// Row-indexed fused updater kernels for the in-process PS store
+// (embed/async_ps.py).  The numpy _apply path walks the batch in five
+// full passes (gather acc, square-add, scatter acc, rsqrt-scale, scatter
+// W) — ~5x the memory traffic of the math.  One pass here, no atomics:
+// the store serializes writers under its own lock (unlike shm_kv.cpp's
+// cross-process CAS kernels, this store is single-process by design).
+// Reference role: gradientUpdater.h:138-150 applied server-side per push
+// (paramserver.h:252-300).
+#include <cmath>
+#include <cstdint>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// W[slots[i]] and acc[slots[i]] are rows of length dim; g is [n, dim]
+// dense in batch order.  slots may repeat only if the caller allows
+// (pushes carry unique keys; repeated slots would under-accumulate in
+// the numpy path too, so semantics match).
+void rows_adagrad(float* W, float* acc, const int64_t* slots,
+                  const float* g, int64_t n, int64_t dim,
+                  float lr, float eps) {
+    for (int64_t i = 0; i < n; ++i) {
+        float* w_row = W + slots[i] * dim;
+        float* a_row = acc + slots[i] * dim;
+        const float* g_row = g + i * dim;
+#pragma GCC unroll 4
+        for (int64_t d = 0; d < dim; ++d) {
+            const float gv = g_row[d];
+            const float a = a_row[d] + gv * gv;
+            a_row[d] = a;
+            w_row[d] -= lr * gv / sqrtf(a + eps);
+        }
+    }
+}
+
+// fp16 wire codec (paramserver.h:161-163 ships every PS value as fp16).
+// numpy's astype(float16) runs ~0.3 GB/s here and gcc auto-vectorizes the
+// plain cast loop into SCALAR vcvtsh2ss — so the wide converters are
+// spelled out: 16 lanes per VCVTPH2PS/VCVTPS2PH on AVX-512, 8 on F16C.
+void f32_to_f16(const float* src, uint16_t* dst, int64_t n) {
+    int64_t i = 0;
+#if defined(__AVX512F__)
+    for (; i + 16 <= n; i += 16)
+        _mm256_storeu_si256(
+            reinterpret_cast<__m256i*>(dst + i),
+            _mm512_cvtps_ph(_mm512_loadu_ps(src + i),
+                            _MM_FROUND_TO_NEAREST_INT));
+#elif defined(__F16C__)
+    for (; i + 8 <= n; i += 8)
+        _mm_storeu_si128(
+            reinterpret_cast<__m128i*>(dst + i),
+            _mm256_cvtps_ph(_mm256_loadu_ps(src + i),
+                            _MM_FROUND_TO_NEAREST_INT));
+#endif
+    _Float16* out = reinterpret_cast<_Float16*>(dst);
+    for (; i < n; ++i) out[i] = (_Float16)src[i];
+}
+
+void f16_to_f32(const uint16_t* src, float* dst, int64_t n) {
+    int64_t i = 0;
+#if defined(__AVX512F__)
+    for (; i + 16 <= n; i += 16)
+        _mm512_storeu_ps(
+            dst + i,
+            _mm512_cvtph_ps(_mm256_loadu_si256(
+                reinterpret_cast<const __m256i*>(src + i))));
+#elif defined(__F16C__)
+    for (; i + 8 <= n; i += 8)
+        _mm256_storeu_ps(
+            dst + i,
+            _mm256_cvtph_ps(_mm_loadu_si128(
+                reinterpret_cast<const __m128i*>(src + i))));
+#endif
+    const _Float16* in = reinterpret_cast<const _Float16*>(src);
+    for (; i < n; ++i) dst[i] = (float)in[i];
+}
+
+}  // extern "C"
