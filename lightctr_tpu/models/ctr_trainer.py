@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +32,18 @@ from lightctr_tpu.models._common import tree_copy
 from lightctr_tpu.ops import losses as losses_lib
 from lightctr_tpu.ops import metrics as metrics_lib
 from lightctr_tpu.ops.activations import sigmoid
+
+
+class CompressedRingState(NamedTuple):
+    """Optimizer state of the wire-compressed data-parallel path: the inner
+    optax state (replicated) plus the per-replica EF-SGD residual carry
+    ([n_devices, padded_grad_len], sharded over ``data``) — each replica's
+    quantization error re-enters its next encode, so the int8 codec's bias
+    becomes a delayed contribution instead of a loss (how the reference's
+    fully-coded ring wire still lands ~1.0 accuracy, 4_node_ring.png)."""
+
+    inner: Any
+    residual: jax.Array
 
 
 class CTRTrainer:
@@ -68,7 +80,19 @@ class CTRTrainer:
         replica.
     compress_range: symmetric quantization range; must bound a single
         device's gradient magnitudes (inputs are pre-divided by the ring size
-        so partial sums stay inside it).
+        so partial sums stay inside it).  The string ``"dynamic"`` measures
+        the range per call (one ring-global scalar pmax) so the codec tracks
+        the gradient scale through training.
+    compress_mode: quantile-table shape ("uniform" / "normal" / "log",
+        ops/quantize.py).  Default: "normal" for ``compress_bits <= 8``
+        (resolution concentrated where gradients live — the measured best
+        int8 table), "uniform" for 16-bit (already parity-grade).
+        Independent of ``error_feedback``.
+    error_feedback: carry each replica's quantization error into its next
+        encode (EF-SGD).  Default: on for ``compress_bits <= 8`` (where the
+        codec bias is material), off for 16-bit.  The residual lives in the
+        optimizer state (``CompressedRingState``), so scan/fit paths thread
+        it automatically.
     zero_sharded: cross-replica weight-update sharding (Xu et al. 2020,
         arXiv:2004.13336 — the ZeRO-1 idea as XLA expresses it): instead of
         every replica applying the identical full-size optimizer update, the
@@ -91,7 +115,9 @@ class CTRTrainer:
         fused_fn: Optional[Callable] = None,
         param_shardings=None,
         compress_bits: Optional[int] = None,
-        compress_range: float = 1.0,
+        compress_range: float | str = 1.0,
+        compress_mode: Optional[str] = None,
+        error_feedback: Optional[bool] = None,
         fused_adagrad: bool = False,
         zero_sharded: bool = False,
     ):
@@ -138,6 +164,23 @@ class CTRTrainer:
             if param_shardings is not None:
                 raise ValueError("compress_bits assumes replicated params "
                                  "(ring-exchanged data-parallel gradients)")
+        self.error_feedback = (
+            error_feedback if error_feedback is not None
+            else (compress_bits is not None and compress_bits <= 8)
+        )
+        if error_feedback and compress_bits is None:
+            raise ValueError("error_feedback rides the compressed ring; set "
+                             "compress_bits")
+        if isinstance(compress_range, str) and compress_range != "dynamic":
+            raise ValueError(
+                f"compress_range must be a float or 'dynamic', "
+                f"got {compress_range!r}"
+            )
+        self.compress_mode = (
+            compress_mode if compress_mode is not None
+            else ("normal" if (compress_bits is not None
+                               and compress_bits <= 8) else "uniform")
+        )
         # own copy: steps donate their input buffers, so the caller's tree
         # must stay untouched (it may seed several trainers)
         self.params = tree_copy(params)
@@ -147,13 +190,20 @@ class CTRTrainer:
         )
         if self._param_sharding is not None:
             self.params = jax.device_put(self.params, self._param_sharding)
-        if zero_sharded:
+        if zero_sharded or compress_bits is not None:
+            # both flows flatten the params and pad to a multiple of the
+            # ring size (mutually exclusive flags, one computation)
             from jax.flatten_util import ravel_pytree
 
-            flat, self._zero_unravel = ravel_pytree(self.params)
+            flat, unravel = ravel_pytree(self.params)
             n = mesh.shape["data"]
-            self._zero_len = flat.shape[0]
-            self._zero_pad = ((self._zero_len + n - 1) // n) * n
+            pad = ((flat.shape[0] + n - 1) // n) * n
+            if zero_sharded:
+                self._zero_unravel = unravel
+                self._zero_len = flat.shape[0]
+                self._zero_pad = pad
+            else:
+                self._ring_pad = pad
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
@@ -299,31 +349,45 @@ class CTRTrainer:
         n = mesh.shape["data"]
         bits = self.compress_bits
         crange = self.compress_range
+        cmode = self.compress_mode
+        use_ef = self.error_feedback
+        padded = self._ring_pad
 
-        def local_step(params, opt_state, batch):
+        def local_step(params, state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             flat, unravel = ravel_pytree(grads)
             length = flat.shape[0]
-            padded = ((length + n - 1) // n) * n
             if padded != length:
                 flat = jnp.pad(flat, (0, padded - length))
-            flat = _ring_all_reduce_local(
-                flat, "data", n, average=True,
-                compress_bits=bits, compress_range=crange,
-            )
+            if use_ef:
+                flat, new_res = _ring_all_reduce_local(
+                    flat, "data", n, average=True,
+                    compress_bits=bits, compress_range=crange,
+                    residual=state.residual[0], compress_mode=cmode,
+                )
+            else:
+                flat = _ring_all_reduce_local(
+                    flat, "data", n, average=True,
+                    compress_bits=bits, compress_range=crange,
+                    compress_mode=cmode,
+                )
+                new_res = state.residual[0]
             grads = unravel(flat[:length])
             loss = jax.lax.pmean(loss, "data")
-            updates, opt_state = tx.update(grads, opt_state, params)
+            updates, inner = tx.update(grads, state.inner, params)
             params = optim_lib.apply_updates(params, updates)
-            return params, opt_state, loss
+            state = CompressedRingState(inner=inner,
+                                        residual=new_res[None])
+            return params, state, loss
 
         from jax import shard_map
 
+        state_spec = CompressedRingState(inner=P(), residual=P("data"))
         return shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(P(), P(), P("data")),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), state_spec, P("data")),
+            out_specs=(P(), state_spec, P()),
             check_vma=False,
         )
 
@@ -356,6 +420,22 @@ class CTRTrainer:
             # 1/n of the flattened state lives on each data replica
             return jax.device_put(
                 state, NamedSharding(self.mesh, P("data"))
+            )
+        if self.compress_bits is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n = self.mesh.shape["data"]
+            # EF-off keeps a 1-element placeholder so the step signature
+            # (and the scan carry) is one shape family either way
+            residual = jnp.zeros(
+                (n, self._ring_pad if self.error_feedback else 1),
+                jnp.float32,
+            )
+            return CompressedRingState(
+                inner=self.tx.init(params),
+                residual=jax.device_put(
+                    residual, NamedSharding(self.mesh, P("data"))
+                ),
             )
         return self.tx.init(params)
 

@@ -73,3 +73,62 @@ def test_python_fallback_malformed_varint_error_contract():
     if bindings.available():
         native_out = bindings.varint_unpack_native(b"\xff" * 9 + b"\x7f", 1)
         np.testing.assert_array_equal(out, native_out)
+
+
+def test_native_f16_codec_bit_parity_with_numpy(rng):
+    """The SIMD fp16 converters (ps_rows.cpp VCVTPS2PH/PH2PS) must be
+    BIT-identical to numpy's astype — round-to-nearest-even, subnormals,
+    overflow-to-inf, and NaN payloads included — or the two wire ends
+    (native sender, fallback receiver) would decode different rows."""
+    if not bindings.available():
+        pytest.skip("native library unavailable")
+    v = np.concatenate([
+        rng.standard_normal(10_001).astype(np.float32),      # odd length:
+        np.array([0.0, -0.0, 1e-8, -1e-8, 65504.0, 65520.0,  # SIMD tail
+                  1e9, -1e9, np.inf, -np.inf, np.nan,
+                  6.1e-5, 5.9e-5], np.float32),               # subnormal edge
+    ])
+    enc = bindings.f16_encode_native(v)
+    ref = v.astype(np.float16)
+    np.testing.assert_array_equal(enc, ref.view(np.uint16))
+    dec = bindings.f16_decode_native(enc.tobytes(), v.size)
+    np.testing.assert_array_equal(dec, ref.astype(np.float32))
+    # empty payload is a defined no-op
+    assert bindings.f16_encode_native(np.zeros(0, np.float32)).size == 0
+    # length mismatch fails loud, not with a short read
+    with pytest.raises(ValueError, match="expected"):
+        bindings.f16_decode_native(enc.tobytes(), v.size + 1)
+
+
+def test_rows_adagrad_native_matches_numpy_path(rng):
+    """Fused one-pass server adagrad (ps_rows.cpp) == the numpy five-pass
+    _apply, through the public push/pull surface, above and below the
+    dispatch threshold."""
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    if not bindings.available():
+        pytest.skip("native library unavailable")
+    n, dim = 5000, 9
+    keys = np.arange(n, dtype=np.int64)
+    init = rng.standard_normal((n, dim)).astype(np.float32)
+
+    def trajectory(force_numpy):
+        ps = AsyncParamServer(dim=dim, updater="adagrad", learning_rate=0.1,
+                              n_workers=1, staleness_threshold=10, seed=0)
+        ps.preload_batch(keys, init)
+        avail = bindings.available
+        if force_numpy:
+            bindings.available = lambda: False
+        try:
+            for step in range(3):
+                # same grads both runs: reseed the generator per step
+                g = np.random.default_rng(step).standard_normal(
+                    (n, dim)).astype(np.float32)
+                ps.push_batch(0, keys, g, worker_epoch=step)
+        finally:
+            bindings.available = avail
+        return ps.pull_batch(keys, worker_epoch=2, worker_id=0)
+
+    a = trajectory(force_numpy=False)
+    b = trajectory(force_numpy=True)
+    np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7)
