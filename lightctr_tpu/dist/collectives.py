@@ -298,7 +298,7 @@ def all_to_all_exchange(
     stacked: jax.Array,
     axis: str = "data",
     compress_bits: int | None = None,
-    compress_range: float = 1.0,
+    compress_range: float | str = 1.0,
 ) -> jax.Array:
     """All-to-all block exchange — the collective under sharded-embedding
     push/pull (SURVEY.md §2.7: the reference's DHT-routed per-PS key batches
@@ -315,8 +315,11 @@ def all_to_all_exchange(
     PS-traffic counterpart of the ring codec (the reference fp16-codes EVERY
     value the PS serves or receives, paramserver.h:161-163).
     ``compress_range`` must bound the block magnitudes (embedding rows / row
-    gradients) or they clip.  Integer payloads (key requests) ride through
-    the separate varint host codec (`dist.wire.pack_varint`) or uncompressed.
+    gradients) or they clip; the string ``"dynamic"`` measures it per call
+    (one global ``pmax`` over the mesh axis), the same adaptive-table
+    policy as :func:`ring_all_reduce`.  Integer payloads (key requests)
+    ride through the separate varint host codec (`dist.wire.pack_varint`)
+    or uncompressed.
     """
     n = mesh.shape[axis]
     if stacked.ndim < 2 or stacked.shape[0] != n or stacked.shape[1] != n:
@@ -333,11 +336,17 @@ def all_to_all_exchange(
     if compress_bits is not None:
         from lightctr_tpu.ops import quantize
 
-        table = quantize.build_table(
-            -compress_range, compress_range, bits=compress_bits, mode="uniform"
-        )
-
         def local(x):  # x: [1, n, ...] this device's outgoing blocks
+            if compress_range == "dynamic":
+                rng = 1.05 * jax.lax.pmax(jnp.max(jnp.abs(x)), axis)
+                rng = jnp.maximum(rng, 1e-12)
+            else:
+                rng = compress_range
+            # all senders share one table (the pmax is axis-global), so
+            # every receiver decodes exactly what was encoded
+            table = quantize.build_table(
+                -rng, rng, bits=compress_bits, mode="uniform"
+            )
             # encode BEFORE the collective so the all_to_all operand is the
             # narrow code array; decode after, on the receiver
             codes = jax.lax.all_to_all(

@@ -69,3 +69,20 @@ def test_exchange_wire_compressed(rng):
         all_to_all_exchange(
             mesh, jnp.zeros((4, 4, 2), jnp.int32), compress_bits=8
         )
+
+
+def test_exchange_dynamic_range_tracks_block_scale(rng):
+    """compress_range="dynamic" on the exchange: tiny embedding-gradient
+    blocks (1e-3 of any fixed range) still route at codec precision
+    relative to their own scale — the same adaptive-table policy as the
+    ring (ring_all_reduce)."""
+    mesh = make_mesh(MeshSpec(data=4))
+    x = jnp.asarray((rng.normal(size=(4, 4, 6, 3)) * 1e-3).astype(np.float32))
+    want = np.swapaxes(np.asarray(x), 0, 1)
+    scale = np.abs(want).max()
+    fixed = np.asarray(all_to_all_exchange(mesh, x, compress_bits=8,
+                                           compress_range=1.0))
+    dyn = np.asarray(all_to_all_exchange(mesh, x, compress_bits=8,
+                                         compress_range="dynamic"))
+    assert np.abs(dyn - want).max() / scale < 0.02
+    assert np.abs(dyn - want).max() < np.abs(fixed - want).max() / 10
