@@ -69,10 +69,12 @@ def _ps_proc(conn, n_workers, lr, stop_evt, seed=0):
 
 
 def _make_client(addresses, dim):
-    """Shared shard-count policy — lightctr_tpu.dist.ps_server.make_client."""
+    """Shared shard-count policy — lightctr_tpu.dist.ps_server.make_client.
+    Multi-shard routing rides the consistent-hash ring (the reference's
+    DHT is the production key->PS policy, consistent_hash.h:18-67)."""
     from lightctr_tpu.dist.ps_server import make_client
 
-    return make_client(addresses, dim)
+    return make_client(addresses, dim, partition="ring")
 
 
 # ---------------------------------------------------------------------------
@@ -456,7 +458,7 @@ def run(rows=393216, eval_rows=20000, n_workers=4, lr=0.05, batch=BATCH,
                       "dim": DIM, "batch": batch},
             "topology": f"{n_workers} worker processes x {ps_shards} "
                         "network PS shard(s) (TCP, varint keys + fp16 "
-                        "rows; key % n_shards partition)",
+                        "rows; consistent-hash ring partition)",
             "store": "slot-contiguous AsyncParamServer (adagrad), "
                      f"{VOCAB + n_dense} preloaded rows",
             "preload_s": round(preload_s, 1),
