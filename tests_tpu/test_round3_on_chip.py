@@ -31,11 +31,16 @@ def test_compressed_ring_trainer_compiles_on_chip():
     n_dev = len(jax.devices())
     mesh = make_mesh(MeshSpec(data=n_dev))
     params = fm.init(jax.random.PRNGKey(0), 2048, 4)
+    # the production int8 configuration: EF residual (default-on at 8
+    # bits) + dynamic range — the round-5 codec that matches the exact
+    # ring's accuracy must lower through real XLA:TPU (pmax + table build
+    # + searchsorted codec + residual carry, one jitted program)
     tr = CTRTrainer(
         params, fm.logits, TrainConfig(learning_rate=0.1),
         fused_fn=fm.logits_with_l2, mesh=mesh,
-        compress_bits=8, compress_range=0.25,
+        compress_bits=8, compress_range="dynamic",
     )
+    assert tr.error_feedback
     batch = {
         "fids": rng.integers(0, 2048, size=(16 * n_dev, 8)).astype(np.int32),
         "fields": np.zeros((16 * n_dev, 8), np.int32),
