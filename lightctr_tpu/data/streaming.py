@@ -52,10 +52,22 @@ def iter_libffm_batches(
             raise ValueError(
                 f"process_index {process_index} not in [0, {process_count})"
             )
-        inner = iter_libffm_batches(
-            path, batch_size, max_nnz, feature_cnt, field_cnt,
-            drop_remainder=False, native=native,
-        )
+        if native is None:
+            native = bindings.available()
+        if native:
+            # scan-level shard: the C parser line-skips other workers'
+            # rows (counted, not tokenized) — the fleet parses each row
+            # exactly once; the rebatcher below is oblivious
+            inner = _iter_native(
+                path, batch_size, max_nnz, feature_cnt, field_cnt,
+                drop_remainder=False,
+                stride_count=process_count, stride_index=process_index,
+            )
+        else:
+            inner = iter_libffm_batches(
+                path, batch_size, max_nnz, feature_cnt, field_cnt,
+                drop_remainder=False, native=False,
+            )
         yield from _stride_rebatch(
             inner, batch_size, process_index, process_count, drop_remainder
         )
@@ -167,10 +179,18 @@ def _stride_rebatch(inner, batch_size, process_index, process_count, drop_remain
         yield carry
 
 
-def _iter_native(path, batch_size, max_nnz, feature_cnt, field_cnt, drop_remainder):
+def _iter_native(path, batch_size, max_nnz, feature_cnt, field_cnt,
+                 drop_remainder, stride_count=None, stride_index=None):
+    """``stride_count``/``stride_index``: tokenize only the rows worker
+    ``stride_index`` owns (global row % count == index) — the scan still
+    COUNTS every data row, so the downstream ``_stride_rebatch`` arithmetic
+    is unchanged, but a fleet of N workers tokenizes the file once total
+    instead of N times.  Non-own rows ride through as zeros and are
+    discarded by the rebatcher's own-row selection."""
     from lightctr_tpu.native.bindings import parse_libffm_chunk
 
     offset = 0
+    g = 0  # global data rows scanned so far (drives the per-chunk phase)
     while True:
         # folding happens natively on the exact long value (pre-narrowing,
         # same as the Python generator), so no np.mod post-pass is needed —
@@ -178,7 +198,10 @@ def _iter_native(path, batch_size, max_nnz, feature_cnt, field_cnt, drop_remaind
         arrays, rows, offset = parse_libffm_chunk(
             path, offset, batch_size, max_nnz,
             fold_fid=feature_cnt or 0, fold_field=field_cnt or 0,
+            stride=stride_count or 1,
+            phase=((stride_index - g) % stride_count) if stride_count else 0,
         )
+        g += rows
         if rows == 0:
             return
         if rows < batch_size and drop_remainder:

@@ -92,6 +92,7 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.ffm_parse_chunk.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long,
         ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_long, ctypes.c_long,
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
@@ -245,6 +246,7 @@ def parse_libffm_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
 def parse_libffm_chunk(
     path: str, offset: int, max_rows: int, max_nnz: int,
     fold_fid: int = 0, fold_field: int = 0,
+    stride: int = 1, phase: int = 0,
 ) -> Tuple[dict, int, int]:
     """Parse up to ``max_rows`` rows starting at byte ``offset`` into padded
     arrays.  Returns ``(arrays, rows_parsed, next_offset)`` where ``arrays``
@@ -252,7 +254,10 @@ def parse_libffm_chunk(
     zero when fewer were available).  Rows longer than ``max_nnz`` are
     truncated — the streaming-generator semantics.  ``fold_fid``/``fold_field``
     > 0 fold ids modulo the vocabulary natively on the exact long value (the
-    hashing trick), matching the Python generator's pre-narrowing fold."""
+    hashing trick), matching the Python generator's pre-narrowing fold.
+    ``stride``/``phase``: tokenize only chunk rows with index % stride ==
+    phase (others are counted but line-skipped, their array rows zero) —
+    the per-worker shard applied at the scan."""
     l_ = lib()
     if l_ is None:
         raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
@@ -265,7 +270,7 @@ def parse_libffm_chunk(
     err_line = ctypes.c_long()
     rc = l_.ffm_parse_chunk(
         path.encode(), ctypes.byref(off), max_rows, max_nnz,
-        fold_fid, fold_field,
+        fold_fid, fold_field, stride, phase,
         _iptr(fields), _iptr(fids), _fptr(vals), _fptr(mask), _fptr(labels),
         ctypes.byref(err_line),
     )

@@ -15,19 +15,67 @@
 
 namespace {
 
+// Bare-decimal fast paths.  strtol/strtod are the semantics of record
+// (locale-aware, sign/exponent/ws handling) but cost ~100ns/call through
+// the libc indirection — and the libFFM token stream is overwhelmingly
+// plain digit runs ("field:fid:1").  These parse ONLY [0-9]+ prefixes and
+// report failure for everything else (signs, '.', exponents, overflow
+// guard), so the fallback keeps the accepted language and results
+// bit-identical.
+inline bool fast_ulong(const char*& p, long& out) {
+    const char* q = p;
+    long v = 0;
+    int digits = 0;
+    while (*q >= '0' && *q <= '9') {
+        if (++digits > 18) return false;  // near LONG_MAX: strtol's job
+        v = v * 10 + (*q - '0');          // guard BEFORE accumulate: no
+        ++q;                              // signed overflow at 18 digits
+    }
+    if (digits == 0) return false;
+    out = v;
+    p = q;
+    return true;
+}
+
+inline bool fast_uval(const char*& p, double& val) {
+    const char* q = p;
+    long v;
+    if (!fast_ulong(q, v)) return false;
+    if (v >= (1L << 53)) return false;  // double-exactness bound; p is
+                                        // untouched so strtod re-parses
+    // only a PURE integer token (delimiter follows) converts exactly;
+    // '.', 'e', or anything else defers to strtod
+    if (*q == ' ' || *q == '\n' || *q == '\t' || *q == '\r' || *q == '\0') {
+        val = (double)v;
+        p = q;
+        return true;
+    }
+    return false;
+}
+
 // Parse "field:fid:val" starting at p; advances p past the token.
 // Returns true on success.
 inline bool parse_token(const char*& p, long& field, long& fid, double& val) {
     char* end = nullptr;
-    field = strtol(p, &end, 10);
-    if (end == p || *end != ':') return false;
-    p = end + 1;
-    fid = strtol(p, &end, 10);
-    if (end == p || *end != ':') return false;
-    p = end + 1;
-    val = strtod(p, &end);
-    if (end == p) return false;
-    p = end;
+    if (!fast_ulong(p, field)) {
+        field = strtol(p, &end, 10);
+        if (end == p) return false;
+        p = end;
+    }
+    if (*p != ':') return false;
+    ++p;
+    if (!fast_ulong(p, fid)) {
+        fid = strtol(p, &end, 10);
+        if (end == p) return false;
+        p = end;
+    }
+    if (*p != ':') return false;
+    ++p;
+    if (!fast_uval(p, val)) {
+        val = strtod(p, &end);
+        if (end == p) return false;
+        p = end;
+    }
     return true;
 }
 
@@ -130,13 +178,21 @@ int ffm_parse(const char* path, long n_rows, long max_nnz, int* fields,
 // advances *offset past the last consumed line.  fold_fid/fold_field > 0
 // reduce ids modulo the fold (the hashing trick) ON THE LONG VALUE —
 // matching the Python generator, which folds exact ints before any int32
-// narrowing.  Returns rows parsed >= 0, -1 on io error, -2 on parse error,
-// -3 when an id exceeds int32 range and no fold was given (*err_line =
-// line index within this chunk, 1-based).
+// narrowing.  stride/phase implement the per-worker row shard AT THE SCAN:
+// data row i (within this chunk) is tokenized only when i % stride ==
+// phase; other rows are line-skipped but still COUNTED (their array rows
+// stay zero) — each row is validated by exactly its owning worker, so a
+// 4-worker fleet tokenizes the file once total instead of 4x.  stride=1
+// parses everything (the single-process behavior).  Returns rows
+// scanned >= 0, -1 on io error, -2 on parse error, -3 when an id exceeds
+// int32 range and no fold was given (*err_line = line index within this
+// chunk, 1-based).
 long ffm_parse_chunk(const char* path, long* offset, long max_rows,
                      long max_nnz, long fold_fid, long fold_field,
+                     long stride, long phase,
                      int* fields, int* fids, float* vals,
                      float* mask, float* labels, long* err_line) {
+    if (stride < 1) stride = 1;
     FILE* f = fopen(path, "r");
     if (!f) return -1;
     if (fseek(f, *offset, SEEK_SET) != 0) { fclose(f); return -1; }
@@ -154,6 +210,13 @@ long ffm_parse_chunk(const char* path, long* offset, long max_rows,
         const char* p = line;
         skip_ws(p);
         if (*p == '\n' || *p == '\0') { *offset = ftell(f); continue; }
+        if (stride > 1 && (r % stride) != phase) {
+            // another worker's row: getline already consumed the bytes;
+            // count it and move on (its array row stays zeroed)
+            ++r;
+            *offset = ftell(f);
+            continue;
+        }
         char* end = nullptr;
         double label = strtod(p, &end);
         if (end == p) {
