@@ -221,3 +221,30 @@ def test_strided_workers_yield_equal_batch_counts(tmp_path):
         for w in range(2)
     ]
     assert counts == [1, 1], counts
+
+
+def test_scan_level_shard_validates_rows_at_their_owner(tmp_path):
+    """The native strided scan line-skips other workers' rows WITHOUT
+    tokenizing them (the whole point: the fleet parses each row once).
+    Contract: a malformed row raises in its OWNING worker's stream — so
+    across a full fleet every row is still validated by exactly one
+    worker — while non-owners stream past it."""
+    p = tmp_path / "bad_row.ffm"
+    with open(p, "w") as f:
+        for i in range(64):
+            if i == 33:  # worker 1's row (33 % 2 == 1)
+                f.write("1 0:borked\n")
+            else:
+                f.write(f"{i % 2} 0:{i % 50}:1 1:{(i * 7) % 50}:2.5\n")
+    # worker 1 owns the malformed row: must fail loud
+    with pytest.raises(ValueError, match="bad libFFM token"):
+        list(iter_libffm_batches(str(p), batch_size=16, max_nnz=4,
+                                 native=True, drop_remainder=False,
+                                 process_index=1, process_count=2))
+    # worker 0 never tokenizes it: full shard, correct rows
+    rows = _real_rows(iter_libffm_batches(
+        str(p), batch_size=16, max_nnz=4, native=True,
+        drop_remainder=False, process_index=0, process_count=2))
+    assert len(rows["labels"]) == 32
+    np.testing.assert_array_equal(rows["fids"][:, 0],
+                                  np.arange(0, 64, 2) % 50)
