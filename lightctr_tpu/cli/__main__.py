@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
             sp.add_argument("--n-cross", type=positive_int, default=3)
         sp.add_argument("--full-batch", action="store_true",
                         help="train full-batch per epoch (the reference FM mode)")
+        sp.add_argument("--dp", action="store_true",
+                        help="data-parallel over every visible device "
+                             "(mesh on 'data'; implies --full-batch)")
+        sp.add_argument("--compress-bits", type=int, choices=(8, 16),
+                        help="wire-compress the DP gradient ring; 8-bit "
+                             "rides error feedback + a dynamic table "
+                             "range (implies --dp)")
 
     sp = common(sub.add_parser("cnn"), lr=0.1, batch=10)     # main.cpp:60
     sp.add_argument("--hidden", type=int, default=200)
@@ -185,11 +192,38 @@ def main(argv=None) -> int:
         if args.model in ("widedeep", "deepfm", "dcn"):
             rep, rep_mask = widedeep.field_representatives(ds.fids, ds.fields, ds.mask, ds.field_cnt)
             batch = widedeep.make_batch(ds, rep, rep_mask)
-        tr = CTRTrainer(params, logits, cfg, fused_fn=fused)
+        mesh = None
+        ndev = 1
+        if args.dp or args.compress_bits:
+            from lightctr_tpu.core.mesh import local_mesh
+
+            mesh = local_mesh()
+            ndev = mesh.shape["data"]
+            n = (len(batch["labels"]) // ndev) * ndev
+            if n == 0:
+                raise SystemExit(
+                    f"--dp: dataset has {len(batch['labels'])} rows but the "
+                    f"mesh has {ndev} devices — nothing to shard"
+                )
+            if n != len(batch["labels"]):
+                # sharded batches must split evenly over the mesh
+                batch = {k: v[:n] for k, v in batch.items()}
+            report["parallel"] = {
+                "devices": ndev,
+                "compress_bits": args.compress_bits,
+            }
+        tr = CTRTrainer(
+            params, logits, cfg, fused_fn=fused, mesh=mesh,
+            compress_bits=args.compress_bits,
+            compress_range="dynamic" if args.compress_bits else 1.0,
+        )
         hist = tr.fit(
             batch,
             epochs=args.epochs,
-            batch_size=None if args.full_batch else cfg.minibatch_size,
+            # DP shards the batch over the mesh: full-batch keeps every
+            # step evenly divisible
+            batch_size=None if (args.full_batch or mesh is not None)
+            else cfg.minibatch_size,
         )
         report["train"] = tr.evaluate(batch)
         report["final_loss"] = hist["loss"][-1]
@@ -200,6 +234,10 @@ def main(argv=None) -> int:
             if args.model in ("widedeep", "deepfm", "dcn"):
                 rep, rep_mask = widedeep.field_representatives(ev.fids, ev.fields, ev.mask, ds.field_cnt)
                 evb = widedeep.make_batch(ev, rep, rep_mask)
+            if mesh is not None:  # eval shards over the mesh too
+                ne = (len(evb["labels"]) // ndev) * ndev
+                if ne != len(evb["labels"]):
+                    evb = {k: v[:ne] for k, v in evb.items()}
             report["eval"] = tr.evaluate(evb)
         if args.ckpt_dir:
             from lightctr_tpu import ckpt
