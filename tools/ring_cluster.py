@@ -36,8 +36,11 @@ import numpy as np
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-N_PROC = 2
-LOCAL_DEVICES = 2
+# 2 procs x 2 devices = 4-member ring by default; RING_PROCS/RING_DEVS
+# scale the topology (e.g. RING_PROCS=4 -> an 8-member ring of real
+# processes, the reference's N-node build_ring.sh scaled up)
+N_PROC = int(os.environ.get("RING_PROCS", "2"))
+LOCAL_DEVICES = int(os.environ.get("RING_DEVS", "2"))
 RING = N_PROC * LOCAL_DEVICES
 # codec range: "dynamic" (the default) measures the ring-global gradient
 # magnitude per call (one scalar pmax) so the table TRACKS the gradient
