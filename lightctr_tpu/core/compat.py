@@ -1,0 +1,59 @@
+"""Version-bridging imports for the jax APIs this repo leans on.
+
+The codebase targets current jax (``from jax import shard_map`` with the
+``check_vma=`` spelling); installs that predate the promotion (< 0.6) ship
+shard_map under ``jax.experimental.shard_map`` and call the same knob
+``check_rep=``.  Every module imports the symbol from here so the whole
+repo — collectives, trainers, ring attention, the cluster tools — runs on
+either API without scattering try/except at each use site.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6: public top-level API
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4/0.5: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def pvary(x, axis_names):
+    """Mark ``x`` as varying over the named manual axes —
+    ``jax.lax.pcast(..., to="varying")`` on current jax,
+    ``jax.lax.pvary`` on the versions that shipped only that spelling.
+    Identity on installs that predate the varying-manual-axes machinery
+    entirely: their shard_map replication inference handles the cast on
+    its own."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    pv = getattr(jax.lax, "pvary", None)
+    if pv is not None:
+        return pv(x, tuple(axis_names))
+    return x
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the rename (pre-0.6 jax calls the
+    same dataclass ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+def shard_map(f=None, **kwargs):
+    """``jax.shard_map`` accepting the ``check_vma`` spelling everywhere
+    (translated to the legacy ``check_rep`` where needed).  Usable exactly
+    like the real one: ``shard_map(fn, mesh=..., in_specs=..., out_specs=...)``
+    or as a decorator factory when ``f`` is omitted."""
+    if not _HAS_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    if f is None:
+        return lambda g: shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)

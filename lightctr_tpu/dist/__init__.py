@@ -1,18 +1,26 @@
 from lightctr_tpu.dist.collectives import (
     all_to_all_exchange,
+    dense_ring_bytes,
     ef_residual_init,
+    prefer_sparse_exchange,
     ring_all_reduce,
     ring_broadcast,
     psum_all_reduce,
+    sparse_all_reduce,
+    sparse_exchange_bytes,
 )
 from lightctr_tpu.dist.bootstrap import HeartbeatMonitor, initialize_multihost
 
 __all__ = [
     "all_to_all_exchange",
+    "dense_ring_bytes",
     "ef_residual_init",
+    "prefer_sparse_exchange",
     "ring_all_reduce",
     "ring_broadcast",
     "psum_all_reduce",
+    "sparse_all_reduce",
+    "sparse_exchange_bytes",
     "HeartbeatMonitor",
     "initialize_multihost",
 ]

@@ -24,9 +24,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, PartitionSpec as P
+
+from lightctr_tpu.core.compat import shard_map
 
 
 def _ring_perm(n: int):
@@ -64,8 +65,11 @@ def _ring_all_reduce_local(
     if compress_bits is not None:
         from lightctr_tpu.ops import quantize
 
+        use_ef = residual is not None
+        res = (residual.reshape(n, -1) if use_ef
+               else jnp.zeros_like(segs))
         if compress_range == "dynamic":
-            # ring-global gradient magnitude: ONE fp32 scalar pmax per call
+            # ring-global gradient magnitude: ONE fp32 pmax per call
             # (negligible next to the coded segments).  The codec's
             # resolution then TRACKS the gradient scale as training
             # converges — a fixed range turns late-training small gradients
@@ -73,20 +77,33 @@ def _ring_all_reduce_local(
             # int8 ring's accuracy (the reference rebuilds its
             # QuantileCompress tables from the data it ships,
             # quantile_compress.h:71-107; this is that policy as one
-            # collective).  1.05 headroom keeps exact-max values (plus an
-            # EF residual of at most half a bucket) off the clip boundary.
-            rng = 1.05 * jax.lax.pmax(jnp.max(jnp.abs(segs)), axis_name)
-            rng = jnp.maximum(rng, 1e-12)
+            # collective).  1.05 headroom keeps exact-max values off the
+            # clip boundary.
+            gmag = jnp.max(jnp.abs(segs))
             if not average:
-                rng = rng * n  # partial SUMS must fit, not partial means
+                gmag = gmag * n  # partial SUMS must fit, not partial means
+            if use_ef:
+                # Every encoded value is val + res, and the carried residual
+                # was bounded by half a bucket of the PREVIOUS table — which
+                # may have been much wider if the gradient scale dropped
+                # sharply between steps.  Measure the residual too (one
+                # stacked pmax, still a single collective) so the 1.05
+                # headroom is a real clip-free bound, not a slowly-varying-
+                # scale assumption.  res already lives in the encoded
+                # domain (/n partial means in average mode, raw sums
+                # otherwise), so the two maxima add directly.
+                mags = jax.lax.pmax(
+                    jnp.stack([gmag, jnp.max(jnp.abs(res))]), axis_name
+                )
+                rng = 1.05 * (mags[0] + mags[1])
+            else:
+                rng = 1.05 * jax.lax.pmax(gmag, axis_name)
+            rng = jnp.maximum(rng, 1e-12)
         else:
             rng = compress_range
         table = quantize.build_table(
             -rng, rng, bits=compress_bits, mode=compress_mode,
         )
-        use_ef = residual is not None
-        res = (residual.reshape(n, -1) if use_ef
-               else jnp.zeros_like(segs))
 
         if average:
             # pre-divide by n so every partial sum in the reduce phase is a
@@ -216,9 +233,11 @@ def ring_all_reduce(
     gradient's magnitude; in ``average=False`` (sum) mode ``compress_range``
     must bound the FULL n-way sum or values clip.  Pass the string
     ``"dynamic"`` to measure the range per call instead (one ring-global
-    scalar ``pmax``): the table then tracks the gradient scale through
-    training, which is what keeps a low-bit codec accurate once gradients
-    shrink far below any fixed range.
+    ``pmax``; with error feedback the measurement includes the carried
+    residual, so a sharp drop in gradient scale cannot clip last step's
+    carry): the table then tracks the gradient scale through training,
+    which is what keeps a low-bit codec accurate once gradients shrink far
+    below any fixed range.
 
     ``residual``: optional per-member error-feedback carry (EF-SGD; build
     the initial zeros with :func:`ef_residual_init`).  When given, every
@@ -360,6 +379,160 @@ def all_to_all_exchange(
 
     fn = shard_map(local, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
     return fn(stacked)
+
+
+# ---------------------------------------------------------------------------
+# Sparsity-aware gradient exchange (SparCML, arXiv:1802.08021; Parallax,
+# arXiv:1808.02621).  CTR gradients touch a few thousand rows of a 2^20-row
+# table; exchanging the dense [vocab, dim] gradient pays O(vocab) bytes per
+# step.  Here each member contributes its deduped (uids, rows) pair — fixed
+# padded shape, so the whole exchange jits — one all_gather moves
+# O(touched) ids+values, and duplicates merge with a segment_sum.  The
+# density-based switch back to the dense ring (SparCML's dense fallback) is
+# a STATIC trace-time policy: our sparse payload is padded to the batch's
+# nnz, so the exchanged byte count is known from shapes alone and the worst
+# case never regresses past the dense path.
+
+
+def _wire_value_bytes(compress_bits: int | None) -> int:
+    return 4 if compress_bits is None else (1 if compress_bits <= 8 else 2)
+
+
+def sparse_exchange_bytes(
+    n: int, k_padded: int, dim: int, compress_bits: int | None = None
+) -> int:
+    """Bytes each member TRANSMITS per :func:`sparse_all_reduce` call: the
+    ring all_gather forwards each of the other members' [k_padded] id +
+    [k_padded, dim] value segments once (n-1 hop payloads of one segment
+    each); values are fp32 or 1/2-byte codes when compressed, ids int32."""
+    return int((n - 1) * int(k_padded)
+               * (4 + int(dim) * _wire_value_bytes(compress_bits)))
+
+
+def dense_ring_bytes(
+    vocab: int, dim: int, n: int, compress_bits: int | None = None
+) -> int:
+    """Bytes each member transmits per dense all-reduce of a [vocab, dim]
+    gradient: reduce-scatter + all-gather each move (n-1) segments of
+    vocab*dim/n values (ring_all_reduce's schedule; psum lowers to the
+    same ring)."""
+    return int(2 * (n - 1) * int(vocab) * int(dim)
+               * _wire_value_bytes(compress_bits) // n)
+
+
+def prefer_sparse_exchange(
+    n: int,
+    k_padded: int,
+    vocab: int,
+    dim: int,
+    sparse_bits: int | None = None,
+    dense_bits: int | None = None,
+    margin: float = 1.0,
+) -> bool:
+    """SparCML's density switch (arXiv:1802.08021 §3: sparse index+value
+    streams until density makes the dense representation cheaper), decided
+    from static shapes: True when the padded sparse payload is cheaper than
+    ``margin`` times the dense ring's bytes.  ``margin < 1`` demands a real
+    win before leaving the dense path (hysteresis against payloads that are
+    only marginally sparse)."""
+    return (sparse_exchange_bytes(n, k_padded, dim, sparse_bits)
+            <= margin * dense_ring_bytes(vocab, dim, n, dense_bits))
+
+
+def _sparse_all_reduce_local(
+    uids: jax.Array,
+    rows: jax.Array,
+    axis_name: str,
+    n: int,
+    average: bool = True,
+    compress_bits: int | None = None,
+    compress_range: float | str = "dynamic",
+    compress_mode: str = "uniform",
+):
+    """Runs per-device under shard_map: this member's deduped ``uids`` [K]
+    (int, padded by repeating id 0) and ``rows`` [K, ...] (summed row
+    gradients, zero at padded slots) against every other member's.
+
+    Returns ``(all_uids, merged)`` with shapes [n*K] / [n*K, ...]:
+    identical on every member.  ``all_uids`` is the sorted union of the
+    members' ids padded by repeating id 0 (``jnp.unique`` fill), and
+    ``merged`` holds each unique id's cross-member segment_sum (mean when
+    ``average``) in its FIRST slot — later duplicate/padded slots carry
+    zero rows, so the pair feeds any ``.add``-based scatter (the
+    ``dedup_grads`` convention) or :func:`~lightctr_tpu.embed.table.\
+sparse_adagrad_update` directly.
+
+    ``compress_bits``: quantile-code the value payload so 1-2 byte codes
+    ride the interconnect instead of fp32 (ids stay int32 — they are the
+    cheap part at CTR dims).  Every member encodes through the same
+    axis-global table and decode happens receiver-side BEFORE the merge,
+    so all members still reconstruct bit-identical merged rows.  Unlike the
+    dense ring there is exactly ONE encode per value per step (no per-hop
+    accumulation), so error feedback is unnecessary here — the codec noise
+    is single-shot, not compounding.
+    """
+    if compress_bits is not None:
+        from lightctr_tpu.ops import quantize
+
+        if compress_range == "dynamic":
+            rng = 1.05 * jax.lax.pmax(jnp.max(jnp.abs(rows)), axis_name)
+            rng = jnp.maximum(rng, 1e-12)
+        else:
+            rng = compress_range
+        table = quantize.build_table(
+            -rng, rng, bits=compress_bits, mode=compress_mode,
+        )
+        codes = jax.lax.all_gather(
+            quantize.compress(table, rows), axis_name, tiled=True
+        )
+        all_rows = quantize.extract(table, codes)
+    else:
+        all_rows = jax.lax.all_gather(rows, axis_name, tiled=True)
+    all_ids = jax.lax.all_gather(uids, axis_name, tiled=True)
+    uniq, inv = jnp.unique(
+        all_ids, return_inverse=True, size=all_ids.shape[0], fill_value=0
+    )
+    merged = jax.ops.segment_sum(
+        all_rows, inv.reshape(-1), num_segments=all_ids.shape[0]
+    )
+    if average:
+        merged = merged / n
+    return uniq, merged
+
+
+def sparse_all_reduce(
+    mesh: Mesh,
+    uids: jax.Array,
+    rows: jax.Array,
+    axis: str = "data",
+    average: bool = True,
+    compress_bits: int | None = None,
+    compress_range: float | str = "dynamic",
+    compress_mode: str = "uniform",
+):
+    """Sparse all-reduce of per-member (ids, row-gradients) pairs.
+
+    ``uids``: [n, K] int ids, one deduped padded slice per mesh member
+    (:func:`~lightctr_tpu.embed.table.dedup_grads` shape conventions);
+    ``rows``: [n, K, ...] the matching summed row values.  Returns stacked
+    ``(all_uids [n, n*K], merged [n, n*K, ...])`` where every member's
+    slice is the identical merged union — O(touched) bytes on the wire
+    instead of the dense ring's O(vocab) (see
+    :func:`prefer_sparse_exchange` for when to switch back).
+    """
+    n = mesh.shape[axis]
+
+    def local(u, r):
+        gu, m = _sparse_all_reduce_local(
+            u[0], r[0], axis, n, average=average,
+            compress_bits=compress_bits, compress_range=compress_range,
+            compress_mode=compress_mode,
+        )
+        return gu[None], m[None]
+
+    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
+                   out_specs=(P(axis), P(axis)))
+    return fn(uids, rows)
 
 
 def psum_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):

@@ -204,8 +204,14 @@ class MasterService:
         with self._admin_lock:
             for w in sorted(self.monitor.dead_workers()):
                 wid = self._to_wid(w)
-                if wid is not None:
-                    self._deliver(shard, "unroute", wid)
+                if wid is not None and not self._deliver(shard, "unroute", wid):
+                    # the returning shard wedged mid-resync (it may keep
+                    # heartbeating): queue the miss exactly like
+                    # _broadcast does so the next successful contact
+                    # replays it — an abandoned delivery would leave the
+                    # shard serving workers the master holds dead until
+                    # some unrelated dead/return transition
+                    self._pending[shard].append(("unroute", wid))
         left = self.flush_pending()
         logging.getLogger(__name__).warning(
             "PS shard %d returned; resynced dead-set + replayed missed "

@@ -199,12 +199,38 @@ class AsyncParamServer:
         for k, s in zip(new_keys.tolist(), sl.tolist()):
             self._slot[k] = s
         self._n += m
-        # NOTE: the sorted lookup snapshot (_key_cache) stays valid —
-        # slots are immutable, so it is merely incomplete; _slots_create
-        # resolves post-snapshot keys through the dict and folds the
-        # pending batch below into the snapshot when drift accumulates
-        self._pending.append((new_keys, sl))
+        # The sorted lookup snapshot (_key_cache) stays valid — slots are
+        # immutable, so it is merely incomplete; post-snapshot batches
+        # queue here until the drift passes the merge bound.  Without a
+        # snapshot there is nothing to queue FOR (the first build walks
+        # the whole dict), so skip the append — a small-batch workload
+        # that never reaches the vectorized lookup would otherwise
+        # accumulate (keys, slots) pairs forever (ADVICE.md round 5) —
+        # and bound the queue HERE, not only in the >=4096-key lookup
+        # path, so _pending cannot outgrow the drift bound no matter
+        # which call pattern allocates.
+        if self._key_cache is not None:
+            self._pending.append((new_keys, sl))
+            if (len(self._slot) - len(self._key_cache[0])
+                    > max(4096, len(self._key_cache[0]) // 8)):
+                self._merge_pending()
         return sl
+
+    def _merge_pending(self) -> None:
+        """Fold the post-snapshot allocation queue into the sorted lookup
+        snapshot with one sorted-merge ``np.insert`` — O(n) memcpy, no
+        dict walk / full argsort (the p99 spikes of the rebuild-from-dict
+        form were ~10x the p50).  No-op when there is no snapshot."""
+        if self._key_cache is None or not self._pending:
+            return
+        sk, sv = self._key_cache
+        pk = np.concatenate([k for k, _ in self._pending])
+        pv = np.concatenate([s for _, s in self._pending])
+        order = np.argsort(pk)
+        pk, pv = pk[order], pv[order]
+        pos = np.searchsorted(sk, pk)
+        self._key_cache = (np.insert(sk, pos, pk), np.insert(sv, pos, pv))
+        self._pending = []
 
     def _slot_for_set(self, key: int) -> int:
         """Slot for a direct row assignment: allocate zero-filled, no RNG."""
@@ -238,32 +264,23 @@ class AsyncParamServer:
             # lazy-init workload that allocates on every request must not
             # pay an O(n_keys) rebuild per request — measured 49ms p50
             # pulls at 2^20 vocab under rebuild-on-every-alloc).
-            sk, sv = self._key_cache if self._key_cache is not None else (
-                np.empty(0, np.int64), np.empty(0, np.int64))
-            if (self._key_cache is None
-                    or len(self._slot) - len(sk) > max(4096, len(sk) // 8)):
-                if self._key_cache is None:
-                    # first build: one dict walk
-                    sk = np.fromiter(self._slot.keys(), np.int64,
-                                     count=len(self._slot))
-                    sv = np.fromiter(self._slot.values(), np.int64,
-                                     count=len(self._slot))
-                    order = np.argsort(sk)
-                    sk, sv = sk[order], sv[order]
-                else:
-                    # incremental: fold the post-snapshot allocations in
-                    # with one sorted-merge np.insert — O(n) memcpy, no
-                    # dict walk / full argsort (the p99 spikes of the
-                    # rebuild-from-dict form were ~10x the p50)
-                    pk = np.concatenate([k for k, _ in self._pending])
-                    pv = np.concatenate([s for _, s in self._pending])
-                    order = np.argsort(pk)
-                    pk, pv = pk[order], pv[order]
-                    pos = np.searchsorted(sk, pk)
-                    sk = np.insert(sk, pos, pk)
-                    sv = np.insert(sv, pos, pv)
-                self._key_cache = (sk, sv)
+            if self._key_cache is None:
+                # first build: one dict walk
+                sk = np.fromiter(self._slot.keys(), np.int64,
+                                 count=len(self._slot))
+                sv = np.fromiter(self._slot.values(), np.int64,
+                                 count=len(self._slot))
+                order = np.argsort(sk)
+                self._key_cache = (sk[order], sv[order])
                 self._pending = []
+            elif (len(self._slot) - len(self._key_cache[0])
+                    > max(4096, len(self._key_cache[0]) // 8)):
+                # incremental: fold queued post-snapshot allocations in
+                # (_merge_pending; _alloc_slots also merges eagerly at
+                # this same bound, so the queue stays bounded even for
+                # workloads that never reach this vectorized path)
+                self._merge_pending()
+            sk, sv = self._key_cache
             if len(sk):
                 pos = np.searchsorted(sk, keys)
                 pos_c = np.minimum(pos, len(sk) - 1)
@@ -374,7 +391,9 @@ class AsyncParamServer:
         self, worker_id: int, slots: np.ndarray, g: np.ndarray
     ) -> None:
         """One vectorized updater step over a batch of unique slots
-        (paramserver.h:252-300)."""
+        (paramserver.h:252-300).  Uniqueness is validated by push_batch
+        BEFORE any state mutation — every call here carries unique
+        slots."""
         if self.updater == "sgd":
             self._W[slots] -= self.lr * g
         elif self.updater == "adagrad":
@@ -441,10 +460,27 @@ class AsyncParamServer:
         wire sends sorted-unique key streams); one fancy-indexed updater
         step instead of a per-key Python loop."""
         with self._lock:
+            keys_arr = np.ascontiguousarray(keys, np.int64)
+            # UNIQUE is a hard contract, enforced server-side BEFORE any
+            # state mutation (the staleness ledger must not advance and
+            # no rows may lazily allocate for a push that is rejected):
+            # on a duplicate slot the numpy fancy-assign updaters are
+            # last-write-wins (one update per slot) while the native
+            # kernel (ps_rows.cpp) accumulates every occurrence — a
+            # violating caller must fail loud here, not silently diverge
+            # between the two branches.  One sort + diff over int64 keys
+            # is noise next to the dim-wide row updates.
+            if keys_arr.size > 1:
+                srt = np.sort(keys_arr)
+                if np.any(np.diff(srt) == 0):
+                    raise ValueError(
+                        "push carries duplicate keys: per-push keys must "
+                        "be unique (batch duplicate-key gradients are "
+                        "summed client-side, push.h:55-66)"
+                    )
             if not self._push_gate(worker_id, worker_epoch):
                 return False
-            if len(keys):
-                keys_arr = np.ascontiguousarray(keys, np.int64)
+            if keys_arr.size:
                 g = np.asarray(grads, np.float32).reshape(-1, self.dim)
                 self._apply(worker_id, self._slots_create(keys_arr), g)
             return True

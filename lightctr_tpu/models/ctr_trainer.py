@@ -192,18 +192,20 @@ class CTRTrainer:
             self.params = jax.device_put(self.params, self._param_sharding)
         if zero_sharded or compress_bits is not None:
             # both flows flatten the params and pad to a multiple of the
-            # ring size (mutually exclusive flags, one computation)
+            # ring size; the compressed ring covers only the leaves
+            # _ring_tree keeps on it (hybrid subclasses exchange table
+            # leaves through the sparse path instead)
             from jax.flatten_util import ravel_pytree
 
-            flat, unravel = ravel_pytree(self.params)
             n = mesh.shape["data"]
-            pad = ((flat.shape[0] + n - 1) // n) * n
             if zero_sharded:
+                flat, unravel = ravel_pytree(self.params)
                 self._zero_unravel = unravel
                 self._zero_len = flat.shape[0]
-                self._zero_pad = pad
+                self._zero_pad = ((flat.shape[0] + n - 1) // n) * n
             else:
-                self._ring_pad = pad
+                flat, _ = ravel_pytree(self._ring_tree(self.params))
+                self._ring_pad = ((flat.shape[0] + n - 1) // n) * n
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
@@ -220,6 +222,14 @@ class CTRTrainer:
         if self.zero_sharded:
             return self._make_zero_step()
         return self._make_step()
+
+    def _ring_tree(self, params):
+        """The param subtree whose gradients ride the dense (compressed)
+        ring exchange — everything, by default.  Hybrid subclasses
+        (Parallax's split, arXiv:1808.02621: dense variables over the ring,
+        sparse variables over an index+value exchange) override this to
+        exclude the leaves they exchange sparsely."""
+        return params
 
     def _make_loss_fn(self):
         lambda_l2 = self.cfg.lambda_l2
@@ -290,7 +300,7 @@ class CTRTrainer:
         ``all_gather`` of the new parameters.  One shard_map program; both
         collectives ride the ICI ring."""
         from jax.flatten_util import ravel_pytree
-        from jax import shard_map
+        from lightctr_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         loss_fn = self._make_loss_fn()
@@ -380,7 +390,7 @@ class CTRTrainer:
                                         residual=new_res[None])
             return params, state, loss
 
-        from jax import shard_map
+        from lightctr_tpu.core.compat import shard_map
 
         state_spec = CompressedRingState(inner=P(), residual=P("data"))
         return shard_map(

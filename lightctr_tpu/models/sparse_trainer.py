@@ -34,8 +34,39 @@ batches, and PS-style ``param_shardings`` (tables row-sharded over the
 ``embed`` axis: the touched-row gather/scatter compose with GSPMD — XLA
 inserts the cross-shard collectives around the O(touched) row ops, which
 is exactly the reference's worker→PS-shard pull/push topology,
-pull.h:50-99 / distributed_algo_abst.h:176-280).  ``compress_bits`` keeps
-the dense trainer (the ring codec path assumes replicated params).
+pull.h:50-99 / distributed_algo_abst.h:176-280).
+
+Multi-device replicated data parallelism (``mesh`` given, no
+``param_shardings``) runs an EXPLICIT hybrid exchange instead of letting
+XLA psum the dense [vocab, dim] table gradients — Parallax's split by
+variable type (arXiv:1808.02621) fused with SparCML's sparse allreduce
+(arXiv:1802.08021), per step, one shard_map program:
+
+  - each replica dedups its LOCAL batch shard's ids and differentiates
+    w.r.t. its gathered rows (O(touched) as above);
+  - table-leaf gradients ride ``sparse_all_reduce``: one all_gather of
+    (uids, g_rows) pairs — O(touched) ids+values on the interconnect
+    instead of the dense ring's O(vocab) — merged across replicas with a
+    segment_sum; every replica then applies the IDENTICAL
+    ``sparse_adagrad_update`` on the merged union, so replicas cannot
+    diverge;
+  - per table, a static trace-time density switch
+    (``prefer_sparse_exchange``) falls back to the dense (optionally
+    quantized) ring when the padded sparse payload would cost more than
+    the [vocab, dim] buffer — SparCML's dense switch-over, so the worst
+    case never regresses.  The taken decision is recorded in
+    ``self.exchange_policy`` ({table: "sparse" | "dense"});
+  - dense leaves keep the existing exchange: the quantile-compressed
+    explicit ring when ``compress_bits`` is set (EF-SGD residual and all,
+    exactly CTRTrainer's compressed path), a plain psum mean otherwise.
+    With ``compress_bits`` the sparse value payload is quantile-coded
+    too — but single-shot (one encode per value per step, decoded before
+    the merge), so it needs no error feedback: unlike the ring there is
+    no per-hop noise accumulation.
+
+The exchanged trajectory matches the dense-psum data-parallel trainer to
+fp32 tolerance (parity-tested): merged mean row gradients equal the dense
+mean gradient's touched rows, and untouched rows move in neither world.
 
 Platform note: the step donates (params, opt_state), so on accelerators
 the row scatters update the tables in place and the step is truly
@@ -50,6 +81,7 @@ from typing import Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from lightctr_tpu.embed.table import SparseAdagradState, sparse_adagrad_update
 from lightctr_tpu.models.ctr_trainer import CTRTrainer
@@ -64,6 +96,15 @@ class SparseTableCTRTrainer(CTRTrainer):
         leaves that are [rows, ...] tables indexed ONLY via ``jnp.take``
         with the listed batch fields (e.g. Wide&Deep:
         ``{"w": ["fids"], "embed": ["rep_fids"]}``).
+    compress_bits / compress_range / compress_mode / error_feedback:
+        as in CTRTrainer, applied to the HYBRID multi-device exchange
+        (mesh given, replicated params): dense leaves ride the compressed
+        explicit ring, table leaves' sparse value payloads are coded with
+        the same table (single-shot, no EF needed — see module docstring).
+    dense_switch_margin: scale on the SparCML density switch — a table
+        leaf takes the sparse exchange only while its padded sparse bytes
+        stay under ``margin * dense_ring_bytes``; below 1.0 demands a real
+        win before leaving the worst-case-safe dense path.
     """
 
     def __init__(
@@ -77,6 +118,11 @@ class SparseTableCTRTrainer(CTRTrainer):
         mesh=None,
         param_shardings=None,
         eps: float = 1e-7,
+        compress_bits: Optional[int] = None,
+        compress_range: float | str = 1.0,
+        compress_mode: Optional[str] = None,
+        error_feedback: Optional[bool] = None,
+        dense_switch_margin: float = 1.0,
     ):
         if not sparse_tables:
             raise ValueError("sparse_tables must name at least one table leaf")
@@ -101,52 +147,101 @@ class SparseTableCTRTrainer(CTRTrainer):
                     )
                 owner[f] = k
         self._eps = eps
+        self._dense_margin = dense_switch_margin
+        # mesh WITHOUT explicit shardings = replicated data parallelism:
+        # the explicit hybrid exchange replaces XLA's dense psum.  With
+        # param_shardings (embed-axis row sharding) GSPMD owns the
+        # collectives and the single-program step below is kept.
+        self._hybrid_dp = mesh is not None and param_shardings is None
+        # {table: "sparse" | "dense"} — the density-switch decision each
+        # table leaf got at trace time (diagnostics / tests)
+        self.exchange_policy: Dict[str, str] = {}
         super().__init__(
             params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
-            param_shardings=param_shardings,
+            param_shardings=param_shardings, compress_bits=compress_bits,
+            compress_range=compress_range, compress_mode=compress_mode,
+            error_feedback=error_feedback,
         )
 
     # -- state -------------------------------------------------------------
 
+    def _ring_tree(self, params):
+        """Only the dense leaves ride the compressed ring — the table
+        leaves have their own sparse exchange (Parallax's split)."""
+        return {k: v for k, v in params.items() if k not in self._spec}
+
     def _init_opt_state(self, params):
         """Dense leaves get optax state; table leaves get per-row Adagrad
-        accumulators only (never the transient full-size optax state)."""
+        accumulators only (never the transient full-size optax state).
+        With ``compress_bits`` the dense-ring EF residual carry rides along
+        (CTRTrainer's CompressedRingState, flattened into this dict)."""
         dense = {k: v for k, v in params.items() if k not in self._spec}
-        return {
+        state = {
             "dense": self.tx.init(dense),
             "accum": {
                 k: jnp.zeros_like(params[k]) for k in self._spec
             },
         }
+        if self.compress_bits is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            n = self.mesh.shape["data"]
+            residual = jnp.zeros(
+                (n, self._ring_pad if self.error_feedback else 1),
+                jnp.float32,
+            )
+            state["residual"] = jax.device_put(
+                residual, NamedSharding(self.mesh, P("data"))
+            )
+        return state
 
     # -- step --------------------------------------------------------------
+
+    def _build_step(self):
+        """Single-device and GSPMD-sharded configurations keep the one-
+        program O(touched) step; replicated data parallelism takes the
+        explicit hybrid exchange."""
+        if self._hybrid_dp:
+            return self._make_hybrid_dp_step()
+        return self._make_step()
+
+    @staticmethod
+    def _dedup_and_gather(spec, params, batch):
+        """Steps 1-3 of the module recipe: per-table batch-id dedup,
+        position rewrite, and the O(touched) row gather.  Shared by the
+        single-program step and the per-replica hybrid step (where
+        ``batch`` is the replica's local shard)."""
+        tables = {k: params[k] for k in spec}
+        dense = {k: v for k, v in params.items() if k not in spec}
+        batch2 = dict(batch)
+        uids = {}
+        for k, fields in spec.items():
+            ids = jnp.concatenate(
+                [batch[f].reshape(-1) for f in fields]
+            ).astype(jnp.int32)
+            u, inv = jnp.unique(
+                ids, return_inverse=True, size=ids.shape[0], fill_value=0
+            )
+            uids[k] = u
+            ofs = 0
+            for f in fields:
+                m = batch[f].size
+                batch2[f] = inv[ofs:ofs + m].reshape(batch[f].shape)
+                ofs += m
+        rows = {k: jnp.take(tables[k], uids[k], axis=0) for k in spec}
+        return tables, dense, batch2, uids, rows
 
     def _make_step(self):
         loss_fn = self._make_loss_fn()
         tx = self.tx
         spec = self._spec
         lr, eps = self.cfg.learning_rate, self._eps
+        dedup_and_gather = self._dedup_and_gather
 
         def step(params, opt_state, batch):
-            tables = {k: params[k] for k in spec}
-            dense = {k: v for k, v in params.items() if k not in spec}
-
-            batch2 = dict(batch)
-            uids = {}
-            for k, fields in spec.items():
-                ids = jnp.concatenate(
-                    [batch[f].reshape(-1) for f in fields]
-                ).astype(jnp.int32)
-                u, inv = jnp.unique(
-                    ids, return_inverse=True, size=ids.shape[0], fill_value=0
-                )
-                uids[k] = u
-                ofs = 0
-                for f in fields:
-                    n = batch[f].size
-                    batch2[f] = inv[ofs:ofs + n].reshape(batch[f].shape)
-                    ofs += n
-            rows = {k: jnp.take(tables[k], uids[k], axis=0) for k in spec}
+            tables, dense, batch2, uids, rows = dedup_and_gather(
+                spec, params, batch
+            )
 
             def loss_on(rows, dense):
                 return loss_fn({**dense, **rows}, batch2)
@@ -179,3 +274,157 @@ class SparseTableCTRTrainer(CTRTrainer):
             return params, {"dense": new_dense_state, "accum": new_accum}, loss
 
         return step
+
+    def _make_hybrid_dp_step(self):
+        """Replicated data-parallel step with the hybrid explicit exchange
+        (module docstring): per-replica O(touched) grads, table leaves over
+        ``sparse_all_reduce`` (or the dense ring past the density switch),
+        dense leaves over the compressed ring / psum mean.  One shard_map
+        program — jit it whole, exactly like CTRTrainer's compressed step."""
+        from jax.flatten_util import ravel_pytree
+        from jax.sharding import PartitionSpec as P
+
+        from lightctr_tpu.core.compat import shard_map
+        from lightctr_tpu.dist.collectives import (
+            _ring_all_reduce_local,
+            _sparse_all_reduce_local,
+            prefer_sparse_exchange,
+        )
+
+        loss_fn = self._make_loss_fn()
+        tx = self.tx
+        spec = self._spec
+        lr, eps = self.cfg.learning_rate, self._eps
+        dedup_and_gather = self._dedup_and_gather
+        mesh = self.mesh
+        n = mesh.shape["data"]
+        bits = self.compress_bits
+        crange, cmode = self.compress_range, self.compress_mode
+        use_ef = self.error_feedback
+        ring_pad = self._ring_pad if bits is not None else 0
+        margin = self._dense_margin
+        policy = self.exchange_policy  # written at trace time
+
+        def dense_table_exchange(g):
+            """SparCML's switch-over target: the table gradient as one
+            dense buffer over the (optionally quantized) ring.  No EF on
+            this path — it is the worst-case escape hatch; its quantized
+            form matches the plain compressed ring's 16-bit-grade use."""
+            if bits is None:
+                return jax.lax.pmean(g, "data")
+            flat = g.reshape(-1)
+            length = flat.shape[0]
+            padded = ((length + n - 1) // n) * n
+            if padded != length:
+                flat = jnp.pad(flat, (0, padded - length))
+            flat = _ring_all_reduce_local(
+                flat, "data", n, average=True,
+                compress_bits=bits, compress_range=crange,
+                compress_mode=cmode,
+            )
+            return flat[:length].reshape(g.shape)
+
+        def local_step(params, opt_state, batch):
+            # batch arrives as this replica's shard: the dedup below is
+            # per-replica, over O(local touched) ids
+            tables, dense, batch2, uids, rows = dedup_and_gather(
+                spec, params, batch
+            )
+
+            def loss_on(rows, dense):
+                return loss_fn({**dense, **rows}, batch2)
+
+            loss, (g_rows, g_dense) = jax.value_and_grad(
+                loss_on, argnums=(0, 1)
+            )(rows, dense)
+            # replica losses are local means; their mean is the global mean
+            loss = jax.lax.pmean(loss, "data")
+
+            # -- dense leaves: Parallax's ring half -------------------------
+            new_res = opt_state["residual"][0] if bits is not None else None
+            if bits is not None:
+                flat, unravel = ravel_pytree(g_dense)
+                length = flat.shape[0]
+                if length:
+                    if ring_pad != length:
+                        flat = jnp.pad(flat, (0, ring_pad - length))
+                    if use_ef:
+                        flat, new_res = _ring_all_reduce_local(
+                            flat, "data", n, average=True,
+                            compress_bits=bits, compress_range=crange,
+                            residual=new_res, compress_mode=cmode,
+                        )
+                    else:
+                        flat = _ring_all_reduce_local(
+                            flat, "data", n, average=True,
+                            compress_bits=bits, compress_range=crange,
+                            compress_mode=cmode,
+                        )
+                    g_dense = unravel(flat[:length])
+            else:
+                g_dense = jax.tree_util.tree_map(
+                    lambda g: jax.lax.pmean(g, "data"), g_dense
+                )
+
+            updates, new_dense_state = tx.update(
+                g_dense, opt_state["dense"], dense
+            )
+            dense = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), dense, updates
+            )
+
+            # -- table leaves: sparse exchange, dense ring past the switch --
+            new_accum = {}
+            for k in spec:
+                vocab = tables[k].shape[0]
+                dim = int(np.prod(tables[k].shape[1:]))
+                if prefer_sparse_exchange(
+                    n, uids[k].shape[0], vocab, dim,
+                    sparse_bits=bits, dense_bits=bits, margin=margin,
+                ):
+                    policy[k] = "sparse"
+                    gu, merged = _sparse_all_reduce_local(
+                        uids[k], g_rows[k], "data", n, average=True,
+                        compress_bits=bits,
+                        compress_range=crange if bits is not None else 1.0,
+                        compress_mode=cmode,
+                    )
+                    # identical (gu, merged) on every replica -> identical
+                    # update; duplicate ids across replicas were merged by
+                    # the exchange, padded slots carry zero rows (no-op)
+                    tables[k], st = sparse_adagrad_update(
+                        tables[k],
+                        SparseAdagradState(accum=opt_state["accum"][k]),
+                        gu,
+                        merged,
+                        lr,
+                        eps=eps,
+                    )
+                    new_accum[k] = st.accum
+                else:
+                    policy[k] = "dense"
+                    g = jnp.zeros_like(tables[k]).at[uids[k]].add(g_rows[k])
+                    g = dense_table_exchange(g)
+                    # dense elementwise Adagrad without state decay — the
+                    # same trajectory as the sparse recipe (untouched rows
+                    # have g == 0: neither weights nor accum move)
+                    acc = opt_state["accum"][k] + g * g
+                    tables[k] = tables[k] - lr * g * jax.lax.rsqrt(acc + eps)
+                    new_accum[k] = acc
+
+            params = {**dense, **tables}
+            new_state = {"dense": new_dense_state, "accum": new_accum}
+            if bits is not None:
+                new_state["residual"] = new_res[None]
+            return params, new_state, loss
+
+        state_spec = {"dense": P(), "accum": {k: P() for k in spec}}
+        if bits is not None:
+            state_spec["residual"] = P("data")
+        return shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), state_spec, P("data")),
+            out_specs=(P(), state_spec, P()),
+            check_vma=False,
+        )

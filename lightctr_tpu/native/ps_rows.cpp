@@ -16,9 +16,12 @@
 extern "C" {
 
 // W[slots[i]] and acc[slots[i]] are rows of length dim; g is [n, dim]
-// dense in batch order.  slots may repeat only if the caller allows
-// (pushes carry unique keys; repeated slots would under-accumulate in
-// the numpy path too, so semantics match).
+// dense in batch order.  slots MUST be unique: this loop applies every
+// occurrence of a repeated slot sequentially, while the store's numpy
+// fallback (fancy-index assignment) is last-write-wins — the two
+// branches would silently diverge.  The store asserts unique keys
+// server-side in push_batch (async_ps.py), before any state mutation,
+// so a contract-violating push fails loud before reaching either branch.
 void rows_adagrad(float* W, float* acc, const int64_t* slots,
                   const float* g, int64_t n, int64_t dim,
                   float lr, float eps) {

@@ -25,7 +25,7 @@ WORKER = textwrap.dedent(
                          num_processes=2, process_id=pid)
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from lightctr_tpu.core.compat import shard_map
     from jax.experimental import multihost_utils
     from jax.sharding import Mesh, PartitionSpec as P
     assert jax.device_count() == 4 and jax.local_device_count() == 2

@@ -1,0 +1,269 @@
+"""Sparsity-aware gradient exchange (SparCML arXiv:1802.08021 / Parallax
+arXiv:1808.02621): O(touched) multi-member allreduce of (uids, g_rows)
+pairs, the density switch back to the dense ring, and the hybrid
+data-parallel SparseTableCTRTrainer mode — all on the 8-device virtual
+mesh (XLA_FLAGS=--xla_force_host_platform_device_count, conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+from lightctr_tpu.dist import (
+    dense_ring_bytes,
+    prefer_sparse_exchange,
+    sparse_all_reduce,
+    sparse_exchange_bytes,
+)
+from lightctr_tpu.models import fm, widedeep
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer
+
+N = 8  # mesh size (conftest pins 8 virtual CPU devices)
+
+
+def dense_scatter(vocab, dim, uids, rows):
+    """Reference oracle: the [vocab, dim] array the (uids, rows) pair
+    denotes under .add scatter semantics."""
+    out = np.zeros((vocab, dim), np.float32)
+    np.add.at(out, np.asarray(uids).reshape(-1),
+              np.asarray(rows).reshape(-1, dim))
+    return out
+
+
+def test_sparse_all_reduce_matches_dense_mean(rng):
+    """The merged union equals the dense mean gradient — with ids shared
+    across members (duplicate-key merge) and ids unique to one member."""
+    mesh = make_mesh(MeshSpec(data=N))
+    vocab, K, dim = 128, 16, 5
+    # force heavy cross-member overlap: ids drawn from a small pool
+    uids = rng.integers(0, 32, size=(N, K)).astype(np.int32)
+    rows = rng.normal(size=(N, K, dim)).astype(np.float32)
+    gu, merged = sparse_all_reduce(mesh, jnp.asarray(uids), jnp.asarray(rows))
+    want = sum(dense_scatter(vocab, dim, uids[m], rows[m])
+               for m in range(N)) / N
+    for d in range(N):
+        got = dense_scatter(vocab, dim, np.asarray(gu)[d],
+                            np.asarray(merged)[d])
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # every member must hold the IDENTICAL merged pair (replicas that
+    # apply it cannot diverge)
+    np.testing.assert_array_equal(
+        np.asarray(gu), np.tile(np.asarray(gu)[:1], (N, 1))
+    )
+    np.testing.assert_allclose(
+        np.asarray(merged), np.tile(np.asarray(merged)[:1], (N, 1, 1)),
+        rtol=0, atol=0,
+    )
+
+
+def test_sparse_all_reduce_sum_mode_and_padding_noop(rng):
+    """Padded slots (repeated id 0, zero rows — the dedup_grads
+    convention) must contribute nothing, including when id 0 is also a
+    REAL touched id on another member."""
+    mesh = make_mesh(MeshSpec(data=N))
+    vocab, K, dim = 64, 8, 3
+    uids = np.zeros((N, K), np.int32)
+    rows = np.zeros((N, K, dim), np.float32)
+    # member 0: one real id-0 row plus padding; others: two real ids + pad
+    rows[0, 0] = 1.0
+    for m in range(1, N):
+        uids[m, 0], uids[m, 1] = 2 * m, 2 * m + 1
+        rows[m, 0], rows[m, 1] = m, -m
+    gu, merged = sparse_all_reduce(
+        mesh, jnp.asarray(uids), jnp.asarray(rows), average=False
+    )
+    want = sum(dense_scatter(vocab, dim, uids[m], rows[m]) for m in range(N))
+    got = dense_scatter(vocab, dim, np.asarray(gu)[0], np.asarray(merged)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_all_reduce_compressed_payload(rng):
+    """Quantile-coded value payload (ids ride int32): single-shot codec,
+    so the merged result lands within one-bucket noise of exact."""
+    mesh = make_mesh(MeshSpec(data=N))
+    K, dim = 16, 4
+    uids = rng.integers(0, 64, size=(N, K)).astype(np.int32)
+    rows = rng.normal(size=(N, K, dim)).astype(np.float32)
+    exact_u, exact_m = sparse_all_reduce(
+        mesh, jnp.asarray(uids), jnp.asarray(rows)
+    )
+    gu, merged = sparse_all_reduce(
+        mesh, jnp.asarray(uids), jnp.asarray(rows),
+        compress_bits=16, compress_range="dynamic",
+    )
+    np.testing.assert_array_equal(np.asarray(gu), np.asarray(exact_u))
+    # 16-bit uniform buckets over |rows|<~4: per-value error ~1e-4, the
+    # merge averages N single-shot codes
+    np.testing.assert_allclose(
+        np.asarray(merged), np.asarray(exact_m), rtol=0, atol=5e-4
+    )
+
+
+def test_density_switch_policy_boundary():
+    """The static SparCML switch: sparse wins at huge vocab, loses once
+    the padded payload outweighs the dense ring buffer."""
+    n, k, dim = N, 512, 8
+    # transmitted-bytes model: (n-1)*k*(4+dim*4) vs 2*(n-1)*vocab*dim*4/n
+    boundary = n * k * (4 + dim * 4) // (2 * dim * 4)
+    assert prefer_sparse_exchange(n, k, 1 << 20, dim)
+    assert not prefer_sparse_exchange(n, k, 64, dim)
+    assert prefer_sparse_exchange(n, k, boundary + 1, dim)
+    assert not prefer_sparse_exchange(n, k, boundary - 1, dim)
+    # compressed payloads shrink the sparse side, moving the boundary down
+    assert sparse_exchange_bytes(n, k, dim, compress_bits=8) < \
+        sparse_exchange_bytes(n, k, dim)
+    assert dense_ring_bytes(1 << 16, dim, n, compress_bits=8) < \
+        dense_ring_bytes(1 << 16, dim, n)
+
+
+def fm_batch(rng, n=64, f=4096, nnz=6):
+    return {
+        "fids": rng.integers(0, f, size=(n, nnz)).astype(np.int32),
+        "fields": np.zeros((n, nnz), np.int32),
+        "vals": np.ones((n, nnz), np.float32),
+        "mask": np.ones((n, nnz), np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+
+
+def test_hybrid_dp_trainer_matches_dense_psum(rng):
+    """The acceptance parity: the sparse-exchange data-parallel trajectory
+    == the dense-psum data-parallel trajectory (same model, same batches)
+    to fp32 tolerance, with the sparse path actually taken."""
+    f = 4096
+    batch = fm_batch(rng, f=f)
+    params = fm.init(jax.random.PRNGKey(0), f, 4)
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    mesh = make_mesh(MeshSpec(data=N))
+    dense_tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2,
+                          mesh=mesh)
+    sparse_tr = SparseTableCTRTrainer(
+        params, fm.logits, cfg, sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2, mesh=mesh,
+    )
+    ld = dense_tr.fit_fullbatch_scan(batch, 12)
+    ls = sparse_tr.fit_fullbatch_scan(batch, 12)
+    assert sparse_tr.exchange_policy == {"w": "sparse", "v": "sparse"}
+    np.testing.assert_allclose(ls, ld, rtol=1e-5, atol=1e-6)
+    for k in ("w", "v"):
+        np.testing.assert_allclose(
+            np.asarray(sparse_tr.params[k]), np.asarray(dense_tr.params[k]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_hybrid_dp_dense_switchover_matches_too(rng):
+    """Past the density boundary (tiny vocab) every table leaf falls back
+    to the dense exchange — the worst case must not regress, and the
+    trajectory stays identical."""
+    f = 32
+    batch = fm_batch(rng, f=f)
+    params = fm.init(jax.random.PRNGKey(0), f, 4)
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    mesh = make_mesh(MeshSpec(data=N))
+    dense_tr = CTRTrainer(params, fm.logits, cfg, fused_fn=fm.logits_with_l2,
+                          mesh=mesh)
+    sparse_tr = SparseTableCTRTrainer(
+        params, fm.logits, cfg, sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2, mesh=mesh,
+    )
+    ld = dense_tr.fit_fullbatch_scan(batch, 12)
+    ls = sparse_tr.fit_fullbatch_scan(batch, 12)
+    assert sparse_tr.exchange_policy == {"w": "dense", "v": "dense"}
+    np.testing.assert_allclose(ls, ld, rtol=1e-5, atol=1e-6)
+    for k in ("w", "v"):
+        np.testing.assert_allclose(
+            np.asarray(sparse_tr.params[k]), np.asarray(dense_tr.params[k]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_hybrid_dp_mixed_dense_leaves_parallax_split(rng):
+    """Wide&Deep: the MLP (dense leaves, psum/ring half of the split) and
+    the tables (sparse half) both track the dense-psum trainer."""
+    n, f, field_cnt, nnz, dim = 64, 2048, 4, 6, 8
+    fids = rng.integers(1, f, size=(n, nnz)).astype(np.int32)
+    fields = rng.integers(0, field_cnt, size=(n, nnz)).astype(np.int32)
+    mask = np.ones((n, nnz), np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask,
+                                                   field_cnt)
+    batch = {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((n, nnz), np.float32), "mask": mask,
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+        "rep_fids": rep, "rep_mask": rep_mask,
+    }
+    params = widedeep.init(jax.random.PRNGKey(1), f, field_cnt, dim)
+    cfg = TrainConfig(learning_rate=0.1)
+    mesh = make_mesh(MeshSpec(data=N))
+    dense_tr = CTRTrainer(params, widedeep.logits, cfg, mesh=mesh)
+    sparse_tr = SparseTableCTRTrainer(
+        params, widedeep.logits, cfg,
+        sparse_tables={"w": ["fids"], "embed": ["rep_fids"]}, mesh=mesh,
+    )
+    ld = dense_tr.fit_fullbatch_scan(batch, 10)
+    ls = sparse_tr.fit_fullbatch_scan(batch, 10)
+    assert sparse_tr.exchange_policy == {"w": "sparse", "embed": "sparse"}
+    np.testing.assert_allclose(ls, ld, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(sparse_tr.params["embed"]),
+        np.asarray(dense_tr.params["embed"]), rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(sparse_tr.params["fc1"]["w"]),
+        np.asarray(dense_tr.params["fc1"]["w"]), rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_hybrid_dp_compressed_converges(rng):
+    """compress_bits engages BOTH halves of the hybrid (coded ring on the
+    MLP with EF-SGD, single-shot-coded sparse value payload) and must
+    still descend to the exact run's neighborhood."""
+    n, f, field_cnt, nnz, dim = 64, 2048, 4, 6, 8
+    fids = rng.integers(1, f, size=(n, nnz)).astype(np.int32)
+    fields = rng.integers(0, field_cnt, size=(n, nnz)).astype(np.int32)
+    mask = np.ones((n, nnz), np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask,
+                                                   field_cnt)
+    batch = {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((n, nnz), np.float32), "mask": mask,
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+        "rep_fids": rep, "rep_mask": rep_mask,
+    }
+    params = widedeep.init(jax.random.PRNGKey(1), f, field_cnt, dim)
+    cfg = TrainConfig(learning_rate=0.1)
+    mesh = make_mesh(MeshSpec(data=N))
+    exact = SparseTableCTRTrainer(
+        params, widedeep.logits, cfg,
+        sparse_tables={"w": ["fids"], "embed": ["rep_fids"]}, mesh=mesh,
+    )
+    coded = SparseTableCTRTrainer(
+        params, widedeep.logits, cfg,
+        sparse_tables={"w": ["fids"], "embed": ["rep_fids"]}, mesh=mesh,
+        compress_bits=8, compress_range="dynamic",
+    )
+    le = exact.fit_fullbatch_scan(batch, 12)
+    lc = coded.fit_fullbatch_scan(batch, 12)
+    assert lc[-1] < le[0], (lc[-1], le[0])
+    assert abs(lc[-1] - le[-1]) < 0.05, (lc[-1], le[-1])
+
+
+def test_hybrid_dp_minibatch_train_step(rng):
+    """The non-scan entry point (train_step over host minibatches) runs
+    the same shard_map program; losses must strictly improve on a fixed
+    batch and params stay finite."""
+    f = 1024
+    batch = fm_batch(rng, n=64, f=f)
+    params = fm.init(jax.random.PRNGKey(0), f, 4)
+    cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+    mesh = make_mesh(MeshSpec(data=N))
+    tr = SparseTableCTRTrainer(
+        params, fm.logits, cfg, sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2, mesh=mesh,
+    )
+    losses = [float(tr.train_step(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(np.asarray(tr.params["v"])).all()

@@ -86,6 +86,8 @@ def test_sparse_step_is_o_touched(rng):
     def flops(tr):
         args = (tr.params, tr.opt_state, tr._put(batch))
         cost = tr._step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # pre-0.6 jax wraps in a list
+            cost = cost[0] if cost else {}
         return cost.get("flops", 0.0)
 
     f_dense = flops(CTRTrainer(params, fm.logits, cfg))

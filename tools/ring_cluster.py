@@ -81,7 +81,7 @@ def worker_main(pid: int, port: int, data_path: str, out_dir: str,
 
     import jax.numpy as jnp
     import optax
-    from jax import shard_map
+    from lightctr_tpu.core.compat import shard_map
     from jax.experimental import multihost_utils
     from jax.flatten_util import ravel_pytree
     from jax.sharding import Mesh, PartitionSpec as P

@@ -1,0 +1,179 @@
+"""Sparse vs dense gradient exchange under data parallelism — the
+O(touched) vs O(vocab) evidence artifact.
+
+A Criteo-like batch touches a few thousand rows of a 2^20-row table, yet
+the dense data-parallel exchange ships the whole [vocab, dim] gradient
+every step.  This bench sweeps the vocabulary (density = touched/vocab)
+on the 8-member virtual mesh and reports, per table leaf:
+
+  - bytes/step each member transmits under the sparse (uids, g_rows)
+    exchange (``dist.collectives.sparse_all_reduce``) — constant in
+    vocab, scaling only with the batch's touched rows;
+  - bytes/step under the dense ring/psum exchange — linear in vocab;
+  - the SparCML-style static switch decision the hybrid trainer takes
+    (``prefer_sparse_exchange`` / ``SparseTableCTRTrainer.exchange_policy``);
+  - measured examples/s for both trainers and the max loss-trajectory
+    divergence between them over the timed steps (step-level parity).
+
+Run:  python -m tools.sparse_ring_bench [--steps 4] [--out SPARSE_RING_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from lightctr_tpu.utils.devicecheck import pin_cpu_platform  # noqa: E402
+
+N_DEV = int(os.environ.get("SPARSE_BENCH_DEVS", "8"))
+pin_cpu_platform(N_DEV)
+
+import jax  # noqa: E402
+
+from lightctr_tpu import TrainConfig  # noqa: E402
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh  # noqa: E402
+from lightctr_tpu.dist import (  # noqa: E402
+    dense_ring_bytes,
+    sparse_exchange_bytes,
+)
+from lightctr_tpu.models import widedeep  # noqa: E402
+from lightctr_tpu.models.ctr_trainer import CTRTrainer  # noqa: E402
+from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer  # noqa: E402
+
+# Criteo-shaped workload: 39 fields, a categorical id per field
+N_FIELDS = 39
+DIM = 16
+BATCH = 2048
+
+
+def synth_batch(rng, vocab: int):
+    fids = rng.integers(0, vocab, size=(BATCH, N_FIELDS)).astype(np.int32)
+    fields = np.tile(np.arange(N_FIELDS, dtype=np.int32), (BATCH, 1))
+    mask = np.ones((BATCH, N_FIELDS), np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask,
+                                                   N_FIELDS)
+    return {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((BATCH, N_FIELDS), np.float32), "mask": mask,
+        "labels": (rng.random(BATCH) > 0.5).astype(np.float32),
+        "rep_fids": rep, "rep_mask": rep_mask,
+    }
+
+
+def timed_steps(tr, batch, steps: int):
+    """examples/s over ``steps`` post-compile steps plus the loss at each
+    (the parity trace)."""
+    losses = [float(tr.train_step(batch))]  # compile + step 0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(tr.train_step(batch)))
+    wall = time.perf_counter() - t0
+    return BATCH * steps / wall, losses
+
+
+def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
+        vocab_sweep=(1 << 14, 1 << 16, 1 << 18, 1 << 20)):
+    rng = np.random.default_rng(0)
+    mesh = make_mesh(MeshSpec(data=N_DEV))
+    tables = {"w": ["fids"], "embed": ["rep_fids"]}
+    sweep = []
+    for vocab in vocab_sweep:
+        batch = synth_batch(rng, vocab)
+        params = widedeep.init(jax.random.PRNGKey(0), vocab, N_FIELDS, DIM)
+        cfg = TrainConfig(learning_rate=0.05)
+
+        # per-member padded id counts (the jit-static sparse payload size)
+        k_w = batch["fids"].size // N_DEV
+        k_e = batch["rep_fids"].size // N_DEV
+        touched = {"w": int(np.unique(batch["fids"]).size),
+                   "embed": int(np.unique(batch["rep_fids"]).size)}
+        sparse_b = {"w": sparse_exchange_bytes(N_DEV, k_w, 1),
+                    "embed": sparse_exchange_bytes(N_DEV, k_e, DIM)}
+        dense_b = {"w": dense_ring_bytes(vocab, 1, N_DEV),
+                   "embed": dense_ring_bytes(vocab, DIM, N_DEV)}
+        sparse_b["total"] = sparse_b["w"] + sparse_b["embed"]
+        dense_b["total"] = dense_b["w"] + dense_b["embed"]
+
+        sparse_tr = SparseTableCTRTrainer(
+            params, widedeep.logits, cfg, sparse_tables=tables, mesh=mesh)
+        dense_tr = CTRTrainer(params, widedeep.logits, cfg, mesh=mesh)
+        ex_s_sparse, l_sparse = timed_steps(sparse_tr, batch, steps)
+        ex_s_dense, l_dense = timed_steps(dense_tr, batch, steps)
+
+        sweep.append({
+            "vocab": vocab,
+            "global_batch": BATCH,
+            "touched_rows": touched,
+            "density": round(touched["w"] / vocab, 6),
+            "padded_ids_per_member": {"w": k_w, "embed": k_e},
+            "bytes_per_step_per_member": {
+                "sparse_exchange": sparse_b,
+                "dense_ring": dense_b,
+                "sparse_exchange_int8": {
+                    "total": sparse_exchange_bytes(N_DEV, k_w, 1, 8)
+                    + sparse_exchange_bytes(N_DEV, k_e, DIM, 8)},
+            },
+            "reduction_x": round(dense_b["total"] / sparse_b["total"], 2),
+            "exchange_policy": dict(sparse_tr.exchange_policy),
+            "examples_per_sec": {
+                "sparse_exchange": round(ex_s_sparse, 1),
+                "dense_psum": round(ex_s_dense, 1),
+            },
+            "max_loss_diff_vs_dense_psum": float(
+                np.max(np.abs(np.asarray(l_sparse) - np.asarray(l_dense)))),
+        })
+        print(f"vocab=2^{vocab.bit_length() - 1}: "
+              f"sparse {sparse_b['total']:,} B/step vs dense "
+              f"{dense_b['total']:,} B/step ({sweep[-1]['reduction_x']}x), "
+              f"{ex_s_sparse:,.0f} vs {ex_s_dense:,.0f} ex/s, "
+              f"policy={sweep[-1]['exchange_policy']}", flush=True)
+
+    criteo_like = sweep[-1]
+    report = {
+        "metric": "sparse_exchange_bytes_reduction_at_criteo_density",
+        "value": criteo_like["reduction_x"],
+        "unit": "x fewer bytes/step/member vs dense ring",
+        "platform": jax.devices()[0].platform,
+        "topology": f"{N_DEV}-member data-parallel mesh "
+                    "(xla_force_host_platform_device_count)",
+        "model": f"widedeep vocab-sweep, dim={DIM}, batch={BATCH}, "
+                 f"{N_FIELDS} fields",
+        "note": "sparse bytes are constant in vocab (they scale with the "
+                "batch's touched rows); dense bytes are linear in vocab. "
+                "examples/s on the CPU host mesh understates the win: XLA's "
+                "CPU backend does not honor donation, so both trainers pay "
+                "an O(vocab) table copy per step (sparse_trainer.py "
+                "platform note).",
+        "sweep": sweep,
+    }
+    print(json.dumps({k: v for k, v in report.items() if k != "sweep"},
+                     indent=1))
+    assert criteo_like["reduction_x"] >= 10.0, (
+        "sparse exchange must beat the dense ring >=10x at Criteo-like "
+        f"density, got {criteo_like['reduction_x']}x"
+    )
+    assert criteo_like["max_loss_diff_vs_dense_psum"] < 1e-4, criteo_like
+    if out:
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1)
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--out", default="SPARSE_RING_BENCH.json")
+    args = ap.parse_args()
+    run(steps=args.steps, out=args.out)
+
+
+if __name__ == "__main__":
+    main()
