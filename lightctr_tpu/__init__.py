@@ -19,6 +19,7 @@ nn      dense, conv, pooling, adapter, VAE sample, LSTM, attention modules
 models  FM, FFM, NFM, Wide&Deep, CNN, RNN, VAE, word2vec, GBM, GMM, PLSA, ANN
 embed   sharded embedding tables (the parameter-server capability)
 dist    data-parallel & collective utilities, multi-host bootstrap
+obs     telemetry: metrics registry, JSONL event log, wire-level stats
 data    libFFM / dense CSV loaders with host sharding
 ckpt    orbax-backed checkpoint / resume
 cli     single entry point replacing the reference's ``-D`` ifdef tree

@@ -28,6 +28,9 @@ from lightctr_tpu.dist.bootstrap import (
 )
 from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.obs import emit_event
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs.registry import labeled
 
 
 # Beats with ids at/above this base are PS-SHARD liveness (shard i beats
@@ -88,6 +91,9 @@ class MasterService:
         # (FIN) must clear the departing worker's routes on the SHARDS,
         # not just here — hence on_farewell.
         self._store = AsyncParamServer(dim=1, n_workers=1)
+        # the master's failover counters live in its store's registry, so
+        # they ride the same MSG_STATS wire op as every shard's telemetry
+        self.registry = self._store.registry
         self._svc = ParamServerService(
             self._store, host=host, port=port, monitor=self.monitor,
             on_farewell=self._broadcast_readmit_wid,
@@ -145,6 +151,8 @@ class MasterService:
             if not self._deliver(i, p_op, p_wid):
                 return False
             pending.pop(0)
+            if obs_gate.enabled():
+                self.registry.inc("master_replayed_decisions_total")
         return True
 
     def _broadcast(self, op: str, wid: int) -> None:
@@ -153,17 +161,29 @@ class MasterService:
         on the next successful contact — monitor transitions fire exactly
         once, so an abandoned delivery would leave that shard's routing
         permanently diverged from the master's view."""
+        telem = obs_gate.enabled()
         with self._admin_lock:
             for i in range(len(self._shards)):
                 # missed decisions first: order matters
                 if not self._replay(i) or not self._deliver(i, op, wid):
                     self._pending[i].append((op, wid))
+                    if telem:
+                        self.registry.inc("master_queued_decisions_total")
                     logging.getLogger(__name__).warning(
                         "PS shard %s unreachable: queued %s(%d) for replay "
                         "(%d pending)",
                         self._shard_addresses[i], op, wid,
                         len(self._pending[i]),
                     )
+                elif telem:
+                    self.registry.inc(
+                        labeled("master_admin_ops_total", op=op)
+                    )
+            if telem:
+                self.registry.gauge_set(
+                    "master_pending_decisions",
+                    sum(len(p) for p in self._pending),
+                )
 
     def flush_pending(self) -> int:
         """Replay queued routing decisions against every shard (call after
@@ -176,10 +196,14 @@ class MasterService:
     def _broadcast_unroute(self, worker: str) -> None:
         wid = self._to_wid(worker)
         if wid is not None:
+            emit_event("failover", action="unroute", worker=wid)
             self._broadcast("unroute", wid)
             return
         shard = self._to_shard(worker)
         if shard is not None:
+            if obs_gate.enabled():
+                self.registry.inc("master_shard_deaths_total")
+            emit_event("failover", action="shard_dead", shard=shard)
             logging.getLogger(__name__).warning(
                 "PS shard %d declared dead (heartbeat silence)", shard
             )
@@ -187,6 +211,7 @@ class MasterService:
     def _broadcast_readmit(self, worker: str) -> None:
         wid = self._to_wid(worker)
         if wid is not None:
+            emit_event("failover", action="readmit", worker=wid)
             self._broadcast("readmit", wid)
             return
         shard = self._to_shard(worker)
@@ -213,6 +238,10 @@ class MasterService:
                     # some unrelated dead/return transition
                     self._pending[shard].append(("unroute", wid))
         left = self.flush_pending()
+        if obs_gate.enabled():
+            self.registry.inc("master_deadset_resyncs_total")
+        emit_event("failover", action="shard_resync", shard=shard,
+                   pending=left)
         logging.getLogger(__name__).warning(
             "PS shard %d returned; resynced dead-set + replayed missed "
             "decisions (%d still pending)", shard, left,

@@ -34,12 +34,15 @@ import json
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from lightctr_tpu.dist import wire
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs.registry import default_registry, labeled
 
 MSG_PULL = 1
 MSG_PUSH = 2
@@ -55,6 +58,14 @@ MSG_FAREWELL = 8
 # decides, network.h:148-151 the PS obeys)
 MSG_UNROUTE = 9
 MSG_READMIT = 10
+
+# wire-op names for the telemetry series (obs registry)
+_OP_NAMES = {
+    MSG_PULL: "pull", MSG_PUSH: "push", MSG_PRELOAD: "preload",
+    MSG_SNAPSHOT: "snapshot", MSG_BEAT: "beat", MSG_STATS: "stats",
+    MSG_FAREWELL: "farewell", MSG_UNROUTE: "unroute",
+    MSG_READMIT: "readmit",
+}
 
 # One garbage length prefix must not make the server buffer gigabytes before
 # any validation: cap frames well above any real payload (2^20 keys at
@@ -154,9 +165,18 @@ class ParamServerService:
 
     def _serve(self, conn: socket.socket):
         dim = self.ps.dim
+        reg = self.ps.registry
+        out_count = [0]
+
+        def send(data: bytes) -> None:
+            conn.sendall(data)
+            out_count[0] += len(data)
+
         try:
             while True:
                 msg_type, payload = _recv_msg(conn, cap=MAX_FRAME_BYTES)
+                telem = obs_gate.enabled()
+                t0 = time.perf_counter() if telem else 0.0
                 try:
                     if msg_type == MSG_PULL:
                         hdr, hdr_len = wire.split_varint(payload, 2)
@@ -168,11 +188,11 @@ class ParamServerService:
                             worker_id=None if wid < 0 else wid,
                         )
                         if rows is None:
-                            conn.sendall(struct.pack("<IB", 1, 0) + b"\x01")
+                            send(struct.pack("<IB", 1, 0) + b"\x01")
                         else:
                             body = (wire.pack_keys(keys)
                                     + wire.pack_values(rows)[0])
-                            conn.sendall(
+                            send(
                                 struct.pack("<IB", 1 + len(body), 0)
                                 + b"\x00" + body
                             )
@@ -190,26 +210,30 @@ class ParamServerService:
                         ok = self.ps.push_batch(
                             wid, keys, grads, worker_epoch=epoch
                         )
-                        conn.sendall(
+                        send(
                             struct.pack("<IB", 1, 0)
                             + (b"\x00" if ok else b"\x01")
                         )
                     elif msg_type == MSG_PRELOAD:
                         keys, rows = _keys_and_rows(payload, dim, np.float32)
                         self.ps.preload_batch(keys, rows)
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                        send(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_SNAPSHOT:
                         keys, rows = self.ps.snapshot_arrays()
                         body = (wire.pack_keys(keys)
                                 + rows.astype(np.float32).tobytes())
-                        conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                        send(struct.pack("<IB", len(body), 0) + body)
                     elif msg_type == MSG_BEAT:
                         wid = int(wire.unpack_varint(payload, 1)[0])
                         if self.monitor is not None:
                             self.monitor.beat(str(wid))
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                        send(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_STATS:
                         stats = self.ps.stats()
+                        # per-shard registry snapshot rides the stats op:
+                        # master/clients merge these cluster-wide
+                        # (obs.merge_snapshots) — the exposition path
+                        stats["telemetry"] = self.ps.registry.snapshot()
                         if self.monitor is not None:
                             # liveness map rides the stats op, so the
                             # launcher/ops plane can read the master's view
@@ -220,15 +244,15 @@ class ParamServerService:
                             # period thread, not this connection's thread
                             stats["liveness"] = self.monitor.peek()
                         body = json.dumps(stats).encode()
-                        conn.sendall(struct.pack("<IB", len(body), 0) + body)
+                        send(struct.pack("<IB", len(body), 0) + body)
                     elif msg_type == MSG_UNROUTE:
                         wid = int(wire.unpack_varint(payload, 1)[0])
                         self.ps.unroute_worker(wid)
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                        send(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_READMIT:
                         wid = int(wire.unpack_varint(payload, 1)[0])
                         self.ps.readmit_worker(wid)
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                        send(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_FAREWELL:
                         # clean departure (FIN, master.h:146-190): stop
                         # liveness tracking so deliberate exits are not
@@ -239,19 +263,29 @@ class ParamServerService:
                         self.ps.readmit_worker(wid)
                         if self.on_farewell is not None:
                             self.on_farewell(wid)
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                        send(struct.pack("<IB", 1, 0) + b"\x00")
                     elif msg_type == MSG_CLOSE:
                         return
                     else:
                         # protocol skew must error out, not deadlock the client
-                        conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                        send(struct.pack("<IB", 1, 0) + b"\xff")
+                    if telem:
+                        op = _OP_NAMES.get(msg_type, "unknown")
+                        reg.inc(labeled("ps_requests_total", op=op))
+                        reg.observe(labeled("ps_op_seconds", op=op),
+                                    time.perf_counter() - t0)
+                        reg.inc("ps_bytes_received_total", 5 + len(payload))
+                        reg.inc("ps_bytes_sent_total", out_count[0])
+                        out_count[0] = 0
                 except (ValueError, struct.error):
                     # malformed frame (truncated varint, row bytes not a
                     # multiple of dim*n_keys, ...): reply with the protocol
                     # error byte instead of killing the thread with a raw
                     # traceback, then drop the connection — the stream can't
                     # be trusted past a framing error
-                    conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                    send(struct.pack("<IB", 1, 0) + b"\xff")
+                    if telem:
+                        reg.inc("ps_protocol_errors_total")
                     return
         except (ConnectionError, OSError):
             return
@@ -436,8 +470,19 @@ class PSClient:
 
     def beat(self, worker_id: int) -> None:
         """Heartbeat over the PS connection (master.h:202 topology: liveness
-        rides the same network as parameters)."""
+        rides the same network as parameters).  The round-trip time lands in
+        the process registry (``heartbeat_rtt_seconds``) — worker-observed
+        control-plane latency, the number that predicts false death
+        declarations."""
+        if not obs_gate.enabled():
+            self._rpc(MSG_BEAT,
+                      wire.pack_varint(np.array([worker_id], np.int64)))
+            return
+        t0 = time.perf_counter()
         self._rpc(MSG_BEAT, wire.pack_varint(np.array([worker_id], np.int64)))
+        reg = default_registry()
+        reg.observe("heartbeat_rtt_seconds", time.perf_counter() - t0)
+        reg.inc("heartbeats_total")
 
     def stats(self) -> Dict:
         """Server-side counter snapshot (withheld/dropped/rejected, unrouted
@@ -777,19 +822,28 @@ class ShardedPSClient:
         self._best_effort(lambda c: c.beat(worker_id))
 
     def stats(self):
-        """Per-shard stats list (shard i = addresses[i]); a down shard's
-        slot is None."""
+        """Per-shard stats list (shard i = addresses[i]).  Every slot is a
+        dict carrying ``addr`` and ``down``; a DOWN shard yields
+        ``{"addr": ..., "down": True, "error": ...}`` — distinguishable
+        from a healthy-but-empty shard (which reports its real counters) —
+        so aggregators can count unreachable shards instead of treating
+        them as zero traffic."""
         out = []
         for i in range(self.n_shards):
+            addr = list(self.addresses[i])
             c = self._ensure(i)
             if c is None:
-                out.append(None)
+                out.append({"addr": addr, "down": True,
+                            "error": "unreachable (reconnect failed)"})
                 continue
             try:
-                out.append(c.stats())
-            except (ConnectionError, OSError, RuntimeError):
+                st = c.stats()
+                st["addr"] = addr
+                st["down"] = False
+                out.append(st)
+            except (ConnectionError, OSError, RuntimeError) as e:
                 self._mark_down(i)
-                out.append(None)
+                out.append({"addr": addr, "down": True, "error": str(e)})
         return out
 
     def farewell(self, worker_id: int) -> None:

@@ -35,12 +35,15 @@ transport, which has no equivalent need on a single host).
 from __future__ import annotations
 
 import threading
+import time
 from itertools import repeat
 from typing import Dict, Optional
 
 import numpy as np
 
 from lightctr_tpu.native import bindings
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs.registry import MetricsRegistry
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
 
@@ -110,9 +113,13 @@ class AsyncParamServer:
         momentum_rate: float = 0.95,
         seed: int = 0,
         eps: float = 1e-7,
+        registry: Optional[MetricsRegistry] = None,
     ):
         if updater not in ("sgd", "adagrad", "dcasgd", "dcasgda"):
             raise ValueError(f"unknown updater {updater!r}")
+        # per-STORE registry (not the process default): N shards hosted in
+        # one process must report distinct snapshots over the stats op
+        self.registry = registry if registry is not None else MetricsRegistry()
         self.dim = dim
         self.updater = updater
         self.lr = learning_rate
@@ -131,6 +138,8 @@ class AsyncParamServer:
         # drift passes a bound
         self._key_cache: Optional[tuple] = None
         self._pending: list = []  # [(keys, slots)] allocated post-snapshot
+        self.key_cache_builds = 0   # full dict-walk snapshot (re)builds
+        self.key_cache_merges = 0   # incremental _merge_pending folds
         self._n = 0
         self._cap = 0
         self._W = np.zeros((0, dim), np.float32)
@@ -214,6 +223,10 @@ class AsyncParamServer:
             if (len(self._slot) - len(self._key_cache[0])
                     > max(4096, len(self._key_cache[0]) // 8)):
                 self._merge_pending()
+            elif obs_gate.enabled():
+                self.registry.gauge_set(
+                    "ps_store_pending_depth", len(self._pending)
+                )
         return sl
 
     def _merge_pending(self) -> None:
@@ -231,6 +244,10 @@ class AsyncParamServer:
         pos = np.searchsorted(sk, pk)
         self._key_cache = (np.insert(sk, pos, pk), np.insert(sv, pos, pv))
         self._pending = []
+        self.key_cache_merges += 1
+        if obs_gate.enabled():
+            self.registry.inc("ps_store_key_cache_merges_total")
+            self.registry.gauge_set("ps_store_pending_depth", 0)
 
     def _slot_for_set(self, key: int) -> int:
         """Slot for a direct row assignment: allocate zero-filled, no RNG."""
@@ -273,6 +290,7 @@ class AsyncParamServer:
                 order = np.argsort(sk)
                 self._key_cache = (sk[order], sv[order])
                 self._pending = []
+                self.key_cache_builds += 1
             elif (len(self._slot) - len(self._key_cache[0])
                     > max(4096, len(self._key_cache[0]) // 8)):
                 # incremental: fold queued post-snapshot allocations in
@@ -362,6 +380,25 @@ class AsyncParamServer:
     ) -> Optional[np.ndarray]:
         """Vectorized pull: ``[n, dim]`` rows in ``keys`` order (a fresh
         copy), or None when withheld/unrouted.  The network PS hot path."""
+        if not obs_gate.enabled():
+            return self._pull_batch(keys, worker_epoch, worker_id)
+        t0 = time.perf_counter()
+        out = self._pull_batch(keys, worker_epoch, worker_id)
+        reg = self.registry
+        reg.observe("ps_store_pull_seconds", time.perf_counter() - t0)
+        reg.inc("ps_store_pulls_total")
+        if out is None:
+            reg.inc("ps_store_gated_pulls_total")
+        else:
+            reg.inc("ps_store_pulled_keys_total", len(keys))
+        return out
+
+    def _pull_batch(
+        self,
+        keys: np.ndarray,
+        worker_epoch: int,
+        worker_id: Optional[int] = None,
+    ) -> Optional[np.ndarray]:
         with self._lock:
             if not self._pull_gate(worker_epoch, worker_id):
                 return None
@@ -459,6 +496,28 @@ class AsyncParamServer:
         """Vectorized push of ``[n, dim]`` grads for UNIQUE ``keys`` (the
         wire sends sorted-unique key streams); one fancy-indexed updater
         step instead of a per-key Python loop."""
+        if not obs_gate.enabled():
+            return self._push_batch(worker_id, keys, grads, worker_epoch)
+        t0 = time.perf_counter()
+        ok = self._push_batch(worker_id, keys, grads, worker_epoch)
+        reg = self.registry
+        reg.observe("ps_store_push_seconds", time.perf_counter() - t0)
+        reg.inc("ps_store_pushes_total")
+        if ok:
+            reg.inc("ps_store_pushed_keys_total", len(keys))
+        else:
+            reg.inc("ps_store_gated_pushes_total")
+        # staleness drift the SSP ledger currently holds (slowest worker)
+        reg.gauge_set("ps_store_staleness", self.staleness)
+        return ok
+
+    def _push_batch(
+        self,
+        worker_id: int,
+        keys: np.ndarray,
+        grads: np.ndarray,
+        worker_epoch: int,
+    ) -> bool:
         with self._lock:
             keys_arr = np.ascontiguousarray(keys, np.int64)
             # UNIQUE is a hard contract, enforced server-side BEFORE any
@@ -562,8 +621,13 @@ class AsyncParamServer:
 
     def stats(self) -> Dict:
         """Counter snapshot for admin/monitoring surfaces (one authoritative
-        implementation; the network PS serves this over MSG_STATS)."""
+        implementation; the network PS serves this over MSG_STATS).
+        ``pending_depth``/``key_cache_drift`` surface the sorted-lookup
+        snapshot's allocation backlog (PR 1's merge rule bounds both)."""
         with self._lock:
+            cache_len = (
+                len(self._key_cache[0]) if self._key_cache is not None else 0
+            )
             return {
                 "withheld_pulls": self.withheld_pulls,
                 "dropped_pushes": self.dropped_pushes,
@@ -573,6 +637,14 @@ class AsyncParamServer:
                 "last_epoch_version": self.last_epoch_version,
                 "staleness": self.staleness,
                 "n_keys": self._n,
+                # sorted-lookup snapshot health (async_ps._alloc_slots):
+                "pending_depth": len(self._pending),
+                "key_cache_drift": (
+                    len(self._slot) - cache_len
+                    if self._key_cache is not None else 0
+                ),
+                "key_cache_builds": self.key_cache_builds,
+                "key_cache_merges": self.key_cache_merges,
             }
 
     def snapshot_arrays(self):

@@ -15,6 +15,7 @@ reproduces that, an integer gives minibatch SGD (the DL-family default).
 
 from __future__ import annotations
 
+import logging
 import time
 from functools import partial
 from typing import Any, Callable, Dict, NamedTuple, Optional
@@ -24,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.core.config import TrainConfig
 from lightctr_tpu.core.mesh import replicated, shard_batch
@@ -32,6 +34,10 @@ from lightctr_tpu.models._common import tree_copy
 from lightctr_tpu.ops import losses as losses_lib
 from lightctr_tpu.ops import metrics as metrics_lib
 from lightctr_tpu.ops.activations import sigmoid
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 class CompressedRingState(NamedTuple):
@@ -206,6 +212,11 @@ class CTRTrainer:
             else:
                 flat, _ = ravel_pytree(self._ring_tree(self.params))
                 self._ring_pad = ((flat.shape[0] + n - 1) // n) * n
+        # live telemetry sink for step/exchange metrics; reassign before
+        # training to isolate a run (benches give each trainer a fresh
+        # MetricsRegistry)
+        self.telemetry = obs.default_registry()
+        self._steps_seen = 0
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
         # letting XLA update in place instead of copying the tables
@@ -455,8 +466,41 @@ class CTRTrainer:
         return {k: jnp.asarray(v) for k, v in batch.items()}
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> float:
-        self.params, self.opt_state, loss = self._step(self.params, self.opt_state, self._put(batch))
+        if not obs.enabled():
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, self._put(batch)
+            )
+            return loss
+        t0 = time.perf_counter()
+        dev_batch = self._put(batch)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, dev_batch
+        )
+        self._record_step(time.perf_counter() - t0, dev_batch)
         return loss
+
+    # -- telemetry ------------------------------------------------------
+
+    def _record_step(self, dt: float, batch) -> None:
+        """Per-step metrics + one JSONL ``step`` event.  On async backends
+        ``trainer_step_seconds`` measures dispatch (the caller's loss read
+        forces the sync); on CPU it is the full step."""
+        reg = self.telemetry
+        self._steps_seen += 1
+        n = int(batch["labels"].shape[0]) if "labels" in batch else 0
+        reg.inc("trainer_steps_total")
+        if n:
+            reg.inc("trainer_examples_total", n)
+        reg.observe("trainer_step_seconds", dt)
+        obs.emit_event(
+            "step", step=self._steps_seen, duration_s=round(dt, 6),
+            examples=n, **self._step_event_fields(),
+        )
+
+    def _step_event_fields(self) -> Dict:
+        """Extra fields subclasses contribute to each ``step`` event (the
+        hybrid sparse trainer reports its exchange decisions here)."""
+        return {}
 
     def fit(
         self,
@@ -488,13 +532,16 @@ class CTRTrainer:
                 for batch in minibatches(arrays, batch_size, seed=self.cfg.seed + epoch):
                     loss = self.train_step(batch)
             history["loss"].append(float(loss))
+            ev = None
             if eval_every and eval_arrays is not None and (epoch + 1) % eval_every == 0:
                 ev = self.evaluate(eval_arrays)
                 history["eval"].append((epoch, ev))
-                if verbose:
-                    print(f"epoch {epoch}: loss={float(loss):.5f} {ev}")
-            elif verbose:
-                print(f"epoch {epoch}: loss={float(loss):.5f}")
+            obs.emit_event("epoch", epoch=epoch, loss=float(loss),
+                           **({"eval": ev} if ev is not None else {}))
+            if verbose:
+                ensure_console_logging()
+                _LOG.info("epoch %d: loss=%.5f%s", epoch, float(loss),
+                          f" {ev}" if ev is not None else "")
         history["wall_time_s"] = time.perf_counter() - t0
         return history
 

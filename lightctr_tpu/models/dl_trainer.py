@@ -15,6 +15,8 @@ default).
 
 from __future__ import annotations
 
+import logging
+
 import time
 from functools import partial
 from typing import Callable, Dict, Optional
@@ -30,6 +32,10 @@ from lightctr_tpu.data.batching import minibatches
 from lightctr_tpu.models._common import check_batch_size, default_dl_optimizer, tree_copy
 from lightctr_tpu.ops import losses as losses_lib
 from lightctr_tpu.ops.activations import softmax
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 def _classification_loss(loss_name: str, z: jax.Array, onehot: jax.Array) -> jax.Array:
@@ -112,7 +118,8 @@ class ClassifierTrainer:
                 )
             history["loss"].append(float(loss))
             if verbose:
-                print(f"epoch {epoch}: loss={float(loss):.5f}")
+                ensure_console_logging()
+                _LOG.info("epoch %d: loss=%.5f", epoch, float(loss))
         history["wall_time_s"] = time.perf_counter() - t0
         return history
 

@@ -9,9 +9,15 @@ PLSA subclass it.  Here the same template is one function over pure
 
 from __future__ import annotations
 
+import logging
+
 from typing import Callable, List, Tuple
 
 import numpy as np
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 def fit_em(
@@ -32,7 +38,8 @@ def fit_em(
         ll = float(ll)
         history.append(ll)
         if verbose:
-            print(f"{name} iter {it}: loglik={ll:.4f}")
+            ensure_console_logging()
+            _LOG.info("%s iter %d: loglik=%.4f", name, it, ll)
         if np.isfinite(prev) and abs(ll - prev) < tol * abs(prev):
             break
         prev = ll

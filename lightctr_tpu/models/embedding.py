@@ -23,6 +23,8 @@ tree walk into dense masked arithmetic.
 
 from __future__ import annotations
 
+import logging
+
 import heapq
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
@@ -32,6 +34,10 @@ import numpy as np
 
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.core.config import TrainConfig
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -260,7 +266,8 @@ class Word2VecTrainer:
                 )
             history.append(float(loss))
             if verbose:
-                print(f"epoch {epoch}: loss={float(loss):.5f}")
+                ensure_console_logging()
+                _LOG.info("epoch %d: loss=%.5f", epoch, float(loss))
         return history
 
     def normalized_embeddings(self) -> np.ndarray:

@@ -30,6 +30,8 @@ chasing (gbm_algo_abst.h:127-151 nextLevel/locAtLeafWeight equivalents).
 
 from __future__ import annotations
 
+import logging
+
 import dataclasses
 from functools import partial
 from typing import List, NamedTuple, Tuple
@@ -39,6 +41,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from lightctr_tpu.ops.activations import sigmoid
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -341,7 +347,8 @@ class GBMModel:
                 )
             history.append(loss)
             if verbose:
-                print(f"round {t}: loss={loss:.5f}")
+                ensure_console_logging()
+                _LOG.info("round %d: loss=%.5f", t, loss)
         return history
 
     def decision_function(self, x: np.ndarray) -> np.ndarray:

@@ -83,8 +83,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightctr_tpu import obs
 from lightctr_tpu.embed.table import SparseAdagradState, sparse_adagrad_update
 from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.utils.profiling import annotate
 
 
 class SparseTableCTRTrainer(CTRTrainer):
@@ -156,6 +158,13 @@ class SparseTableCTRTrainer(CTRTrainer):
         # {table: "sparse" | "dense"} — the density-switch decision each
         # table leaf got at trace time (diagnostics / tests)
         self.exchange_policy: Dict[str, str] = {}
+        # {table: bytes each member transmits per step under the decision
+        # above} — written at trace time with the SAME accounting helpers
+        # the benches use (dist.collectives.sparse_exchange_bytes /
+        # dense_ring_bytes), so live counters and BENCH JSONs cannot
+        # disagree
+        self.exchange_bytes_per_step: Dict[str, int] = {}
+        self._exchange_logged = False
         super().__init__(
             params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
             param_shardings=param_shardings, compress_bits=compress_bits,
@@ -215,20 +224,21 @@ class SparseTableCTRTrainer(CTRTrainer):
         dense = {k: v for k, v in params.items() if k not in spec}
         batch2 = dict(batch)
         uids = {}
-        for k, fields in spec.items():
-            ids = jnp.concatenate(
-                [batch[f].reshape(-1) for f in fields]
-            ).astype(jnp.int32)
-            u, inv = jnp.unique(
-                ids, return_inverse=True, size=ids.shape[0], fill_value=0
-            )
-            uids[k] = u
-            ofs = 0
-            for f in fields:
-                m = batch[f].size
-                batch2[f] = inv[ofs:ofs + m].reshape(batch[f].shape)
-                ofs += m
-        rows = {k: jnp.take(tables[k], uids[k], axis=0) for k in spec}
+        with annotate("sparse_tables/dedup_gather"):
+            for k, fields in spec.items():
+                ids = jnp.concatenate(
+                    [batch[f].reshape(-1) for f in fields]
+                ).astype(jnp.int32)
+                u, inv = jnp.unique(
+                    ids, return_inverse=True, size=ids.shape[0], fill_value=0
+                )
+                uids[k] = u
+                ofs = 0
+                for f in fields:
+                    m = batch[f].size
+                    batch2[f] = inv[ofs:ofs + m].reshape(batch[f].shape)
+                    ofs += m
+            rows = {k: jnp.take(tables[k], uids[k], axis=0) for k in spec}
         return tables, dense, batch2, uids, rows
 
     def _make_step(self):
@@ -256,19 +266,21 @@ class SparseTableCTRTrainer(CTRTrainer):
             )
 
             new_accum = {}
-            for k in spec:
-                # single source of truth for the PS Adagrad recipe; uids are
-                # already unique (its internal dedup is an identity pass,
-                # and the repeated padded id-0 slots carry zero gradient)
-                tables[k], st = sparse_adagrad_update(
-                    tables[k],
-                    SparseAdagradState(accum=opt_state["accum"][k]),
-                    uids[k],
-                    g_rows[k],
-                    lr,
-                    eps=eps,
-                )
-                new_accum[k] = st.accum
+            with annotate("sparse_tables/apply"):
+                for k in spec:
+                    # single source of truth for the PS Adagrad recipe; uids
+                    # are already unique (its internal dedup is an identity
+                    # pass, and the repeated padded id-0 slots carry zero
+                    # gradient)
+                    tables[k], st = sparse_adagrad_update(
+                        tables[k],
+                        SparseAdagradState(accum=opt_state["accum"][k]),
+                        uids[k],
+                        g_rows[k],
+                        lr,
+                        eps=eps,
+                    )
+                    new_accum[k] = st.accum
 
             params = {**dense, **tables}
             return params, {"dense": new_dense_state, "accum": new_accum}, loss
@@ -288,7 +300,9 @@ class SparseTableCTRTrainer(CTRTrainer):
         from lightctr_tpu.dist.collectives import (
             _ring_all_reduce_local,
             _sparse_all_reduce_local,
+            dense_ring_bytes,
             prefer_sparse_exchange,
+            sparse_exchange_bytes,
         )
 
         loss_fn = self._make_loss_fn()
@@ -304,6 +318,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         ring_pad = self._ring_pad if bits is not None else 0
         margin = self._dense_margin
         policy = self.exchange_policy  # written at trace time
+        xbytes = self.exchange_bytes_per_step  # ditto (live telemetry)
 
         def dense_table_exchange(g):
             """SparCML's switch-over target: the table gradient as one
@@ -383,33 +398,45 @@ class SparseTableCTRTrainer(CTRTrainer):
                     sparse_bits=bits, dense_bits=bits, margin=margin,
                 ):
                     policy[k] = "sparse"
-                    gu, merged = _sparse_all_reduce_local(
-                        uids[k], g_rows[k], "data", n, average=True,
-                        compress_bits=bits,
-                        compress_range=crange if bits is not None else 1.0,
-                        compress_mode=cmode,
+                    xbytes[k] = sparse_exchange_bytes(
+                        n, uids[k].shape[0], dim, bits
                     )
+                    with annotate("sparse_tables/sparse_exchange"):
+                        gu, merged = _sparse_all_reduce_local(
+                            uids[k], g_rows[k], "data", n, average=True,
+                            compress_bits=bits,
+                            compress_range=crange if bits is not None else 1.0,
+                            compress_mode=cmode,
+                        )
                     # identical (gu, merged) on every replica -> identical
                     # update; duplicate ids across replicas were merged by
                     # the exchange, padded slots carry zero rows (no-op)
-                    tables[k], st = sparse_adagrad_update(
-                        tables[k],
-                        SparseAdagradState(accum=opt_state["accum"][k]),
-                        gu,
-                        merged,
-                        lr,
-                        eps=eps,
-                    )
+                    with annotate("sparse_tables/apply"):
+                        tables[k], st = sparse_adagrad_update(
+                            tables[k],
+                            SparseAdagradState(accum=opt_state["accum"][k]),
+                            gu,
+                            merged,
+                            lr,
+                            eps=eps,
+                        )
                     new_accum[k] = st.accum
                 else:
                     policy[k] = "dense"
-                    g = jnp.zeros_like(tables[k]).at[uids[k]].add(g_rows[k])
-                    g = dense_table_exchange(g)
+                    xbytes[k] = dense_ring_bytes(vocab, dim, n, bits)
+                    with annotate("sparse_tables/dense_exchange"):
+                        g = jnp.zeros_like(tables[k]).at[uids[k]].add(
+                            g_rows[k]
+                        )
+                        g = dense_table_exchange(g)
                     # dense elementwise Adagrad without state decay — the
                     # same trajectory as the sparse recipe (untouched rows
                     # have g == 0: neither weights nor accum move)
-                    acc = opt_state["accum"][k] + g * g
-                    tables[k] = tables[k] - lr * g * jax.lax.rsqrt(acc + eps)
+                    with annotate("sparse_tables/apply"):
+                        acc = opt_state["accum"][k] + g * g
+                        tables[k] = tables[k] - lr * g * jax.lax.rsqrt(
+                            acc + eps
+                        )
                     new_accum[k] = acc
 
             params = {**dense, **tables}
@@ -428,3 +455,54 @@ class SparseTableCTRTrainer(CTRTrainer):
             out_specs=(P(), state_spec, P()),
             check_vma=False,
         )
+
+    # -- telemetry ------------------------------------------------------
+
+    def _exchange_byte_totals(self):
+        """(sparse_bytes, dense_bytes) each member transmits per step under
+        the trace-time decisions; populated after the first step."""
+        sparse_b = dense_b = 0
+        for k, pol in self.exchange_policy.items():
+            b = self.exchange_bytes_per_step.get(k, 0)
+            if pol == "sparse":
+                sparse_b += b
+            else:
+                dense_b += b
+        return sparse_b, dense_b
+
+    def _step_event_fields(self) -> Dict:
+        if not (self._hybrid_dp and self.exchange_policy):
+            return {}
+        sparse_b, dense_b = self._exchange_byte_totals()
+        return {
+            "exchange_policy": dict(self.exchange_policy),
+            "sparse_exchange_bytes": sparse_b,
+            "dense_ring_bytes": dense_b,
+        }
+
+    def _record_step(self, dt: float, batch) -> None:
+        super()._record_step(dt, batch)
+        if not (self._hybrid_dp and self.exchange_policy):
+            return
+        reg = self.telemetry
+        for k, pol in self.exchange_policy.items():
+            b = self.exchange_bytes_per_step.get(k, 0)
+            reg.inc(
+                obs.labeled("trainer_exchange_bytes_total",
+                            table=k, policy=pol),
+                b,
+            )
+            reg.inc(
+                "trainer_sparse_exchange_bytes_total" if pol == "sparse"
+                else "trainer_dense_ring_bytes_total",
+                b,
+            )
+        if not self._exchange_logged:
+            # the density-switch decision is static post-trace: one
+            # ``exchange`` event per table, not one per step
+            self._exchange_logged = True
+            for k, pol in self.exchange_policy.items():
+                obs.emit_event(
+                    "exchange", table=k, policy=pol,
+                    bytes_per_step=self.exchange_bytes_per_step.get(k, 0),
+                )

@@ -13,6 +13,8 @@ same way (sharded table over the ``embed`` axis when needed).
 
 from __future__ import annotations
 
+import logging
+
 from typing import Dict, List, Optional
 
 import jax
@@ -25,6 +27,10 @@ from lightctr_tpu.models.gbm import GBMConfig, GBMModel
 from lightctr_tpu.ops import losses as losses_lib
 from lightctr_tpu.ops.activations import sigmoid
 from lightctr_tpu.ops.metrics import auc_exact
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 class GBMLRStack:
@@ -84,7 +90,8 @@ class GBMLRStack:
             lr_hist.append(float(loss))
         self.w = w
         if verbose:
-            print(f"LR: loss {lr_hist[0]:.5f} -> {lr_hist[-1]:.5f}")
+            ensure_console_logging()
+            _LOG.info("LR: loss %.5f -> %.5f", lr_hist[0], lr_hist[-1])
         return {"gbm_loss": gbm_hist, "lr_loss": lr_hist}
 
     def predict_proba(self, x: np.ndarray) -> np.ndarray:

@@ -18,6 +18,8 @@ train_vae_algo.h:104-109) returning the latent sample.
 
 from __future__ import annotations
 
+import logging
+
 import time
 from typing import Dict, Optional
 
@@ -32,6 +34,10 @@ from lightctr_tpu.data.batching import minibatches
 from lightctr_tpu.models._common import check_batch_size, default_dl_optimizer
 from lightctr_tpu.nn import dense, sample
 from lightctr_tpu.ops.activations import sigmoid
+
+from lightctr_tpu.obs import ensure_console_logging
+
+_LOG = logging.getLogger(__name__)
 
 
 def init(key: jax.Array, feature_cnt: int, hidden: int = 60, gauss_cnt: int = 20) -> Dict:
@@ -112,7 +118,8 @@ class VAETrainer:
                 )
             history["loss"].append(float(loss))
             if verbose:
-                print(f"epoch {epoch}: loss={float(loss):.5f}")
+                ensure_console_logging()
+                _LOG.info("epoch %d: loss=%.5f", epoch, float(loss))
         history["wall_time_s"] = time.perf_counter() - t0
         return history
 

@@ -1,0 +1,61 @@
+"""obs — unified telemetry: metrics registry, structured event log,
+exposition helpers.
+
+The operational layer the adaptive comms stack is flown with: every number
+that justifies a policy decision (exchanged bytes/step, PS op latency,
+staleness drift, failover replays) is a live counter/gauge/histogram in a
+:class:`~lightctr_tpu.obs.registry.MetricsRegistry` or a typed record in
+the JSONL event log — never a bare print.
+
+Entry points
+------------
+``enabled()`` / ``set_enabled()`` / ``override()``
+    process-wide switch; instrumented hot paths check it first.
+``default_registry()``
+    the process registry (trainers, clients); PS stores own one each so
+    per-shard snapshots stay distinct.
+``emit_event(kind, **fields)``
+    append to the default JSONL event log (``configure_event_log`` to give
+    it a file).
+``merge_snapshots`` / ``render_prometheus`` / ``histogram_quantile``
+    aggregate shard snapshots cluster-wide and expose them.
+
+See docs/OBSERVABILITY.md for metric names and the event schema.
+"""
+
+from lightctr_tpu.obs.gate import enabled, override, set_enabled  # noqa: F401
+from lightctr_tpu.obs.registry import (  # noqa: F401
+    DEFAULT_TIME_BUCKETS_S,
+    MetricsRegistry,
+    default_registry,
+    histogram_quantile,
+    labeled,
+    merge_snapshots,
+    render_prometheus,
+)
+from lightctr_tpu.obs.events import (  # noqa: F401
+    SCHEMA_VERSION,
+    EventLog,
+    read_jsonl,
+)
+from lightctr_tpu.obs.events import configure as configure_event_log  # noqa: F401
+from lightctr_tpu.obs.events import emit as emit_event  # noqa: F401
+from lightctr_tpu.obs.events import get_event_log  # noqa: F401
+
+import logging as _logging
+
+
+def ensure_console_logging(level: int = _logging.INFO) -> None:
+    """Make the library's progress logging visible when the CALLER asked
+    for it (``verbose=True``) but never configured Python logging: Python's
+    last-resort handler drops INFO, so without this the converted
+    ``print`` call sites would be silent no-ops.  Attaches ONE stream
+    handler to the ``lightctr_tpu`` logger — only when neither it nor the
+    root logger has any handler, so an application's own logging config
+    always wins."""
+    log = _logging.getLogger("lightctr_tpu")
+    if not log.handlers and not _logging.getLogger().handlers:
+        handler = _logging.StreamHandler()
+        handler.setFormatter(_logging.Formatter("%(message)s"))
+        log.addHandler(handler)
+        log.setLevel(level)

@@ -58,8 +58,27 @@ class wall_clock:
         self._t1 = time.perf_counter()
 
 
-def annotate(name: str):
-    """Named sub-region for traces (shows as a block in the timeline)."""
-    import jax
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named sub-region for traces: tags BOTH timelines — the host timeline
+    (``jax.profiler.TraceAnnotation``) and the device/HLO metadata
+    (``jax.named_scope``, so the region name survives into compiled-program
+    profiles even though the body runs at trace time).
 
-    return jax.profiler.TraceAnnotation(name)
+    No-op-safe: usable on CPU, inside ``jit`` tracing, and in processes
+    where jax (or its profiler) is unavailable — instrumented library code
+    must never crash because profiling isn't."""
+    stack = contextlib.ExitStack()
+    try:
+        import jax
+
+        stack.enter_context(jax.named_scope(name))
+        stack.enter_context(jax.profiler.TraceAnnotation(name))
+    except Exception:
+        # unwind whatever DID enter (a half-entered named_scope left open
+        # would push jax's thread-local name stack one level forever)
+        stack.close()
+        yield
+        return
+    with stack:
+        yield

@@ -1,0 +1,479 @@
+"""Unified telemetry layer: registry, event log, wire-level aggregation,
+trainer instrumentation, overhead guard, and the no-bare-print lint."""
+
+import ast
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lightctr_tpu import obs
+
+LIB_ROOT = Path(__file__).resolve().parents[1] / "lightctr_tpu"
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_counters_gauges_histograms_roundtrip():
+    r = obs.MetricsRegistry()
+    r.inc("a_total")
+    r.inc("a_total", 5)
+    r.gauge_set("depth", 3)
+    r.observe("lat_seconds", 0.003)
+    r.observe("lat_seconds", 0.3)
+    s = r.snapshot()
+    assert s["counters"]["a_total"] == 6
+    assert s["gauges"]["depth"] == 3
+    h = s["histograms"]["lat_seconds"]
+    assert h["count"] == 2 and abs(h["sum"] - 0.303) < 1e-9
+    assert sum(h["counts"]) == 2
+    # snapshots are wire-ready: plain JSON types end to end
+    json.dumps(s)
+
+
+def test_snapshot_reset_is_atomic_with_read():
+    r = obs.MetricsRegistry()
+    r.inc("c", 7)
+    r.observe("h", 0.1)
+    first = r.snapshot(reset=True)
+    assert first["counters"]["c"] == 7
+    second = r.snapshot()
+    assert "c" not in second["counters"]
+    assert "h" not in second["histograms"]
+
+
+def test_registry_thread_safe_increments():
+    r = obs.MetricsRegistry()
+
+    def hammer():
+        for _ in range(1000):
+            r.inc("n_total")
+            r.observe("h", 0.001)
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = r.snapshot()
+    assert s["counters"]["n_total"] == 8000
+    assert s["histograms"]["h"]["count"] == 8000
+
+
+def test_merge_snapshots_sums_everything():
+    a, b = obs.MetricsRegistry(), obs.MetricsRegistry()
+    a.inc("c", 2)
+    b.inc("c", 3)
+    b.inc("only_b")
+    a.observe("h", 0.01)
+    b.observe("h", 10.0)
+    merged = obs.merge_snapshots([a.snapshot(), b.snapshot(), {}])
+    assert merged["counters"]["c"] == 5
+    assert merged["counters"]["only_b"] == 1
+    assert merged["histograms"]["h"]["count"] == 2
+
+
+def test_merge_rejects_mismatched_buckets():
+    a, b = obs.MetricsRegistry(), obs.MetricsRegistry()
+    a.observe("h", 1.0, buckets=(1.0, 2.0))
+    b.observe("h", 1.0, buckets=(5.0,))
+    with pytest.raises(ValueError):
+        obs.merge_snapshots([a.snapshot(), b.snapshot()])
+
+
+def test_histogram_quantile_interpolates():
+    r = obs.MetricsRegistry()
+    for v in np.linspace(0.0, 1.0, 101):
+        r.observe("h", float(v), buckets=(0.25, 0.5, 0.75, 1.0))
+    h = r.snapshot()["histograms"]["h"]
+    assert abs(obs.histogram_quantile(h, 0.5) - 0.5) < 0.05
+    assert obs.histogram_quantile(h, 0.0) <= obs.histogram_quantile(h, 1.0)
+    empty = {"le": [1.0], "counts": [0, 0], "sum": 0.0, "count": 0}
+    assert obs.histogram_quantile(empty, 0.99) == 0.0
+
+
+def test_render_prometheus_format():
+    r = obs.MetricsRegistry()
+    r.inc("reqs_total", 4)
+    r.inc(obs.labeled("ops_total", op="pull"), 2)
+    r.gauge_set("depth", 1)
+    r.observe(obs.labeled("lat_seconds", op="pull"), 0.2, buckets=(0.1, 1.0))
+    text = obs.render_prometheus(r.snapshot(), prefix="lightctr_")
+    assert "# TYPE lightctr_reqs_total counter" in text
+    assert "lightctr_reqs_total 4" in text
+    assert 'lightctr_ops_total{op="pull"} 2' in text
+    assert "# TYPE lightctr_depth gauge" in text
+    # histogram renders the cumulative bucket/sum/count triple with the
+    # baked-in labels merged alongside le
+    assert 'lightctr_lat_seconds_bucket{op="pull",le="+Inf"} 1' in text
+    assert 'lightctr_lat_seconds_count{op="pull"} 1' in text
+
+
+# -- event log --------------------------------------------------------------
+
+
+def test_event_log_ring_is_bounded():
+    log = obs.EventLog(capacity=10)
+    for i in range(25):
+        log.emit("step", step=i)
+    recs = log.records()
+    assert len(recs) == 10
+    assert recs[0]["step"] == 15 and recs[-1]["step"] == 24  # oldest dropped
+    assert log.dropped == 15 and log.emitted == 25
+    assert all(r["v"] == obs.SCHEMA_VERSION for r in recs)
+
+
+def test_event_log_flushes_jsonl(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    log = obs.EventLog(path=path, capacity=100, flush_every=4)
+    for i in range(10):
+        log.emit("step", step=i, loss=0.1 * i)
+    # flush_every=4 -> two automatic flushes so far; close drains the rest
+    log.close()
+    recs = obs.read_jsonl(path)
+    assert [r["step"] for r in recs] == list(range(10))
+    assert all(r["kind"] == "step" and "ts" in r for r in recs)
+    assert log.dropped == 0
+
+
+def test_event_log_flush_failure_never_raises(tmp_path):
+    """Telemetry I/O failure must not kill the emitting (training) thread:
+    the flush swallows the OSError, counts it, and keeps ring semantics."""
+    gone = tmp_path / "subdir"
+    gone.mkdir()
+    path = str(gone / "run.jsonl")
+    log = obs.EventLog(path=path, capacity=8, flush_every=4)
+    gone.rmdir()  # directory vanishes before the first flush
+    for i in range(30):
+        log.emit("step", step=i)  # would raise without containment
+    assert log.flush_errors >= 1
+    assert len(log.records()) <= 8  # fell back to the bounded ring
+    assert log.dropped > 0
+
+
+def test_ensure_console_logging_attaches_once():
+    import logging
+
+    root = logging.getLogger()
+    lib_log = logging.getLogger("lightctr_tpu")
+    old_root = list(root.handlers)
+    old_handlers, old_level = list(lib_log.handlers), lib_log.level
+    root.handlers.clear()  # simulate a fresh interpreter (pytest adds some)
+    lib_log.handlers.clear()
+    try:
+        obs.ensure_console_logging()
+        obs.ensure_console_logging()  # idempotent
+        assert len(lib_log.handlers) == 1
+        assert lib_log.isEnabledFor(logging.INFO)
+        # an application's own config wins: with root handlers present the
+        # helper must not attach anything
+        lib_log.handlers.clear()
+        root.addHandler(logging.NullHandler())
+        obs.ensure_console_logging()
+        assert lib_log.handlers == []
+    finally:
+        root.handlers[:] = old_root
+        lib_log.handlers[:] = old_handlers
+        lib_log.setLevel(old_level)
+
+
+def test_default_event_log_respects_gate(tmp_path):
+    obs.configure_event_log()
+    try:
+        with obs.override(False):
+            obs.emit_event("step", step=1)
+        assert obs.get_event_log().records() == []
+        obs.emit_event("step", step=2)
+        assert len(obs.get_event_log().records()) == 1
+    finally:
+        obs.configure_event_log()
+
+
+# -- PS wire-level stats ----------------------------------------------------
+
+
+def test_stats_wire_op_carries_registry_snapshot(rng):
+    from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=4, n_workers=1, seed=0)
+    svc = ParamServerService(ps)
+    client = PSClient(svc.address, 4)
+    try:
+        keys = np.arange(32, dtype=np.int64)
+        client.pull_arrays(keys, worker_epoch=0, worker_id=0)
+        client.push_arrays(0, keys, np.ones((32, 4), np.float32),
+                           worker_epoch=0)
+        st = client.stats()
+        telem = st["telemetry"]
+        c = telem["counters"]
+        assert c[obs.labeled("ps_requests_total", op="pull")] == 1
+        assert c[obs.labeled("ps_requests_total", op="push")] == 1
+        assert c["ps_store_pulled_keys_total"] == 32
+        assert c["ps_bytes_received_total"] > 0
+        assert c["ps_bytes_sent_total"] > 0
+        h = telem["histograms"][obs.labeled("ps_op_seconds", op="pull")]
+        assert h["count"] == 1
+        # the snapshot renders straight to Prometheus text
+        assert "ps_requests_total" in obs.render_prometheus(telem)
+    finally:
+        client.close()
+        svc.close()
+
+
+def test_store_stats_expose_pending_and_drift():
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=2, n_workers=1, seed=0)
+    st = ps.stats()
+    assert st["pending_depth"] == 0 and st["key_cache_drift"] == 0
+    assert st["key_cache_builds"] == 0 and st["key_cache_merges"] == 0
+    # first big pull allocates via the dict path (empty store); the second
+    # takes the vectorized path and builds the sorted snapshot; later small
+    # allocations queue against it
+    ps.pull_batch(np.arange(5000, dtype=np.int64), worker_epoch=0)
+    ps.pull_batch(np.arange(5000, dtype=np.int64), worker_epoch=0)
+    assert ps.stats()["key_cache_builds"] == 1
+    ps.pull_batch(np.arange(5000, 5100, dtype=np.int64), worker_epoch=0)
+    st = ps.stats()
+    assert st["pending_depth"] >= 1
+    assert st["key_cache_drift"] == 100
+
+
+def test_async_ps_pending_stays_bounded_under_merge_rule():
+    """PR 1's merge rule: _pending folds into the snapshot once drift
+    passes max(4096, cache/8) — so the queue depth (and drift) stay bounded
+    no matter how many small allocations arrive post-snapshot."""
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=1, n_workers=1, seed=0)
+    ps.pull_batch(np.arange(8192, dtype=np.int64), worker_epoch=0)  # alloc
+    ps.pull_batch(np.arange(8192, dtype=np.int64), worker_epoch=0)  # build
+    max_depth = 0
+    key = 8192
+    for _ in range(300):
+        ks = np.arange(key, key + 64, dtype=np.int64)
+        key += 64
+        ps.pull_batch(ks, worker_epoch=0)
+        st = ps.stats()
+        bound = max(4096, (st["n_keys"] - st["key_cache_drift"]) // 8)
+        assert st["key_cache_drift"] <= bound + 64, st
+        max_depth = max(max_depth, st["pending_depth"])
+    st = ps.stats()
+    assert st["key_cache_merges"] >= 1  # the rule actually fired
+    # 300 allocations of 64 keys would queue 300 deep without the rule
+    assert max_depth <= (bound // 64) + 2
+
+
+def test_two_process_cluster_aggregates_over_stats_op(tmp_path):
+    """Acceptance: a 2-PROCESS PS run surfaces cluster-wide metrics through
+    the stats wire op — each OS process serves its own shard + registry,
+    the client merges the per-shard telemetry snapshots."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from lightctr_tpu.dist.ps_server import ShardedPSClient
+
+    server = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, %r)
+        from lightctr_tpu.embed.async_ps import AsyncParamServer
+        from lightctr_tpu.dist.ps_server import ParamServerService
+        ps = AsyncParamServer(dim=4, n_workers=2, seed=int(sys.argv[1]))
+        svc = ParamServerService(ps)
+        print("ADDR", svc.address[0], svc.address[1], flush=True)
+        sys.stdin.read()   # serve until the parent closes our stdin
+        svc.close()
+        """
+    ) % str(LIB_ROOT.parent)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", server, str(i)],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         text=True, env=env)
+        for i in range(2)
+    ]
+    client = None
+    try:
+        addrs = []
+        for p in procs:
+            line = p.stdout.readline().split()
+            assert line[0] == "ADDR", line
+            addrs.append((line[1], int(line[2])))
+        client = ShardedPSClient(addrs, 4)
+        keys = np.arange(100, dtype=np.int64)  # 50 keys per modulo shard
+        client.pull_arrays(keys, worker_epoch=0, worker_id=0)
+        client.push_arrays(0, keys, np.ones((100, 4), np.float32),
+                           worker_epoch=0)
+        per_shard = client.stats()
+        assert all(not s["down"] for s in per_shard)
+        for s in per_shard:
+            assert s["telemetry"]["counters"][
+                obs.labeled("ps_requests_total", op="push")] == 1
+        merged = obs.merge_snapshots([s["telemetry"] for s in per_shard
+                                      if not s.get("down")])
+        c = merged["counters"]
+        # cluster-wide: both shards' pulls/pushes summed
+        assert c[obs.labeled("ps_requests_total", op="pull")] == 2
+        assert c[obs.labeled("ps_requests_total", op="push")] == 2
+        assert c["ps_store_pulled_keys_total"] == 100
+        assert c["ps_store_pushed_keys_total"] == 100
+        assert merged["histograms"][
+            obs.labeled("ps_op_seconds", op="push")]["count"] == 2
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs:
+            try:
+                p.stdin.close()
+            except OSError:
+                pass
+            p.wait(timeout=10)
+
+
+# -- trainer instrumentation ------------------------------------------------
+
+
+def _tiny_widedeep(vocab=4096, n_fields=4, dim=4, batch=64, seed=0):
+    import jax
+
+    from lightctr_tpu.models import widedeep
+
+    rng = np.random.default_rng(seed)
+    fids = rng.integers(0, vocab, size=(batch, n_fields)).astype(np.int32)
+    fields = np.tile(np.arange(n_fields, dtype=np.int32), (batch, 1))
+    mask = np.ones((batch, n_fields), np.float32)
+    rep, rep_mask = widedeep.field_representatives(fids, fields, mask,
+                                                   n_fields)
+    batch_arrays = {
+        "fids": fids, "fields": fields,
+        "vals": np.ones((batch, n_fields), np.float32), "mask": mask,
+        "labels": (rng.random(batch) > 0.5).astype(np.float32),
+        "rep_fids": rep, "rep_mask": rep_mask,
+    }
+    params = widedeep.init(jax.random.PRNGKey(0), vocab, n_fields, dim)
+    return params, batch_arrays
+
+
+def test_hybrid_trainer_jsonl_reproduces_bench_byte_accounting(tmp_path):
+    """Acceptance: a single-host hybrid run's per-step JSONL counters equal
+    the byte accounting SPARSE_RING_BENCH.json is built from (both sides
+    use dist.collectives.sparse_exchange_bytes on the same static shapes,
+    so they can never disagree)."""
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+    from lightctr_tpu.dist.collectives import sparse_exchange_bytes
+    from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer
+
+    n_dev = 8
+    vocab, n_fields, dim, batch_n = 4096, 4, 4, 64
+    params, batch = _tiny_widedeep(vocab, n_fields, dim, batch_n)
+    mesh = make_mesh(MeshSpec(data=n_dev))
+    tr = SparseTableCTRTrainer(
+        params, __import__("lightctr_tpu.models.widedeep",
+                           fromlist=["logits"]).logits,
+        TrainConfig(learning_rate=0.05),
+        sparse_tables={"w": ["fids"], "embed": ["rep_fids"]},
+        mesh=mesh,
+    )
+    tr.telemetry = obs.MetricsRegistry()
+    path = str(tmp_path / "run.jsonl")
+    obs.configure_event_log(path=path, flush_every=1)
+    try:
+        for _ in range(3):
+            tr.train_step(batch)
+    finally:
+        obs.get_event_log().flush()
+        obs.configure_event_log()
+
+    # the bench's accounting, from the same helpers on the same shapes
+    k_w = batch["fids"].size // n_dev
+    k_e = batch["rep_fids"].size // n_dev
+    expect_sparse = (sparse_exchange_bytes(n_dev, k_w, 1)
+                     + sparse_exchange_bytes(n_dev, k_e, dim))
+    assert tr.exchange_policy == {"w": "sparse", "embed": "sparse"}
+
+    steps = [r for r in obs.read_jsonl(path) if r["kind"] == "step"]
+    assert len(steps) == 3
+    for s in steps:
+        assert s["sparse_exchange_bytes"] == expect_sparse
+        assert s["dense_ring_bytes"] == 0
+        assert s["exchange_policy"] == {"w": "sparse", "embed": "sparse"}
+        assert s["examples"] == batch_n
+        assert s["duration_s"] > 0
+    # one exchange-decision event per table rode along
+    decisions = [r for r in obs.read_jsonl(path) if r["kind"] == "exchange"]
+    assert {d["table"] for d in decisions} == {"w", "embed"}
+    # registry counters agree with the event-log per-step numbers
+    c = tr.telemetry.snapshot()["counters"]
+    assert c["trainer_steps_total"] == 3
+    assert c["trainer_sparse_exchange_bytes_total"] == 3 * expect_sparse
+    assert c["trainer_examples_total"] == 3 * batch_n
+
+
+def test_trainer_telemetry_overhead_under_5_percent():
+    """Tier-1 overhead guard: the instrumented step path must cost <5%
+    wall time over the disabled path on CPU (min-of-reps to denoise)."""
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+    rng = np.random.default_rng(0)
+    d = 256
+    batch = {
+        "x": rng.normal(size=(512, d)).astype(np.float32),
+        "labels": (rng.random(512) > 0.5).astype(np.float32),
+    }
+    params = {"w": np.zeros((d,), np.float32)}
+    tr = CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
+                    TrainConfig(learning_rate=0.1))
+    obs.configure_event_log()  # fresh in-memory ring (no disk writes)
+    try:
+        for _ in range(5):  # compile + warm both paths
+            tr.train_step(batch)
+
+        def run(n=60):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                tr.train_step(batch)
+            return time.perf_counter() - t0
+
+        with obs.override(False):
+            t_off = min(run() for _ in range(4))
+        with obs.override(True):
+            t_on = min(run() for _ in range(4))
+    finally:
+        obs.configure_event_log()
+    # small absolute slack keeps the guard robust to scheduler noise while
+    # still catching any real regression (a disk flush or sync per step
+    # would blow far past this)
+    assert t_on <= t_off * 1.05 + 0.005, (t_on, t_off)
+
+
+# -- library hygiene --------------------------------------------------------
+
+
+def test_no_bare_print_in_library_code():
+    """Library code reports through obs/logging, never print().  cli/ is
+    the user-facing surface and exempt (tools/ lives outside the package)."""
+    offenders = []
+    for path in sorted(LIB_ROOT.rglob("*.py")):
+        rel = path.relative_to(LIB_ROOT)
+        if rel.parts[0] == "cli":
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "bare print() in library code (use logging or obs events): "
+        + ", ".join(offenders)
+    )

@@ -176,8 +176,9 @@ def test_fresh_relaunched_shard_gets_dead_set_resync():
 
 
 def test_sharded_client_down_shard_stats_and_accounting(rng):
-    """stats() marks a down shard None instead of raising; byte counters
-    survive the client-slot teardown."""
+    """stats() marks a down shard with an explicit {"down": True, "addr",
+    "error"} record (distinguishable from a healthy-but-empty shard)
+    instead of raising; byte counters survive the client-slot teardown."""
     svcs = [ParamServerService(_mk_store(s)) for s in (0, 1)]
     client = ShardedPSClient([s.address for s in svcs], DIM)
     try:
@@ -187,7 +188,10 @@ def test_sharded_client_down_shard_stats_and_accounting(rng):
         assert sent_before > 0
         svcs[1].close()
         st = client.stats()
-        assert st[0] is not None and st[1] is None
+        assert st[0]["down"] is False and "n_keys" in st[0]
+        assert st[1]["down"] is True and st[1]["error"]
+        assert st[1]["addr"] == list(svcs[1].address)
+        assert "n_keys" not in st[1]  # down != empty
         assert client.bytes_sent >= sent_before  # accumulated, not lost
         client.close()
     finally:

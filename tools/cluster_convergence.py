@@ -462,11 +462,12 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
 
     def agg_stats():
         """Aggregate shard stats (single shard -> dict; sharded -> list).
-        A down shard's slot is None — aggregate over the survivors."""
+        A down shard's slot is an explicit {"down": True, ...} record —
+        aggregate over the survivors."""
         s = admin.stats()
         if isinstance(s, dict):
             return s
-        live = [x for x in s if x is not None]
+        live = [x for x in s if x is not None and not x.get("down")]
         if not live:
             raise ConnectionError("no PS shard reachable")
         return {
@@ -478,7 +479,8 @@ def run(data_path=None, n_workers=4, epochs=30, batch_size=50, factor_dim=8,
             "rejected_pulls": sum(x["rejected_pulls"] for x in live),
             "rejected_pushes": sum(x["rejected_pushes"] for x in live),
             "n_keys": sum(x["n_keys"] for x in live),
-            "down_shards": [i for i, x in enumerate(s) if x is None],
+            "down_shards": [i for i, x in enumerate(s)
+                            if x is None or x.get("down")],
             "per_shard": s,
         }
 

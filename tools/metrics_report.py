@@ -1,0 +1,129 @@
+"""Summarize a telemetry run: JSONL event log -> one report JSON, or a
+registry snapshot -> Prometheus text.
+
+The obs layer (lightctr_tpu/obs/) leaves two artifacts behind: the JSONL
+event log (``obs.configure_event_log(path=...)``) and registry snapshots
+(scraped over the PS ``stats`` wire op or taken in-process).  This tool
+turns either into something readable:
+
+  python -m tools.metrics_report run.jsonl [--out REPORT.json]
+      # -> per-kind event counts, step-time percentiles, exchanged-bytes
+      #    totals, failover timeline
+  python -m tools.metrics_report --prom snapshot.json
+      # -> Prometheus text exposition of a registry snapshot (the JSON a
+      #    shard's stats()["telemetry"] returns, or a merge of several)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from lightctr_tpu.obs import read_jsonl, render_prometheus  # noqa: E402
+
+
+def _percentiles(values):
+    a = np.asarray(values, np.float64)
+    return {
+        "mean_s": round(float(a.mean()), 6),
+        "p50_s": round(float(np.percentile(a, 50)), 6),
+        "p95_s": round(float(np.percentile(a, 95)), 6),
+        "p99_s": round(float(np.percentile(a, 99)), 6),
+        "max_s": round(float(a.max()), 6),
+    }
+
+
+def summarize(records) -> dict:
+    """Event records -> run report (exact percentiles: unlike the registry
+    histograms these come from the raw per-step durations in the log)."""
+    by_kind: dict = {}
+    for r in records:
+        by_kind.setdefault(r.get("kind", "?"), []).append(r)
+
+    report: dict = {
+        "events": len(records),
+        "by_kind": {k: len(v) for k, v in sorted(by_kind.items())},
+        "schema_versions": sorted(
+            {r.get("v") for r in records} - {None}
+        ),
+    }
+    ts = [r["ts"] for r in records if "ts" in r]
+    if ts:
+        report["span_s"] = round(max(ts) - min(ts), 3)
+
+    steps = by_kind.get("step", [])
+    if steps:
+        durations = [s["duration_s"] for s in steps if "duration_s" in s]
+        step_rep = {
+            "count": len(steps),
+            "examples_total": sum(s.get("examples", 0) for s in steps),
+        }
+        if durations:
+            step_rep["step_time"] = _percentiles(durations)
+        sparse_b = sum(s.get("sparse_exchange_bytes", 0) for s in steps)
+        dense_b = sum(s.get("dense_ring_bytes", 0) for s in steps)
+        if sparse_b or dense_b:
+            step_rep["sparse_exchange_bytes_total"] = sparse_b
+            step_rep["dense_ring_bytes_total"] = dense_b
+        report["steps"] = step_rep
+
+    epochs = by_kind.get("epoch", [])
+    if epochs:
+        losses = [e["loss"] for e in epochs if "loss" in e]
+        report["epochs"] = {
+            "count": len(epochs),
+            "first_loss": losses[0] if losses else None,
+            "last_loss": losses[-1] if losses else None,
+        }
+
+    exchanges = by_kind.get("exchange", [])
+    if exchanges:
+        report["exchange_decisions"] = [
+            {k: e[k] for k in ("table", "policy", "bytes_per_step")
+             if k in e}
+            for e in exchanges
+        ]
+
+    failovers = by_kind.get("failover", [])
+    if failovers:
+        report["failovers"] = [
+            {k: v for k, v in f.items() if k not in ("v",)}
+            for f in failovers
+        ]
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
+    ap.add_argument("--out", help="write the report JSON here too")
+    ap.add_argument("--prom", metavar="SNAPSHOT_JSON",
+                    help="render a registry-snapshot JSON as Prometheus "
+                         "text instead of summarizing an event log")
+    args = ap.parse_args(argv)
+
+    if args.prom:
+        with open(args.prom) as f:
+            snap = json.load(f)
+        sys.stdout.write(render_prometheus(snap, prefix="lightctr_"))
+        return 0
+    if not args.jsonl:
+        ap.error("give an event-log path or --prom SNAPSHOT_JSON")
+
+    report = summarize(read_jsonl(args.jsonl))
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

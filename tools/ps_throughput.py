@@ -8,6 +8,14 @@ actually serves: timed pull and push rounds at Criteo-ish key-batch sizes,
 for the two dims the reference's benchmarks exercise (dim=9 ~ FM row
 1+k8; dim=33 ~ W&D row 1+k32).
 
+Byte and latency numbers come from the LIVE telemetry registry the server
+itself maintains (``lightctr_tpu/obs``): latency percentiles are estimated
+from the ``ps_op_seconds{op=...}`` histograms and wire bytes from the
+``ps_bytes_*_total`` counters — the same series a production scrape reads
+over the stats op, so this artifact and live monitoring cannot disagree.
+(Latency is therefore SERVER-side handling time per request; wall-clock
+throughput still includes the client/socket round trip.)
+
 Run:  python -m tools.ps_throughput [--out PS_THROUGHPUT.json]
 Emits one JSON artifact with, per (dim, keys-per-request) cell:
   pull/push keys-per-second, payload MB/s, p50/p99 request latency.
@@ -17,17 +25,32 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
 
-def _percentiles(lat_s):
-    a = np.asarray(lat_s)
+from lightctr_tpu.obs import histogram_quantile, labeled, set_enabled  # noqa: E402
+
+
+def _hist_percentiles(snap: dict, op: str) -> dict:
+    h = snap["histograms"][labeled("ps_op_seconds", op=op)]
     return {
-        "p50_us": round(float(np.percentile(a, 50)) * 1e6, 1),
-        "p99_us": round(float(np.percentile(a, 99)) * 1e6, 1),
+        "p50_us": round(histogram_quantile(h, 0.50) * 1e6, 1),
+        "p99_us": round(histogram_quantile(h, 0.99) * 1e6, 1),
+        "mean_us": round(h["sum"] / max(1, h["count"]) * 1e6, 1),
+        "source": "server registry histogram (handler time)",
     }
+
+
+def _wire_bytes(snap: dict) -> int:
+    c = snap["counters"]
+    return int(c.get("ps_bytes_received_total", 0)
+               + c.get("ps_bytes_sent_total", 0))
 
 
 def _warm_keys(vocab: int, keys_per_req: int) -> np.ndarray:
@@ -45,6 +68,7 @@ def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
     from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
     from lightctr_tpu.embed.async_ps import AsyncParamServer
 
+    set_enabled(True)  # this bench reads the registry; never run it dark
     ps = AsyncParamServer(dim=dim, updater="adagrad", learning_rate=0.05,
                           n_workers=1, seed=seed)
     svc = ParamServerService(ps)
@@ -55,40 +79,35 @@ def bench_cell(dim: int, keys_per_req: int, n_req: int, vocab: int, seed: int):
     # lazy-init cost) and warm both code paths once
     client.pull_arrays(_warm_keys(vocab, keys_per_req), worker_epoch=0,
                        worker_id=0)
+    ps.registry.snapshot(reset=True)  # drop the warm-up from the series
 
     batches = _request_batches(rng, vocab, keys_per_req, n_req)
     grads = rng.standard_normal((keys_per_req, dim)).astype(np.float32) * 0.01
 
     t0 = time.perf_counter()
-    pull_lat = []
-    pulled_keys = 0
     for keys in batches:
-        t = time.perf_counter()
-        out = client.pull_arrays(keys, worker_epoch=0, worker_id=0)
-        pull_lat.append(time.perf_counter() - t)
-        pulled_keys += len(out[0])
+        client.pull_arrays(keys, worker_epoch=0, worker_id=0)
     pull_wall = time.perf_counter() - t0
+    snap_pull = ps.registry.snapshot(reset=True)
 
     t0 = time.perf_counter()
-    push_lat = []
-    pushed_keys = 0
     for e, keys in enumerate(batches):
-        t = time.perf_counter()
         client.push_arrays(0, keys, grads[: len(keys)], worker_epoch=e)
-        push_lat.append(time.perf_counter() - t)
-        pushed_keys += len(keys)
     push_wall = time.perf_counter() - t0
+    snap_push = ps.registry.snapshot(reset=True)
 
-    # payload accounting straight from the client's byte counters
-    mb = (client.bytes_sent + client.bytes_received) / 1e6
+    # keys served + payload accounting straight from the server's registry
+    pulled_keys = snap_pull["counters"]["ps_store_pulled_keys_total"]
+    pushed_keys = snap_push["counters"]["ps_store_pushed_keys_total"]
+    mb = (_wire_bytes(snap_pull) + _wire_bytes(snap_push)) / 1e6
     cell = {
         "dim": dim,
         "keys_per_request": keys_per_req,
         "requests": n_req,
         "pull_keys_per_s": round(pulled_keys / pull_wall),
         "push_keys_per_s": round(pushed_keys / push_wall),
-        "pull": _percentiles(pull_lat),
-        "push": _percentiles(push_lat),
+        "pull": _hist_percentiles(snap_pull, "pull"),
+        "push": _hist_percentiles(snap_push, "push"),
         "wire_mb_total": round(mb, 2),
         "wire_mb_per_s": round(mb / (pull_wall + push_wall), 1),
     }
@@ -102,22 +121,25 @@ def bench_concurrent(dim: int, keys_per_req: int, n_req: int, vocab: int,
     """Aggregate pull throughput with N clients hammering one service
     concurrently (the reference PS serves every worker at once,
     paramserver.h:138-210).  The store lock serializes the numpy work but
-    socket/codec time overlaps; this measures what actually survives."""
+    socket/codec time overlaps; this measures what actually survives.
+    Served-key counts come from the server registry (one counter across
+    every connection thread)."""
     import threading
 
     from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
     from lightctr_tpu.embed.async_ps import AsyncParamServer
 
+    set_enabled(True)
     ps = AsyncParamServer(dim=dim, updater="adagrad", learning_rate=0.05,
                           n_workers=n_clients, seed=seed)
     svc = ParamServerService(ps)
     rng = np.random.default_rng(seed)
     clients = [PSClient(svc.address, dim) for _ in range(n_clients)]
     clients[0].pull_arrays(_warm_keys(vocab, keys_per_req), worker_epoch=0)
+    ps.registry.snapshot(reset=True)
 
     batches = [_request_batches(rng, vocab, keys_per_req, n_req)
                for _ in range(n_clients)]
-    done = [0] * n_clients
     errors = []
 
     def hammer(i):
@@ -128,7 +150,6 @@ def bench_concurrent(dim: int, keys_per_req: int, n_req: int, vocab: int,
                     out = clients[i].pull_arrays(
                         keys, worker_epoch=0, worker_id=i
                     )
-                done[i] += len(out[0])
         except Exception as e:  # surfaced after join — a failed thread
             errors.append((i, e))  # must fail the benchmark, not shrink it
 
@@ -142,11 +163,16 @@ def bench_concurrent(dim: int, keys_per_req: int, n_req: int, vocab: int,
     wall = time.perf_counter() - t0
     if errors:
         raise RuntimeError(f"client threads failed: {errors}")
+    snap = ps.registry.snapshot()
+    served = snap["counters"]["ps_store_pulled_keys_total"]
+    expect = sum(len(k) for b in batches for k in b)
+    assert served >= expect, (served, expect)  # registry saw every request
     cell = {
         "dim": dim, "keys_per_request": keys_per_req,
         "requests_per_client": n_req,
         "concurrent_clients": n_clients,
-        "aggregate_pull_keys_per_s": round(sum(done) / wall),
+        "aggregate_pull_keys_per_s": round(served / wall),
+        "pull_latency": _hist_percentiles(snap, "pull"),
     }
     for c in clients:
         c.close()
@@ -175,6 +201,9 @@ def main(argv=None):
         "tool": "tools.ps_throughput",
         "transport": "tcp localhost, varint keys + fp16 rows",
         "store": "slot-contiguous AsyncParamServer (adagrad)",
+        "telemetry_source": "obs registry (ps_op_seconds histograms, "
+                            "ps_bytes_*_total / ps_store_*_keys_total "
+                            "counters)",
         "cells": cells,
         "concurrent": conc,
     }

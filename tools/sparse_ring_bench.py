@@ -6,10 +6,14 @@ the dense data-parallel exchange ships the whole [vocab, dim] gradient
 every step.  This bench sweeps the vocabulary (density = touched/vocab)
 on the 8-member virtual mesh and reports, per table leaf:
 
-  - bytes/step each member transmits under the sparse (uids, g_rows)
-    exchange (``dist.collectives.sparse_all_reduce``) — constant in
-    vocab, scaling only with the batch's touched rows;
-  - bytes/step under the dense ring/psum exchange — linear in vocab;
+  - bytes/step each member actually transmits under the hybrid trainer's
+    decision, read from the trainer's LIVE telemetry
+    (``SparseTableCTRTrainer.exchange_bytes_per_step`` + the obs registry
+    counters ``trainer_sparse_exchange_bytes_total`` /
+    ``trainer_dense_ring_bytes_total``) — the same series a production
+    scrape reads, so this artifact and live monitoring cannot disagree;
+  - bytes/step the dense ring/psum exchange WOULD have cost (the
+    counterfactual baseline, ``dense_ring_bytes``) — linear in vocab;
   - the SparCML-style static switch decision the hybrid trainer takes
     (``prefer_sparse_exchange`` / ``SparseTableCTRTrainer.exchange_policy``);
   - measured examples/s for both trainers and the max loss-trajectory
@@ -44,6 +48,7 @@ from lightctr_tpu.dist import (  # noqa: E402
     dense_ring_bytes,
     sparse_exchange_bytes,
 )
+from lightctr_tpu.obs import MetricsRegistry, set_enabled  # noqa: E402
 from lightctr_tpu.models import widedeep  # noqa: E402
 from lightctr_tpu.models.ctr_trainer import CTRTrainer  # noqa: E402
 from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer  # noqa: E402
@@ -81,6 +86,7 @@ def timed_steps(tr, batch, steps: int):
 
 def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
         vocab_sweep=(1 << 14, 1 << 16, 1 << 18, 1 << 20)):
+    set_enabled(True)  # byte numbers come from the live registry
     rng = np.random.default_rng(0)
     mesh = make_mesh(MeshSpec(data=N_DEV))
     tables = {"w": ["fids"], "embed": ["rep_fids"]}
@@ -95,18 +101,31 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
         k_e = batch["rep_fids"].size // N_DEV
         touched = {"w": int(np.unique(batch["fids"]).size),
                    "embed": int(np.unique(batch["rep_fids"]).size)}
-        sparse_b = {"w": sparse_exchange_bytes(N_DEV, k_w, 1),
-                    "embed": sparse_exchange_bytes(N_DEV, k_e, DIM)}
+        # counterfactual baseline: what the dense ring WOULD ship
         dense_b = {"w": dense_ring_bytes(vocab, 1, N_DEV),
                    "embed": dense_ring_bytes(vocab, DIM, N_DEV)}
-        sparse_b["total"] = sparse_b["w"] + sparse_b["embed"]
         dense_b["total"] = dense_b["w"] + dense_b["embed"]
 
         sparse_tr = SparseTableCTRTrainer(
             params, widedeep.logits, cfg, sparse_tables=tables, mesh=mesh)
+        # isolated registry: this sweep cell's live counters only
+        sparse_tr.telemetry = MetricsRegistry()
         dense_tr = CTRTrainer(params, widedeep.logits, cfg, mesh=mesh)
         ex_s_sparse, l_sparse = timed_steps(sparse_tr, batch, steps)
         ex_s_dense, l_dense = timed_steps(dense_tr, batch, steps)
+
+        # live byte accounting from the trainer's telemetry, NOT re-derived:
+        # per-table rates from the trace-time record, totals cross-checked
+        # against the registry counters the instrumented steps incremented
+        live_b = dict(sparse_tr.exchange_bytes_per_step)
+        live_b["total"] = sum(live_b.values())
+        snap = sparse_tr.telemetry.snapshot()
+        n_steps = snap["counters"]["trainer_steps_total"]
+        counted = (snap["counters"].get(
+                       "trainer_sparse_exchange_bytes_total", 0)
+                   + snap["counters"].get(
+                       "trainer_dense_ring_bytes_total", 0))
+        assert counted == live_b["total"] * n_steps, (counted, live_b, n_steps)
 
         sweep.append({
             "vocab": vocab,
@@ -115,13 +134,17 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
             "density": round(touched["w"] / vocab, 6),
             "padded_ids_per_member": {"w": k_w, "embed": k_e},
             "bytes_per_step_per_member": {
-                "sparse_exchange": sparse_b,
-                "dense_ring": dense_b,
+                "live_exchange": live_b,
+                "dense_ring_counterfactual": dense_b,
                 "sparse_exchange_int8": {
                     "total": sparse_exchange_bytes(N_DEV, k_w, 1, 8)
                     + sparse_exchange_bytes(N_DEV, k_e, DIM, 8)},
             },
-            "reduction_x": round(dense_b["total"] / sparse_b["total"], 2),
+            "registry_counters": {
+                k: v for k, v in snap["counters"].items()
+                if "bytes" in k or k == "trainer_steps_total"
+            },
+            "reduction_x": round(dense_b["total"] / live_b["total"], 2),
             "exchange_policy": dict(sparse_tr.exchange_policy),
             "examples_per_sec": {
                 "sparse_exchange": round(ex_s_sparse, 1),
@@ -131,7 +154,7 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
                 np.max(np.abs(np.asarray(l_sparse) - np.asarray(l_dense)))),
         })
         print(f"vocab=2^{vocab.bit_length() - 1}: "
-              f"sparse {sparse_b['total']:,} B/step vs dense "
+              f"live {live_b['total']:,} B/step vs dense "
               f"{dense_b['total']:,} B/step ({sweep[-1]['reduction_x']}x), "
               f"{ex_s_sparse:,.0f} vs {ex_s_dense:,.0f} ex/s, "
               f"policy={sweep[-1]['exchange_policy']}", flush=True)
@@ -146,7 +169,9 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
                     "(xla_force_host_platform_device_count)",
         "model": f"widedeep vocab-sweep, dim={DIM}, batch={BATCH}, "
                  f"{N_FIELDS} fields",
-        "note": "sparse bytes are constant in vocab (they scale with the "
+        "note": "live bytes come from the trainer's obs-registry telemetry "
+                "(trainer_*_bytes_total counters / exchange_bytes_per_step); "
+                "sparse bytes are constant in vocab (they scale with the "
                 "batch's touched rows); dense bytes are linear in vocab. "
                 "examples/s on the CPU host mesh understates the win: XLA's "
                 "CPU backend does not honor donation, so both trainers pay "
