@@ -30,6 +30,7 @@ from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import emit_event
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import labeled
 
 
@@ -162,7 +163,11 @@ class MasterService:
         once, so an abandoned delivery would leave that shard's routing
         permanently diverged from the master's view."""
         telem = obs_gate.enabled()
-        with self._admin_lock:
+        # failover spans: the admin-op _send calls inside carry this span's
+        # context, so each shard's unroute/readmit handler stitches under
+        # the master's broadcast — a replayed decision is causally visible
+        with obs_trace.span("master/broadcast", op=op, worker=wid), \
+                self._admin_lock:
             for i in range(len(self._shards)):
                 # missed decisions first: order matters
                 if not self._replay(i) or not self._deliver(i, op, wid):
@@ -226,7 +231,8 @@ class MasterService:
         replay anything still queued for every shard."""
         if not (0 <= shard < len(self._shards)):
             return
-        with self._admin_lock:
+        with obs_trace.span("master/resync_shard", shard=shard), \
+                self._admin_lock:
             for w in sorted(self.monitor.dead_workers()):
                 wid = self._to_wid(w)
                 if wid is not None and not self._deliver(shard, "unroute", wid):

@@ -30,6 +30,7 @@ numerics the reference's workers train with.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import socket
 import struct
@@ -41,7 +42,9 @@ import numpy as np
 
 from lightctr_tpu.dist import wire
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import default_registry, labeled
 
 MSG_PULL = 1
@@ -73,8 +76,22 @@ _OP_NAMES = {
 MAX_FRAME_BYTES = 256 * 1024 * 1024
 
 
-def _send_msg(sock: socket.socket, msg_type: int, payload: bytes) -> None:
-    sock.sendall(struct.pack("<IB", len(payload), msg_type) + payload)
+def _send_msg(
+    sock: socket.socket,
+    msg_type: int,
+    payload: bytes,
+    trace_ctx=None,
+) -> int:
+    """Frame and send one message; returns the framed byte count.  With
+    ``trace_ctx=(trace_id, span_id)`` the payload is prefixed with the
+    varint trace header and the type byte carries ``wire.TRACE_FLAG`` —
+    headerless frames stay bit-identical to the pre-trace format."""
+    if trace_ctx is not None:
+        msg_type |= wire.TRACE_FLAG
+        payload = wire.pack_trace_ctx(*trace_ctx) + payload
+    frame = struct.pack("<IB", len(payload), msg_type) + payload
+    sock.sendall(frame)
+    return len(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -146,6 +163,10 @@ class ParamServerService:
         self.on_farewell = on_farewell
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
+        # the store's registry is where this shard's numbers live — make
+        # the crash flight recorder snapshot it alongside the default
+        self._flight_name = f"ps_shard_{self.address[1]}"
+        obs_flight.register_registry(self._flight_name, ps.registry)
         self._peers = []  # [(thread, conn)] of live connections
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -174,109 +195,135 @@ class ParamServerService:
 
         try:
             while True:
-                msg_type, payload = _recv_msg(conn, cap=MAX_FRAME_BYTES)
+                raw_type, payload = _recv_msg(conn, cap=MAX_FRAME_BYTES)
+                msg_type = raw_type & ~wire.TRACE_FLAG & 0xFF
+                # exact framed bytes, BEFORE the trace header (if any) is
+                # stripped below — ps_bytes_received_total promises what
+                # crossed the wire, not what reached the handler
+                frame_bytes = 5 + len(payload)
                 telem = obs_gate.enabled()
                 t0 = time.perf_counter() if telem else 0.0
                 try:
-                    if msg_type == MSG_PULL:
-                        hdr, hdr_len = wire.split_varint(payload, 2)
-                        wid = int(hdr[0]) - 1
-                        epoch = int(hdr[1])
-                        keys = wire.unpack_keys(payload[hdr_len:])
-                        rows = self.ps.pull_batch(
-                            keys, worker_epoch=epoch,
-                            worker_id=None if wid < 0 else wid,
+                    rctx = None
+                    if raw_type & wire.TRACE_FLAG:
+                        # inbound trace header: adopt the caller's span as
+                        # parent so this handler's span stitches into the
+                        # worker's step trace across the process boundary
+                        rctx, used = wire.split_trace_ctx(payload)
+                        payload = payload[used:]
+                    span_cm = contextlib.nullcontext()
+                    if msg_type != MSG_CLOSE and (
+                            rctx is not None or obs_trace.enabled()):
+                        # MSG_CLOSE is connection teardown, not work — a
+                        # span per disconnect would be pure ring noise
+                        span_cm = obs_trace.span(
+                            "ps/" + _OP_NAMES.get(msg_type, "unknown"),
+                            remote=rctx, n_bytes=len(payload),
                         )
-                        if rows is None:
-                            send(struct.pack("<IB", 1, 0) + b"\x01")
-                        else:
-                            body = (wire.pack_keys(keys)
-                                    + wire.pack_values(rows)[0])
-                            send(
-                                struct.pack("<IB", 1 + len(body), 0)
-                                + b"\x00" + body
+                    with span_cm:
+                        if msg_type == MSG_PULL:
+                            hdr, hdr_len = wire.split_varint(payload, 2)
+                            wid = int(hdr[0]) - 1
+                            epoch = int(hdr[1])
+                            keys = wire.unpack_keys(payload[hdr_len:])
+                            rows = self.ps.pull_batch(
+                                keys, worker_epoch=epoch,
+                                worker_id=None if wid < 0 else wid,
                             )
-                    elif msg_type == MSG_PUSH:
-                        hdr, hdr_len = wire.split_varint(payload, 2)
-                        wid, epoch = int(hdr[0]), int(hdr[1])
-                        keys, grads = _keys_and_rows(
-                            payload[hdr_len:], dim, np.float16
-                        )
-                        if len(keys) and not (np.diff(keys) > 0).all():
-                            # duplicate keys would mis-apply under the
-                            # vectorized (fancy-indexed) updater — refuse
-                            # the frame rather than corrupt rows
-                            raise ValueError("push keys must be unique")
-                        ok = self.ps.push_batch(
-                            wid, keys, grads, worker_epoch=epoch
-                        )
-                        send(
-                            struct.pack("<IB", 1, 0)
-                            + (b"\x00" if ok else b"\x01")
-                        )
-                    elif msg_type == MSG_PRELOAD:
-                        keys, rows = _keys_and_rows(payload, dim, np.float32)
-                        self.ps.preload_batch(keys, rows)
-                        send(struct.pack("<IB", 1, 0) + b"\x00")
-                    elif msg_type == MSG_SNAPSHOT:
-                        keys, rows = self.ps.snapshot_arrays()
-                        body = (wire.pack_keys(keys)
-                                + rows.astype(np.float32).tobytes())
-                        send(struct.pack("<IB", len(body), 0) + body)
-                    elif msg_type == MSG_BEAT:
-                        wid = int(wire.unpack_varint(payload, 1)[0])
-                        if self.monitor is not None:
-                            self.monitor.beat(str(wid))
-                        send(struct.pack("<IB", 1, 0) + b"\x00")
-                    elif msg_type == MSG_STATS:
-                        stats = self.ps.stats()
-                        # per-shard registry snapshot rides the stats op:
-                        # master/clients merge these cluster-wide
-                        # (obs.merge_snapshots) — the exposition path
-                        stats["telemetry"] = self.ps.registry.snapshot()
-                        if self.monitor is not None:
-                            # liveness map rides the stats op, so the
-                            # launcher/ops plane can read the master's view
-                            # of every beating node (master.h:202 ledger).
-                            # peek(), not check(): a stats request must stay
-                            # read-only — transitions (and their blocking
-                            # broadcast callbacks) belong to the monitor's
-                            # period thread, not this connection's thread
-                            stats["liveness"] = self.monitor.peek()
-                        body = json.dumps(stats).encode()
-                        send(struct.pack("<IB", len(body), 0) + body)
-                    elif msg_type == MSG_UNROUTE:
-                        wid = int(wire.unpack_varint(payload, 1)[0])
-                        self.ps.unroute_worker(wid)
-                        send(struct.pack("<IB", 1, 0) + b"\x00")
-                    elif msg_type == MSG_READMIT:
-                        wid = int(wire.unpack_varint(payload, 1)[0])
-                        self.ps.readmit_worker(wid)
-                        send(struct.pack("<IB", 1, 0) + b"\x00")
-                    elif msg_type == MSG_FAREWELL:
-                        # clean departure (FIN, master.h:146-190): stop
-                        # liveness tracking so deliberate exits are not
-                        # declared deaths, and clear any unroute flag
-                        wid = int(wire.unpack_varint(payload, 1)[0])
-                        if self.monitor is not None:
-                            self.monitor.forget(str(wid))
-                        self.ps.readmit_worker(wid)
-                        if self.on_farewell is not None:
-                            self.on_farewell(wid)
-                        send(struct.pack("<IB", 1, 0) + b"\x00")
-                    elif msg_type == MSG_CLOSE:
-                        return
-                    else:
-                        # protocol skew must error out, not deadlock the client
-                        send(struct.pack("<IB", 1, 0) + b"\xff")
-                    if telem:
-                        op = _OP_NAMES.get(msg_type, "unknown")
-                        reg.inc(labeled("ps_requests_total", op=op))
-                        reg.observe(labeled("ps_op_seconds", op=op),
-                                    time.perf_counter() - t0)
-                        reg.inc("ps_bytes_received_total", 5 + len(payload))
-                        reg.inc("ps_bytes_sent_total", out_count[0])
-                        out_count[0] = 0
+                            if rows is None:
+                                send(struct.pack("<IB", 1, 0) + b"\x01")
+                            else:
+                                body = (wire.pack_keys(keys)
+                                        + wire.pack_values(rows)[0])
+                                send(
+                                    struct.pack("<IB", 1 + len(body), 0)
+                                    + b"\x00" + body
+                                )
+                        elif msg_type == MSG_PUSH:
+                            hdr, hdr_len = wire.split_varint(payload, 2)
+                            wid, epoch = int(hdr[0]), int(hdr[1])
+                            keys, grads = _keys_and_rows(
+                                payload[hdr_len:], dim, np.float16
+                            )
+                            if len(keys) and not (np.diff(keys) > 0).all():
+                                # duplicate keys would mis-apply under the
+                                # vectorized (fancy-indexed) updater — refuse
+                                # the frame rather than corrupt rows
+                                raise ValueError("push keys must be unique")
+                            ok = self.ps.push_batch(
+                                wid, keys, grads, worker_epoch=epoch
+                            )
+                            send(
+                                struct.pack("<IB", 1, 0)
+                                + (b"\x00" if ok else b"\x01")
+                            )
+                        elif msg_type == MSG_PRELOAD:
+                            keys, rows = _keys_and_rows(
+                                payload, dim, np.float32
+                            )
+                            self.ps.preload_batch(keys, rows)
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
+                        elif msg_type == MSG_SNAPSHOT:
+                            keys, rows = self.ps.snapshot_arrays()
+                            body = (wire.pack_keys(keys)
+                                    + rows.astype(np.float32).tobytes())
+                            send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_BEAT:
+                            wid = int(wire.unpack_varint(payload, 1)[0])
+                            if self.monitor is not None:
+                                self.monitor.beat(str(wid))
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
+                        elif msg_type == MSG_STATS:
+                            stats = self.ps.stats()
+                            # per-shard registry snapshot rides the stats op:
+                            # master/clients merge these cluster-wide
+                            # (obs.merge_snapshots) — the exposition path
+                            stats["telemetry"] = self.ps.registry.snapshot()
+                            if self.monitor is not None:
+                                # liveness map rides the stats op, so the
+                                # launcher/ops plane can read the master's
+                                # view of every beating node (master.h:202
+                                # ledger).  peek(), not check(): a stats
+                                # request must stay read-only — transitions
+                                # (and their blocking broadcast callbacks)
+                                # belong to the monitor's period thread, not
+                                # this connection's thread
+                                stats["liveness"] = self.monitor.peek()
+                            body = json.dumps(stats).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_UNROUTE:
+                            wid = int(wire.unpack_varint(payload, 1)[0])
+                            self.ps.unroute_worker(wid)
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
+                        elif msg_type == MSG_READMIT:
+                            wid = int(wire.unpack_varint(payload, 1)[0])
+                            self.ps.readmit_worker(wid)
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
+                        elif msg_type == MSG_FAREWELL:
+                            # clean departure (FIN, master.h:146-190): stop
+                            # liveness tracking so deliberate exits are not
+                            # declared deaths, and clear any unroute flag
+                            wid = int(wire.unpack_varint(payload, 1)[0])
+                            if self.monitor is not None:
+                                self.monitor.forget(str(wid))
+                            self.ps.readmit_worker(wid)
+                            if self.on_farewell is not None:
+                                self.on_farewell(wid)
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
+                        elif msg_type == MSG_CLOSE:
+                            return
+                        else:
+                            # protocol skew must error out, not deadlock
+                            # the client
+                            send(struct.pack("<IB", 1, 0) + b"\xff")
+                        if telem:
+                            op = _OP_NAMES.get(msg_type, "unknown")
+                            reg.inc(labeled("ps_requests_total", op=op))
+                            reg.observe(labeled("ps_op_seconds", op=op),
+                                        time.perf_counter() - t0)
+                            reg.inc("ps_bytes_received_total", frame_bytes)
+                            reg.inc("ps_bytes_sent_total", out_count[0])
+                            out_count[0] = 0
                 except (ValueError, struct.error):
                     # malformed frame (truncated varint, row bytes not a
                     # multiple of dim*n_keys, ...): reply with the protocol
@@ -294,6 +341,7 @@ class ParamServerService:
 
     def close(self):
         self._stop.set()
+        obs_flight.unregister_registry(self._flight_name)
         # shutdown() BEFORE close(): the accept thread blocked in accept()
         # holds the kernel's open file description, so close() alone leaves
         # the port listening (and accepting!) until that syscall returns —
@@ -353,9 +401,14 @@ class PSClient:
     def _send(self, msg_type: int, payload: bytes) -> None:
         """Fire a request without waiting for the reply (pipelining
         primitive — the server answers requests on one connection in
-        order, so N sends followed by N receives is safe)."""
-        _send_msg(self._sock, msg_type, payload)
-        self.bytes_sent += 5 + len(payload)
+        order, so N sends followed by N receives is safe).  When a
+        sampled span is open on this thread, its context rides the frame
+        as the wire trace header — the server's handler span becomes its
+        child."""
+        self.bytes_sent += _send_msg(
+            self._sock, msg_type, payload,
+            trace_ctx=obs_trace.current_context(),
+        )
         self._inflight_type = msg_type
 
     def _recv_reply(self) -> bytes:
@@ -392,7 +445,8 @@ class PSClient:
             # request would get rows back in a DIFFERENT order than asked —
             # silent misalignment; fail loud instead
             raise ValueError("pull_arrays keys must be sorted")
-        reply = self._rpc(MSG_PULL, hdr + wire.pack_keys(keys_arr))
+        with obs_trace.span("ps_client/pull", n_keys=int(keys_arr.size)):
+            reply = self._rpc(MSG_PULL, hdr + wire.pack_keys(keys_arr))
         if reply[:1] == b"\x01":
             self.withheld_pulls += 1
             return None
@@ -427,7 +481,8 @@ class PSClient:
             raise ValueError("push_arrays keys must be sorted unique")
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
         payload = hdr + wire.pack_keys(keys_arr) + wire.pack_values(r)[0]
-        ok = self._rpc(MSG_PUSH, payload) == b"\x00"
+        with obs_trace.span("ps_client/push", n_keys=int(keys_arr.size)):
+            ok = self._rpc(MSG_PUSH, payload) == b"\x00"
         if not ok:
             self.dropped_pushes += 1
         return ok
@@ -479,7 +534,9 @@ class PSClient:
                       wire.pack_varint(np.array([worker_id], np.int64)))
             return
         t0 = time.perf_counter()
-        self._rpc(MSG_BEAT, wire.pack_varint(np.array([worker_id], np.int64)))
+        with obs_trace.span("ps_client/beat"):
+            self._rpc(MSG_BEAT,
+                      wire.pack_varint(np.array([worker_id], np.int64)))
         reg = default_registry()
         reg.observe("heartbeat_rtt_seconds", time.perf_counter() - t0)
         reg.inc("heartbeats_total")
@@ -657,21 +714,6 @@ class ShardedPSClient:
         ))
         live = []
         state = {"withheld": False, "failed": False}
-        for i, (part, idx) in enumerate(zip(parts, order)):
-            if not len(part):
-                continue
-            c = self._ensure(i)
-            if c is None:
-                # shard down: same retry contract as a withheld pull — the
-                # caller backs off and retries until the shard returns
-                state["failed"] = True
-                continue
-            try:
-                c._send(MSG_PULL, hdr + wire.pack_keys(part))
-                live.append((i, c, idx))
-            except (ConnectionError, OSError):
-                self._mark_down(i)
-                state["failed"] = True
         rows = np.empty((len(keys_arr), self.dim), np.float32)
 
         def handle(item):
@@ -691,7 +733,26 @@ class ShardedPSClient:
             _, r = _keys_and_rows(reply[1:], self.dim, np.float16)
             rows[idx] = r
 
-        self._drain(live, handle)
+        # one span covers the whole fan-out: every per-shard _send fires
+        # inside it, so each shard's server span is this span's child
+        with obs_trace.span("ps_client/pull", n_keys=int(keys_arr.size),
+                            shards=self.n_shards):
+            for i, (part, idx) in enumerate(zip(parts, order)):
+                if not len(part):
+                    continue
+                c = self._ensure(i)
+                if c is None:
+                    # shard down: same retry contract as a withheld pull —
+                    # the caller backs off and retries until it returns
+                    state["failed"] = True
+                    continue
+                try:
+                    c._send(MSG_PULL, hdr + wire.pack_keys(part))
+                    live.append((i, c, idx))
+                except (ConnectionError, OSError):
+                    self._mark_down(i)
+                    state["failed"] = True
+            self._drain(live, handle)
         if state["withheld"] or state["failed"]:
             return None
         return keys_arr, rows
@@ -704,25 +765,6 @@ class ShardedPSClient:
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
         live = []
         state = {"ok": True}
-        for i, (part, idx) in enumerate(zip(parts, order)):
-            if not len(part):
-                continue
-            c = self._ensure(i)
-            if c is None:
-                # shard down: that slice of the push is lost — the
-                # reference's async pushes are likewise lossy
-                state["ok"] = False
-                continue
-            try:
-                c._send(
-                    MSG_PUSH,
-                    hdr + wire.pack_keys(part)
-                    + wire.pack_values(r[idx])[0],
-                )
-                live.append((i, c))
-            except (ConnectionError, OSError):
-                self._mark_down(i)
-                state["ok"] = False
 
         def handle(item):
             i, c = item
@@ -738,7 +780,28 @@ class ShardedPSClient:
                 # (per-shard ledgers — see class docstring); caller
                 # semantics match the reference's lossy async pushes
 
-        self._drain(live, handle)
+        with obs_trace.span("ps_client/push", n_keys=int(keys_arr.size),
+                            shards=self.n_shards):
+            for i, (part, idx) in enumerate(zip(parts, order)):
+                if not len(part):
+                    continue
+                c = self._ensure(i)
+                if c is None:
+                    # shard down: that slice of the push is lost — the
+                    # reference's async pushes are likewise lossy
+                    state["ok"] = False
+                    continue
+                try:
+                    c._send(
+                        MSG_PUSH,
+                        hdr + wire.pack_keys(part)
+                        + wire.pack_values(r[idx])[0],
+                    )
+                    live.append((i, c))
+                except (ConnectionError, OSError):
+                    self._mark_down(i)
+                    state["ok"] = False
+            self._drain(live, handle)
         return state["ok"]
 
     def preload_arrays(self, keys, rows) -> None:

@@ -16,6 +16,15 @@ between processes, the role of the reference's ZeroMQ ``Buffer`` packing:
 
 A packed request frames as: ``n_keys`` varint, then the delta-coded key
 stream — self-describing and byte-order independent.
+
+Trace context (obs/trace.py) crosses the wire as an OPTIONAL varint-framed
+header: a frame whose type byte carries :data:`TRACE_FLAG` (bit 7 — real
+op types stay < 0x80) prefixes its payload with
+``pack_trace_ctx(trace_id, span_id)``.  Headerless frames are bit-for-bit
+the pre-trace format, and a tracing-disabled client emits exactly those —
+so old and new peers interoperate whenever tracing is off, and an
+unexpected flagged frame at an old server fails loud (protocol-error
+reply), never silently misparses.
 """
 
 from __future__ import annotations
@@ -66,6 +75,24 @@ def _unpack_py(buf: bytes, n: int) -> Tuple[np.ndarray, int]:
         u &= 0xFFFFFFFFFFFFFFFF
         out[i] = (u >> 1) ^ -(u & 1)
     return out, pos
+
+
+# bit 7 of the frame-type byte: "payload starts with a trace header".
+# Message types are small positive ints, so the flag never collides.
+TRACE_FLAG = 0x80
+
+
+def pack_trace_ctx(trace_id: int, span_id: int) -> bytes:
+    """(trace_id, parent span_id) -> varint trace header.  Ids are 63-bit
+    (obs/trace.py) so they ride the zigzag-int64 codec losslessly."""
+    return pack_varint(np.array([trace_id, span_id], np.int64))
+
+
+def split_trace_ctx(buf: bytes):
+    """Decode a :func:`pack_trace_ctx` header -> ((trace_id, span_id),
+    bytes consumed) — the remainder of ``buf`` is the original payload."""
+    vals, consumed = split_varint(buf, 2)
+    return (int(vals[0]), int(vals[1])), consumed
 
 
 def pack_varint(vals: np.ndarray) -> bytes:

@@ -43,6 +43,7 @@ import numpy as np
 
 from lightctr_tpu.native import bindings
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import MetricsRegistry
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
@@ -383,7 +384,8 @@ class AsyncParamServer:
         if not obs_gate.enabled():
             return self._pull_batch(keys, worker_epoch, worker_id)
         t0 = time.perf_counter()
-        out = self._pull_batch(keys, worker_epoch, worker_id)
+        with obs_trace.span("ps_store/pull", n_keys=int(len(keys))):
+            out = self._pull_batch(keys, worker_epoch, worker_id)
         reg = self.registry
         reg.observe("ps_store_pull_seconds", time.perf_counter() - t0)
         reg.inc("ps_store_pulls_total")
@@ -499,7 +501,8 @@ class AsyncParamServer:
         if not obs_gate.enabled():
             return self._push_batch(worker_id, keys, grads, worker_epoch)
         t0 = time.perf_counter()
-        ok = self._push_batch(worker_id, keys, grads, worker_epoch)
+        with obs_trace.span("ps_store/push", n_keys=int(len(keys))):
+            ok = self._push_batch(worker_id, keys, grads, worker_epoch)
         reg = self.registry
         reg.observe("ps_store_push_seconds", time.perf_counter() - t0)
         reg.inc("ps_store_pushes_total")

@@ -27,6 +27,8 @@ import optax
 
 from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.obs import trace as trace_mod
+from lightctr_tpu.utils.profiling import annotate
 from lightctr_tpu.core.config import TrainConfig
 from lightctr_tpu.core.mesh import replicated, shard_batch
 from lightctr_tpu.data.batching import minibatches
@@ -471,11 +473,33 @@ class CTRTrainer:
                 self.params, self.opt_state, self._put(batch)
             )
             return loss
+        if trace_mod.enabled():
+            # separate path so the default (tracing-off) step pays exactly
+            # one extra branch — the overhead guard measures this path
+            return self._train_step_traced(batch)
         t0 = time.perf_counter()
         dev_batch = self._put(batch)
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, dev_batch
         )
+        self._record_step(time.perf_counter() - t0, dev_batch)
+        return loss
+
+    def _train_step_traced(self, batch: Dict[str, np.ndarray]) -> float:
+        """Phase-spanned step: ``annotate`` puts the same names on the XLA
+        profiler timeline and the wire trace (obs/trace.py), and any PS
+        RPC issued under these phases stitches into this step's trace via
+        the wire trace header.  The sparse trainer's jit-time phases
+        (``sparse_tables/dedup_gather`` / ``sparse_exchange`` / ``apply``)
+        appear under ``trainer/exec`` on the first (tracing) step."""
+        t0 = time.perf_counter()
+        with annotate("trainer/step", step=self._steps_seen + 1):
+            with annotate("trainer/input"):
+                dev_batch = self._put(batch)
+            with annotate("trainer/exec"):
+                self.params, self.opt_state, loss = self._step(
+                    self.params, self.opt_state, dev_batch
+                )
         self._record_step(time.perf_counter() - t0, dev_batch)
         return loss
 
@@ -601,6 +625,13 @@ class CTRTrainer:
         (fm_predict.cpp:56-77).  With ``batch_size``, evaluation streams in
         fixed-size chunks with running sums + streaming AUC histograms —
         memory-bounded for epoch-scale sets (the histogram AUC's purpose)."""
+        with annotate("trainer/eval",
+                      examples=int(len(arrays["labels"]))):
+            return self._evaluate(arrays, batch_size)
+
+    def _evaluate(
+        self, arrays: Dict[str, np.ndarray], batch_size: Optional[int] = None
+    ) -> Dict[str, float]:
         labels_all = arrays["labels"]
         n = len(labels_all)
         if batch_size is None or batch_size >= n:

@@ -224,7 +224,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         dense = {k: v for k, v in params.items() if k not in spec}
         batch2 = dict(batch)
         uids = {}
-        with annotate("sparse_tables/dedup_gather"):
+        with annotate("sparse_tables/dedup_gather", tables=len(spec)):
             for k, fields in spec.items():
                 ids = jnp.concatenate(
                     [batch[f].reshape(-1) for f in fields]
@@ -401,7 +401,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                     xbytes[k] = sparse_exchange_bytes(
                         n, uids[k].shape[0], dim, bits
                     )
-                    with annotate("sparse_tables/sparse_exchange"):
+                    with annotate("sparse_tables/sparse_exchange", table=k):
                         gu, merged = _sparse_all_reduce_local(
                             uids[k], g_rows[k], "data", n, average=True,
                             compress_bits=bits,
@@ -424,7 +424,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                 else:
                     policy[k] = "dense"
                     xbytes[k] = dense_ring_bytes(vocab, dim, n, bits)
-                    with annotate("sparse_tables/dense_exchange"):
+                    with annotate("sparse_tables/dense_exchange", table=k):
                         g = jnp.zeros_like(tables[k]).at[uids[k]].add(
                             g_rows[k]
                         )

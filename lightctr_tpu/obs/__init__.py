@@ -19,6 +19,13 @@ Entry points
     it a file).
 ``merge_snapshots`` / ``render_prometheus`` / ``histogram_quantile``
     aggregate shard snapshots cluster-wide and expose them.
+``trace`` (submodule)
+    causal span tracer — ``obs.trace.span(name)`` regions stitched across
+    the PS wire; ``LIGHTCTR_TRACE=<rate>`` samples,
+    ``LIGHTCTR_TRACE_DIR`` streams span JSONL per process.
+``flight`` (submodule)
+    crash flight recorder — ``LIGHTCTR_FLIGHT=<dir>`` dumps the span
+    ring, event ring, and registry snapshots on crash/SIGTERM/SIGUSR1.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -41,6 +48,12 @@ from lightctr_tpu.obs.events import (  # noqa: F401
 from lightctr_tpu.obs.events import configure as configure_event_log  # noqa: F401
 from lightctr_tpu.obs.events import emit as emit_event  # noqa: F401
 from lightctr_tpu.obs.events import get_event_log  # noqa: F401
+from lightctr_tpu.obs import trace  # noqa: F401  (obs.trace.span / export)
+from lightctr_tpu.obs import flight  # noqa: F401  (crash flight recorder)
+
+# LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
+# inherits the variable — the multi-process PS run's postmortem switch
+flight.maybe_install_from_env()
 
 import logging as _logging
 

@@ -54,6 +54,11 @@ class EventLog:
         self.dropped = 0
         self.flushed = 0
         self.flush_errors = 0
+        if path is not None:
+            # short-lived processes (benches, multiprocess-test workers)
+            # must not lose the tail of the buffer between the last
+            # flush_every boundary and interpreter exit
+            atexit.register(self.flush)
 
     def emit(self, kind: str, **fields) -> None:
         """Append one record.  Fields must be JSON-serializable."""
@@ -74,13 +79,28 @@ class EventLog:
         with self._lock:
             return list(self._buf)
 
+    @staticmethod
+    def _dump_record(rec: Dict) -> str:
+        """One record -> one JSON line, never raising: a non-JSON value
+        smuggled into a record (numpy scalar, set, ...) degrades THAT
+        record via repr instead of poisoning the buffer forever — a
+        TypeError escaping the flush would crash the instrumented caller
+        and then re-raise on every later flush attempt."""
+        try:
+            return json.dumps(rec, sort_keys=True)
+        except (TypeError, ValueError):
+            try:
+                return json.dumps(rec, sort_keys=True, default=repr)
+            except (TypeError, ValueError):  # e.g. non-string dict keys
+                return json.dumps({"unserializable": repr(rec)})
+
     def _flush_locked(self) -> None:
         if self.path is None or not self._buf:
             return
         try:
             with open(self.path, "a") as f:
                 for rec in self._buf:
-                    f.write(json.dumps(rec, sort_keys=True) + "\n")
+                    f.write(self._dump_record(rec) + "\n")
         except OSError:
             # telemetry must never kill the training step (full disk,
             # removed directory, ...): count the failure, fall back to
@@ -101,16 +121,32 @@ class EventLog:
 
     def close(self) -> None:
         self.flush()
+        if self.path is not None:
+            # drop the atexit reference so a closed log can be collected
+            try:
+                atexit.unregister(self.flush)
+            except Exception:
+                pass
 
 
-def read_jsonl(path: str) -> List[Dict]:
-    """Load a JSONL event file back into records (blank lines skipped)."""
+def read_jsonl(path: str, strict: bool = False) -> List[Dict]:
+    """Load a JSONL event file back into records (blank lines skipped).
+
+    Tolerant by default: a malformed line — the torn tail a crashed
+    writer leaves behind, or a corrupted record — is skipped rather than
+    aborting the whole read (``strict=True`` restores the raise), so a
+    postmortem can always summarize what DID land."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                if strict:
+                    raise
     return out
 
 
@@ -127,10 +163,12 @@ def configure(
     capacity: int = 4096,
     flush_every: int = 256,
 ) -> EventLog:
-    """Replace the process-default event log (flushing the old one).
+    """Replace the process-default event log (flushing the old one —
+    close(), so a path-backed predecessor also drops its atexit
+    registration instead of pinning itself for the process lifetime).
     ``configure()`` with no arguments resets to a fresh in-memory log."""
     global _default
-    _default.flush()
+    _default.close()
     _default = EventLog(path=path, capacity=capacity,
                         flush_every=flush_every)
     return _default

@@ -5,8 +5,13 @@ counters (``clock_start``/``clock_cycles``, time.h:81-99) and DEBUG printf
 tracing; on TPU the right tool is ``jax.profiler`` traces viewed in
 Perfetto/TensorBoard.
 
-``trace(dir)`` wraps a region; ``wall_clock()`` reproduces the reference's
-train-wall-clock counter pair.
+``trace(dir)`` wraps a region (and emits a ``trace_capture`` event through
+the obs event log so captures are discoverable from telemetry);
+``wall_clock()`` reproduces the reference's train-wall-clock counter pair;
+``annotate(name)`` tags a sub-region on EVERY timeline at once — the XLA
+profiler's host track, the HLO metadata, and the obs span tracer
+(obs/trace.py) — so a region carries the same name in a Perfetto device
+trace and in a cross-process wire trace.
 
 Caveat (environment note): under the experimental ``axon`` remote-TPU
 platform the profiler hangs — use on CPU or directly-attached TPU.
@@ -15,20 +20,45 @@ platform the profiler hangs — use on CPU or directly-attached TPU.
 from __future__ import annotations
 
 import contextlib
+import logging
 import time
 from typing import Iterator, Optional
+
+from lightctr_tpu.obs import events as _events
+from lightctr_tpu.obs import trace as _trace
+
+_LOG = logging.getLogger(__name__)
 
 
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
-    """jax.profiler trace around a region; view in TensorBoard/Perfetto."""
-    import jax
+    """jax.profiler trace around a region; view in TensorBoard/Perfetto.
 
-    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    Emits a ``trace_capture`` event so the capture (and where it landed)
+    shows up in the run's event log; degrades to a logged no-op when
+    ``jax.profiler`` is unavailable — a CPU-only worker process asking for
+    a profile must not crash, just not profile."""
+    try:
+        import jax
+
+        profiler = jax.profiler
+    except Exception:  # jax absent or profiler backend broken
+        _LOG.warning(
+            "jax.profiler unavailable: profiling.trace(%r) is a no-op",
+            log_dir,
+        )
+        _events.emit("trace_capture", log_dir=str(log_dir),
+                     perfetto_link=bool(create_perfetto_link),
+                     unavailable=True)
+        yield
+        return
+    _events.emit("trace_capture", log_dir=str(log_dir),
+                 perfetto_link=bool(create_perfetto_link))
+    profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        profiler.stop_trace()
 
 
 class wall_clock:
@@ -59,26 +89,31 @@ class wall_clock:
 
 
 @contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named sub-region for traces: tags BOTH timelines — the host timeline
-    (``jax.profiler.TraceAnnotation``) and the device/HLO metadata
+def annotate(name: str, **attrs) -> Iterator[None]:
+    """Named sub-region for traces: tags ALL timelines — the host timeline
+    (``jax.profiler.TraceAnnotation``), the device/HLO metadata
     (``jax.named_scope``, so the region name survives into compiled-program
-    profiles even though the body runs at trace time).
+    profiles even though the body runs at trace time), and the obs span
+    tracer (a span when tracing is sampled, ``attrs`` attached) — one name
+    across XLA profiler traces and cross-process wire traces.
 
     No-op-safe: usable on CPU, inside ``jit`` tracing, and in processes
     where jax (or its profiler) is unavailable — instrumented library code
     must never crash because profiling isn't."""
-    stack = contextlib.ExitStack()
+    jstack = contextlib.ExitStack()
     try:
         import jax
 
-        stack.enter_context(jax.named_scope(name))
-        stack.enter_context(jax.profiler.TraceAnnotation(name))
+        jstack.enter_context(jax.named_scope(name))
+        jstack.enter_context(jax.profiler.TraceAnnotation(name))
     except Exception:
         # unwind whatever DID enter (a half-entered named_scope left open
         # would push jax's thread-local name stack one level forever)
-        stack.close()
-        yield
-        return
-    with stack:
-        yield
+        jstack.close()
+        jstack = None
+    try:
+        with _trace.span(name, **attrs):
+            yield
+    finally:
+        if jstack is not None:
+            jstack.close()

@@ -1,4 +1,5 @@
-"""utils/profiling: wall_clock freeze semantics and no-op-safe annotate."""
+"""utils/profiling: wall_clock freeze semantics, no-op-safe annotate (now
+also a span emitter), and trace()'s trace_capture event."""
 
 import time
 
@@ -7,6 +8,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from lightctr_tpu import obs
+from lightctr_tpu.obs import trace as obs_trace
+from lightctr_tpu.utils import profiling
 from lightctr_tpu.utils.profiling import annotate, wall_clock
 
 
@@ -66,3 +70,66 @@ def test_annotate_nested():
     with annotate("outer"):
         with annotate("inner"):
             pass  # nesting must not raise (named_scope stacks)
+
+
+def test_annotate_emits_spans_when_tracing_sampled():
+    """annotate is the one-name-everywhere hook: when tracing is sampled
+    it opens an obs span under the same name (wire trace == XLA trace)."""
+    obs_trace.reset()
+    with obs.override(True), obs_trace.override_rate(1.0):
+        with annotate("phase/outer", step=3):
+            with annotate("phase/inner"):
+                pass
+    spans = {s["name"]: s for s in obs_trace.finished()}
+    assert set(spans) == {"phase/outer", "phase/inner"}
+    assert spans["phase/inner"]["parent"] == spans["phase/outer"]["span"]
+    assert spans["phase/outer"]["attrs"] == {"step": 3}
+    obs_trace.reset()
+
+
+def test_trace_emits_trace_capture_event(tmp_path):
+    """Satellite: a profiler capture announces itself through the event
+    log, so telemetry consumers can FIND the capture artifacts."""
+    obs.configure_event_log()
+    try:
+        with obs.override(True):
+            with profiling.trace(str(tmp_path / "profile"),
+                                 create_perfetto_link=False):
+                pass
+        recs = [r for r in obs.get_event_log().records()
+                if r["kind"] == "trace_capture"]
+        assert len(recs) == 1
+        assert recs[0]["log_dir"].endswith("profile")
+        assert recs[0]["perfetto_link"] is False
+    finally:
+        obs.configure_event_log()
+
+
+def test_trace_degrades_to_noop_without_jax_profiler(tmp_path, monkeypatch,
+                                                     caplog):
+    """Satellite: jax.profiler unavailable -> logged warning + no-op, and
+    the trace_capture event records the degradation."""
+    import builtins
+    import logging
+
+    real_import = builtins.__import__
+
+    def no_jax(name, *a, **k):
+        if name == "jax":
+            raise ImportError("no jax here")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_jax)
+    obs.configure_event_log()
+    try:
+        with obs.override(True), caplog.at_level(
+                logging.WARNING, logger="lightctr_tpu.utils.profiling"):
+            with profiling.trace(str(tmp_path / "p")):
+                ran = True
+        assert ran
+        assert any("no-op" in r.message for r in caplog.records)
+        recs = [r for r in obs.get_event_log().records()
+                if r["kind"] == "trace_capture"]
+        assert recs and recs[0]["unavailable"] is True
+    finally:
+        obs.configure_event_log()

@@ -1,0 +1,378 @@
+"""Distributed tracing + crash flight recorder (obs/trace.py, obs/flight.py,
+tools/trace_report.py): span trees, wire trace-context stitching across
+processes, Perfetto export, and the SIGTERM flight bundle."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from lightctr_tpu import obs
+from lightctr_tpu.obs import flight, trace
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def traced(tmp_path):
+    """Tracing on at rate 1.0 with a JSONL sink; fully restored after."""
+    trace.reset()
+    trace.configure(path=str(tmp_path / "trace-client.jsonl"),
+                    flush_every=1)
+    with obs.override(True), trace.override_rate(1.0):
+        yield tmp_path
+    trace.configure()
+    trace.reset()
+
+
+# -- span core ---------------------------------------------------------------
+
+
+def test_span_tree_parents_and_ring(traced):
+    with trace.span("root", step=7):
+        root_ctx = trace.current_context()
+        with trace.span("child"):
+            with trace.span("grandchild"):
+                pass
+    spans = {s["name"]: s for s in trace.finished()}
+    assert set(spans) == {"root", "child", "grandchild"}
+    assert "parent" not in spans["root"]
+    assert spans["child"]["parent"] == spans["root"]["span"]
+    assert spans["grandchild"]["parent"] == spans["child"]["span"]
+    assert len({s["trace"] for s in spans.values()}) == 1
+    assert spans["root"]["attrs"] == {"step": 7}
+    assert all(s["dur_s"] >= 0 for s in spans.values())
+    assert f"{root_ctx[0]:016x}" == spans["root"]["trace"]
+    # the sink streamed them too (flush_every=1)
+    recs = obs.read_jsonl(str(traced / "trace-client.jsonl"))
+    assert {r["name"] for r in recs} == {"root", "child", "grandchild"}
+
+
+def test_remote_continuation_adopts_parent(traced):
+    with trace.span("trainer/step"):
+        ctx = trace.current_context()
+    with trace.span("ps/pull", remote=ctx):
+        pass
+    spans = {s["name"]: s for s in trace.finished()}
+    assert spans["ps/pull"]["trace"] == spans["trainer/step"]["trace"]
+    assert spans["ps/pull"]["parent"] == spans["trainer/step"]["span"]
+
+
+def test_remote_subtree_records_even_with_local_rate_zero():
+    """A PS server without LIGHTCTR_TRACE (rate 0) must still record the
+    FULL subtree under a remote-continued span — the sender made the
+    sampling decision; the local rate only gates new roots."""
+    trace.reset()
+    with obs.override(True), trace.override_rate(0.0):
+        with trace.span("ps/pull", remote=(1234, 5678)):
+            with trace.span("ps_store/pull"):
+                pass
+        with trace.span("local-root"):  # rate 0: new roots stay gated
+            pass
+    spans = {s["name"]: s for s in trace.finished()}
+    assert set(spans) == {"ps/pull", "ps_store/pull"}
+    assert spans["ps_store/pull"]["parent"] == spans["ps/pull"]["span"]
+    assert spans["ps/pull"]["trace"] == f"{1234:016x}"
+    trace.reset()
+
+
+def test_tracing_disabled_is_inert_and_leaks_no_context():
+    trace.reset()
+    assert not trace.enabled()  # default rate 0
+    with trace.span("invisible"):
+        assert trace.current_context() is None
+    assert trace.finished() == []
+
+
+def test_unsampled_heads_suppress_their_whole_subtree():
+    trace.reset()
+    with obs.override(True), trace.override_rate(1e-9):
+        for _ in range(50):
+            with trace.span("head"):
+                with trace.span("child"):
+                    assert trace.current_context() is None
+    # stack discipline held and (statistically certain) nothing recorded
+    assert trace._ctx.stack == []
+    assert len(trace.finished()) == 0
+
+
+def test_span_records_error_class(traced):
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("x")
+    (rec,) = trace.finished()
+    assert rec["error"] == "ValueError"
+
+
+def test_non_json_attrs_degrade_instead_of_raising(traced, tmp_path):
+    """A numpy scalar (or any non-JSON value) in span attrs must never
+    raise out of the span exit / sink flush (the 'never raises' contract)
+    nor poison the sink buffer — the record degrades via repr."""
+    with trace.span("bad-attr", n=np.int64(3)):
+        pass
+    with trace.span("good"):
+        pass
+    trace.flush()  # would raise TypeError without the per-record fallback
+    recs = obs.read_jsonl(str(traced / "trace-client.jsonl"))
+    assert {r["name"] for r in recs} == {"bad-attr", "good"}
+    # and the flight bundle survives the same record in the ring
+    path = flight.dump("bad-attr-test", dir=str(tmp_path / "fb"))
+    assert path is not None
+    names = {r.get("name") for r in obs.read_jsonl(path)
+             if r.get("kind") == "span"}
+    assert {"bad-attr", "good"} <= names
+
+
+def test_chrome_trace_export_shape(traced):
+    with trace.span("a"):
+        with trace.span("b"):
+            pass
+    ct = trace.to_chrome_trace(trace.finished())
+    assert {e["ph"] for e in ct["traceEvents"]} == {"X"}
+    names = {e["name"] for e in ct["traceEvents"]}
+    assert names == {"a", "b"}
+    json.dumps(ct)  # Perfetto-loadable == valid JSON with traceEvents
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in ct["traceEvents"])
+
+
+def test_traced_trainer_step_emits_phase_spans(traced):
+    """Span-creation coverage for the trainer path: one traced step yields
+    the step/input/exec phase tree (the names profiling.annotate shares
+    with the XLA profiler timelines)."""
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+    rng = np.random.default_rng(0)
+    d = 16
+    batch = {
+        "x": rng.normal(size=(32, d)).astype(np.float32),
+        "labels": (rng.random(32) > 0.5).astype(np.float32),
+    }
+    tr = CTRTrainer({"w": np.zeros((d,), np.float32)},
+                    lambda p, b: b["x"] @ p["w"],
+                    TrainConfig(learning_rate=0.1))
+    obs.configure_event_log()
+    try:
+        tr.train_step(batch)
+    finally:
+        obs.configure_event_log()
+    spans = {s["name"]: s for s in trace.finished()}
+    assert {"trainer/step", "trainer/input", "trainer/exec"} <= set(spans)
+    step = spans["trainer/step"]
+    assert spans["trainer/input"]["parent"] == step["span"]
+    assert spans["trainer/exec"]["parent"] == step["span"]
+    assert step["attrs"]["step"] == 1
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_dump_bundle_contents(tmp_path, traced):
+    with trace.span("work"):
+        pass
+    obs.emit_event("step", step=1)
+    reg = obs.MetricsRegistry()
+    reg.inc("shard_counter", 3)
+    flight.register_registry("shard0", reg)
+    try:
+        path = flight.dump("unit-test", dir=str(tmp_path / "bundles"))
+    finally:
+        flight.unregister_registry("shard0")
+    recs = obs.read_jsonl(path)
+    header = recs[0]
+    assert header["kind"] == "flight" and header["reason"] == "unit-test"
+    kinds = [r["kind"] for r in recs]
+    assert "span" in kinds and "flight_event" in kinds
+    regs = {r["registry"]: r for r in recs if r["kind"] == "metrics"}
+    assert "default" in regs
+    assert regs["shard0"]["snapshot"]["counters"]["shard_counter"] == 3
+    # tmp + rename: no torn .tmp left behind
+    assert glob.glob(str(tmp_path / "bundles" / "*.tmp")) == []
+
+
+def test_flight_excepthook_and_sigusr1(tmp_path):
+    flight.install(str(tmp_path))
+    try:
+        # excepthook chain: dump, then delegate to the previous hook
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        bundles = glob.glob(str(tmp_path / "flight-*.jsonl"))
+        assert len(bundles) == 1
+        recs = obs.read_jsonl(bundles[0])
+        assert recs[0]["reason"] == "exception:RuntimeError"
+        if hasattr(signal, "SIGUSR1"):
+            os.kill(os.getpid(), signal.SIGUSR1)  # dump-and-keep-running
+            # the dump runs on a helper thread (the handler must never
+            # block on telemetry locks the interrupted frame may hold)
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                bundles = glob.glob(str(tmp_path / "flight-*.jsonl"))
+                if len(bundles) >= 2:
+                    break
+                time.sleep(0.02)
+            assert len(bundles) >= 2  # still alive to assert it
+    finally:
+        flight.uninstall()
+
+
+def test_event_log_atexit_flushes_short_lived_process(tmp_path):
+    """Satellite: a process that emits fewer events than flush_every and
+    exits without close() must still land them on disk (atexit flush)."""
+    path = tmp_path / "events.jsonl"
+    script = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, %r)
+        from lightctr_tpu import obs
+        obs.configure_event_log(path=%r, flush_every=256)
+        obs.emit_event("step", step=1)
+        obs.emit_event("epoch", epoch=0)
+        # exit WITHOUT flush/close — atexit must drain the tail
+        """
+    ) % (str(REPO_ROOT), str(path))
+    subprocess.run([sys.executable, "-c", script], check=True,
+                   env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=60)
+    recs = obs.read_jsonl(str(path))
+    assert [r["kind"] for r in recs] == ["step", "epoch"]
+
+
+# -- acceptance: 2-process stitched trace + SIGTERM flight bundle ------------
+
+
+def test_two_process_trace_stitches_and_sigterm_leaves_flight_bundle(tmp_path):
+    """ISSUE 3 acceptance: a 2-process PS run under LIGHTCTR_TRACE=1
+    produces a trace where the trainer step span has child spans from the
+    ps_server PROCESS (stitched via the wire trace header);
+    tools/trace_report.py exports Perfetto JSON over the per-process span
+    files; SIGTERM leaves a flight bundle that --flight summarizes."""
+    trace_dir = str(tmp_path / "traces")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        LIGHTCTR_TRACE="1", LIGHTCTR_TRACE_DIR=trace_dir,
+        LIGHTCTR_FLIGHT=trace_dir,
+    )
+    server = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, %r)
+        from lightctr_tpu.embed.async_ps import AsyncParamServer
+        from lightctr_tpu.dist.ps_server import ParamServerService
+        ps = AsyncParamServer(dim=4, n_workers=1, seed=0)
+        svc = ParamServerService(ps)
+        print("ADDR", svc.address[0], svc.address[1], flush=True)
+        sys.stdin.read()   # serve until killed
+        """
+    ) % str(REPO_ROOT)
+    proc = subprocess.Popen([sys.executable, "-c", server],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, env=env)
+    client = None
+    try:
+        line = proc.stdout.readline().split()
+        assert line[0] == "ADDR", line
+        addr = (line[1], int(line[2]))
+
+        from lightctr_tpu.dist.ps_server import PSClient
+
+        trace.reset()
+        trace.configure(path=os.path.join(trace_dir, "trace-client.jsonl"),
+                        flush_every=1)
+        try:
+            with obs.override(True), trace.override_rate(1.0):
+                client = PSClient(addr, 4)
+                keys = np.arange(64, dtype=np.int64)
+                for step in range(2):
+                    # the PS-worker step shape (tools/criteo_ps_soak):
+                    # pull -> compute -> push, one step span around it
+                    with trace.span("trainer/step", step=step):
+                        out = client.pull_arrays(keys, worker_epoch=step,
+                                                 worker_id=0)
+                        assert out is not None
+                        g = np.ones((64, 4), np.float32)
+                        client.push_arrays(0, keys, g, worker_epoch=step)
+            client_spans = trace.finished()
+        finally:
+            trace.configure()  # flushes the client span file
+            trace.reset()
+
+        # SIGTERM the server: flight recorder dumps, span file flushes
+        proc.terminate()
+        proc.wait(timeout=30)
+
+        # the per-process span files now hold both halves of the trace
+        report = json.loads(subprocess.run(
+            [sys.executable, "-m", "tools.trace_report", trace_dir,
+             "--perfetto", str(tmp_path / "perfetto.json")],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=str(REPO_ROOT), capture_output=True, text=True, check=True,
+            timeout=120,
+        ).stdout)
+        assert report["spans"] >= 8  # 2 steps x (step+pull+push) x 2 sides
+        assert len(report["processes"]) == 2
+        assert report["cross_process_edges"] >= 4
+        assert "trainer/step" in report["phases"]
+        assert "ps/pull" in report["phases"] and "ps/push" in report["phases"]
+
+        # verify the causal chain explicitly: a server-side ps/pull span's
+        # ancestry reaches the client's trainer/step span
+        spans = {}
+        for f in glob.glob(os.path.join(trace_dir, "trace-*.jsonl")):
+            for r in obs.read_jsonl(f):
+                if r.get("kind") == "span":
+                    spans[r["span"]] = r
+        step_spans = {s["span"] for s in spans.values()
+                      if s["name"] == "trainer/step"}
+        client_pids = {s["pid"] for s in spans.values()
+                       if s["name"] == "trainer/step"}
+        stitched = 0
+        for s in spans.values():
+            if s["name"] != "ps/pull" or s["pid"] in client_pids:
+                continue
+            hops = 0
+            cur = s
+            while cur is not None and hops < 10:
+                if cur["span"] in step_spans:
+                    stitched += 1
+                    break
+                cur = spans.get(cur.get("parent"))
+                hops += 1
+        assert stitched >= 1, "no server ps/pull span reached trainer/step"
+
+        # Perfetto export is valid JSON with events from both processes
+        with open(tmp_path / "perfetto.json") as f:
+            perfetto = json.load(f)
+        pids = {e["pid"] for e in perfetto["traceEvents"] if e["ph"] == "X"}
+        assert len(pids) == 2
+
+        # the SIGTERM flight bundle exists and --flight summarizes it
+        bundles = glob.glob(os.path.join(trace_dir, "flight-*.jsonl"))
+        assert len(bundles) == 1, bundles
+        flight_report = json.loads(subprocess.run(
+            [sys.executable, "-m", "tools.trace_report",
+             "--flight", bundles[0]],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=str(REPO_ROOT), capture_output=True, text=True, check=True,
+            timeout=120,
+        ).stdout)
+        assert flight_report["reason"] == "signal:SIGTERM"
+        assert flight_report["pid"] == proc.pid
+        assert flight_report["span_ring"]["spans"] > 0
+        assert any(name.startswith("ps_shard_")
+                   for name in flight_report["registries"])
+        del client_spans
+    finally:
+        if client is not None:
+            client.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)

@@ -100,6 +100,99 @@ def test_native_f16_codec_bit_parity_with_numpy(rng):
         bindings.f16_decode_native(enc.tobytes(), v.size + 1)
 
 
+def test_trace_ctx_header_roundtrip():
+    """The optional wire trace header: varint-framed, self-delimiting, and
+    63-bit-id safe through the zigzag codec."""
+    for tid, sid in [(1, 2), (2**62, 2**63 - 1), (123456789, 987654321)]:
+        buf = wire.pack_trace_ctx(tid, sid) + b"PAYLOAD"
+        (t, s), used = wire.split_trace_ctx(buf)
+        assert (t, s) == (tid, sid)
+        assert buf[used:] == b"PAYLOAD"
+
+
+def test_headerless_frames_are_bit_identical_to_old_format():
+    """Wire compat: with no trace context, the new framing emits EXACTLY
+    the pre-trace bytes — an old peer cannot tell the difference."""
+    import socket
+    import struct
+
+    from lightctr_tpu.dist.ps_server import _send_msg
+
+    a, b = socket.socketpair()
+    try:
+        payload = wire.pack_keys(np.arange(10, dtype=np.int64))
+        n = _send_msg(a, 3, payload)  # no trace_ctx
+        old_frame = struct.pack("<IB", len(payload), 3) + payload
+        assert n == len(old_frame)
+        assert b.recv(4096) == old_frame
+        # flagged frame: type byte carries TRACE_FLAG, payload grows by
+        # exactly the header — everything after it is the old payload
+        n2 = _send_msg(a, 3, payload, trace_ctx=(77, 88))
+        got = b.recv(4096)
+        length, raw_type = struct.unpack("<IB", got[:5])
+        assert raw_type == 3 | wire.TRACE_FLAG
+        ctx, used = wire.split_trace_ctx(got[5:])
+        assert ctx == (77, 88)
+        assert got[5 + used:] == payload and n2 == len(got)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_mixed_old_new_client_server_pairs_interoperate():
+    """An OLD client (raw pre-trace frames, no header) against the NEW
+    server, and the NEW client with tracing off (which emits old-format
+    bytes — asserted above) against the new server: both round-trip."""
+    import socket
+    import struct
+
+    from lightctr_tpu import obs
+    from lightctr_tpu.dist.ps_server import (
+        MSG_PULL, PSClient, ParamServerService, _recv_msg,
+    )
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+    from lightctr_tpu.obs import trace as trace_mod
+
+    dim = 3
+    ps = AsyncParamServer(dim=dim, n_workers=1, seed=0)
+    svc = ParamServerService(ps)
+    try:
+        # old-style client: hand-rolled pre-trace frames on a raw socket
+        keys = np.arange(8, dtype=np.int64)
+        hdr = wire.pack_varint(np.array([0 + 1, 0], np.int64))
+        payload = hdr + wire.pack_keys(keys)
+        raw = socket.create_connection(svc.address)
+        try:
+            raw.sendall(struct.pack("<IB", len(payload), MSG_PULL) + payload)
+            _, reply = _recv_msg(raw)
+            assert reply[:1] == b"\x00"
+            got_keys, rows = wire.split_keys(reply[1:])[0], None
+            np.testing.assert_array_equal(got_keys, keys)
+        finally:
+            raw.close()
+        # new client, tracing at its default (off): old bytes on the wire
+        with trace_mod.override_rate(0.0):
+            c = PSClient(svc.address, dim)
+            try:
+                out = c.pull_arrays(keys, worker_epoch=0, worker_id=0)
+                assert out is not None
+                np.testing.assert_array_equal(out[0], keys)
+            finally:
+                c.close()
+        # new client with tracing SAMPLING: flagged frames, same replies
+        with obs.override(True), trace_mod.override_rate(1.0):
+            c = PSClient(svc.address, dim)
+            try:
+                with trace_mod.span("test/step"):
+                    out = c.pull_arrays(keys, worker_epoch=0, worker_id=0)
+                assert out is not None
+                np.testing.assert_array_equal(out[0], keys)
+            finally:
+                c.close()
+    finally:
+        svc.close()
+
+
 def test_rows_adagrad_native_matches_numpy_path(rng):
     """Fused one-pass server adagrad (ps_rows.cpp) == the numpy five-pass
     _apply, through the public push/pull surface, above and below the

@@ -24,6 +24,7 @@ slice the same script runs unchanged with JAX_PLATFORMS unset.
 import argparse
 import json
 import os
+import sys
 import time
 
 # CPU-pinned by default (set LIGHTCTR_CRITEO_REAL=1 to run on real attached
@@ -72,7 +73,7 @@ def main():
     train_path = "/tmp/criteo_proxy/train.ffm"
     eval_path = "/tmp/criteo_proxy/eval.ffm"
     if not os.path.exists(train_path):
-        print(f"synthesizing {args.rows} train rows...")
+        print(f"synthesizing {args.rows} train rows...", file=sys.stderr)
         synthesize(train_path, args.rows, seed=0)
     if not os.path.exists(eval_path):
         synthesize(eval_path, args.eval_rows, seed=1)
@@ -182,7 +183,7 @@ def main():
     assert a > 0.55, f"planted signal not recovered: AUC={a}"
     with open(args.out, "w") as f:
         json.dump(payload, f, indent=1)
-    print(f"wrote {args.out}")
+    print(f"wrote {args.out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,7 @@ a real multi-chip slice can measure that.
 
 import json
 import os
+import sys
 import time
 
 from lightctr_tpu.utils.devicecheck import pin_cpu_platform
@@ -92,7 +93,8 @@ def main():
     n = len(jax.devices())
     assert n >= 8, f"need 8 virtual devices, got {n}"
 
-    print(f"1-device run ({STEPS} steps, table {FEATURE_CNT}x{DIM})...")
+    print(f"1-device run ({STEPS} steps, table {FEATURE_CNT}x{DIM})...",
+          file=sys.stderr)
     l1, t1 = run()
 
     runs = {}
@@ -105,7 +107,7 @@ def main():
         ("data8_zero_sharded", MeshSpec(data=8), {"zero_sharded": True}),
     ):
         mesh = make_mesh(spec)
-        print(f"{spec_name} run...")
+        print(f"{spec_name} run...", file=sys.stderr)
         if kw.get("zero_sharded"):
             lk, tk = run(mesh=mesh, zero_sharded=True)
         else:
@@ -117,7 +119,8 @@ def main():
             "max_abs_loss_diff_vs_1dev": float(diff),
             "final_loss": float(lk[-1]),
         }
-        print(f"  max|Δloss| vs 1-dev: {diff:.2e}  per-step {tk/STEPS*1e3:.2f} ms")
+        print(f"  max|Δloss| vs 1-dev: {diff:.2e}  "
+              f"per-step {tk/STEPS*1e3:.2f} ms", file=sys.stderr)
 
     assert l1[-1] < l1[0], "1-device run did not converge"
     for name, r in runs.items():
@@ -146,7 +149,7 @@ def main():
     }
     with open("MULTICHIP_r03.json", "w") as f:
         json.dump(payload, f, indent=1)
-    print("wrote MULTICHIP_r03.json")
+    print("wrote MULTICHIP_r03.json", file=sys.stderr)
 
 
 if __name__ == "__main__":

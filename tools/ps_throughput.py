@@ -209,7 +209,7 @@ def main(argv=None):
     }
     with open(args.out, "w") as f:
         json.dump(art, f, indent=1)
-    print(f"wrote {args.out}")
+    print(f"wrote {args.out}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -157,7 +157,8 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
               f"live {live_b['total']:,} B/step vs dense "
               f"{dense_b['total']:,} B/step ({sweep[-1]['reduction_x']}x), "
               f"{ex_s_sparse:,.0f} vs {ex_s_dense:,.0f} ex/s, "
-              f"policy={sweep[-1]['exchange_policy']}", flush=True)
+              f"policy={sweep[-1]['exchange_policy']}", file=sys.stderr,
+              flush=True)
 
     criteo_like = sweep[-1]
     report = {

@@ -40,6 +40,7 @@ def scan_time(body, carry, label, length):
     dt = time.perf_counter() - t0
     print(
         f"{label:32s} compile {t_compile:6.1f}s  warm {dt / length * 1000:8.2f} ms/step",
+        file=sys.stderr,
         flush=True,
     )
 
@@ -53,7 +54,8 @@ def main():
     params = fm.init(jax.random.PRNGKey(0), ds.feature_cnt, 8)
     tx = optim.adagrad(0.1)
     state = tx.init(params)
-    print(f"devices: {jax.devices()}  F={ds.feature_cnt}  scan={length}", flush=True)
+    print(f"devices: {jax.devices()}  F={ds.feature_cnt}  scan={length}",
+          file=sys.stderr, flush=True)
 
     def lossf(p):
         z, l2 = fm.logits_with_l2(p, b)

@@ -1,0 +1,219 @@
+"""Summarize distributed traces and crash flight bundles.
+
+The span tracer (lightctr_tpu/obs/trace.py) leaves one JSONL span file per
+process (``LIGHTCTR_TRACE_DIR``), and the flight recorder
+(lightctr_tpu/obs/flight.py) leaves a crash bundle whose span section uses
+the same record shape.  This tool merges any mix of them into one causal
+view:
+
+  python -m tools.trace_report TRACE.jsonl [MORE.jsonl ...|DIR]
+      # -> per-phase critical-path summary (total / self time per span
+      #    name), slowest-span table, cross-process stitch counts
+  python -m tools.trace_report DIR --perfetto OUT.json
+      # -> Chrome trace-event JSON: load in Perfetto (ui.perfetto.dev)
+      #    or chrome://tracing; cross-process parent links drawn as
+      #    flow arrows
+  python -m tools.trace_report --flight BUNDLE.jsonl
+      # -> flight-bundle postmortem: reason, registry snapshots, span
+      #    ring and event ring summaries
+
+A directory argument expands to every ``trace-*.jsonl`` inside it (the
+per-process files one run leaves behind).  Reads are tolerant of torn
+tails — a crashed writer's half-line is skipped, not fatal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from lightctr_tpu.obs import read_jsonl  # noqa: E402
+from lightctr_tpu.obs.trace import to_chrome_trace  # noqa: E402
+
+
+def _expand(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "trace-*.jsonl"))))
+        else:
+            out.append(p)
+    return out
+
+
+def load_spans(paths: List[str]) -> List[Dict]:
+    """Collect span records from span JSONL files and/or flight bundles
+    (both carry ``kind == "span"`` records), deduped by span id — the
+    same span can appear in a stream file AND a crash bundle."""
+    seen = set()
+    spans: List[Dict] = []
+    for path in _expand(paths):
+        for rec in read_jsonl(path):
+            if rec.get("kind") != "span" or "span" not in rec:
+                continue
+            if rec["span"] in seen:
+                continue
+            seen.add(rec["span"])
+            spans.append(rec)
+    spans.sort(key=lambda r: r.get("ts", 0.0))
+    return spans
+
+
+def summarize_spans(spans: List[Dict], top: int = 10) -> Dict:
+    """Spans -> report: per-phase (span name) totals with SELF time — a
+    span's duration minus its children's, the critical-path view that says
+    where the time actually went — plus the slowest individual spans and
+    how much of the tree crossed a process boundary."""
+    by_id = {s["span"]: s for s in spans}
+    child_time: Dict[str, float] = {}
+    cross_process = 0
+    orphans = 0
+    for s in spans:
+        parent = s.get("parent")
+        if parent is None:
+            continue
+        p = by_id.get(parent)
+        if p is None:
+            orphans += 1  # parent outside the ring/file set
+            continue
+        child_time[parent] = child_time.get(parent, 0.0) + float(
+            s.get("dur_s", 0.0))
+        if p.get("pid") != s.get("pid"):
+            cross_process += 1
+
+    phases: Dict[str, Dict] = {}
+    for s in spans:
+        ph = phases.setdefault(s["name"], {
+            "count": 0, "total_s": 0.0, "self_s": 0.0, "max_s": 0.0,
+            "errors": 0,
+        })
+        dur = float(s.get("dur_s", 0.0))
+        ph["count"] += 1
+        ph["total_s"] += dur
+        ph["self_s"] += max(0.0, dur - child_time.get(s["span"], 0.0))
+        ph["max_s"] = max(ph["max_s"], dur)
+        if "error" in s:
+            ph["errors"] += 1
+    for ph in phases.values():
+        ph["mean_s"] = round(ph["total_s"] / ph["count"], 6)
+        for k in ("total_s", "self_s", "max_s"):
+            ph[k] = round(ph[k], 6)
+
+    slowest = sorted(spans, key=lambda s: s.get("dur_s", 0.0),
+                     reverse=True)[:top]
+    report = {
+        "spans": len(spans),
+        "traces": len({s.get("trace") for s in spans}),
+        "processes": sorted({s.get("pid") for s in spans}),
+        "roots": sum(1 for s in spans if "parent" not in s),
+        "cross_process_edges": cross_process,
+        "orphan_parents": orphans,
+        "phases": dict(sorted(phases.items(),
+                              key=lambda kv: -kv[1]["self_s"])),
+        "slowest": [
+            {
+                "name": s["name"], "dur_s": s.get("dur_s"),
+                "pid": s.get("pid"), "trace": s.get("trace"),
+                "span": s.get("span"),
+                **({"attrs": s["attrs"]} if "attrs" in s else {}),
+            }
+            for s in slowest
+        ],
+    }
+    if spans:
+        ts = [s["ts"] for s in spans if "ts" in s]
+        if ts:
+            report["span_window_s"] = round(max(ts) - min(ts), 3)
+    return report
+
+
+def summarize_flight(path: str) -> Dict:
+    """Flight bundle -> postmortem report."""
+    recs = read_jsonl(path)
+    header = next((r for r in recs if r.get("kind") == "flight"), {})
+    spans = [r for r in recs if r.get("kind") == "span"]
+    events = [r["record"] for r in recs
+              if r.get("kind") == "flight_event" and "record" in r]
+    metrics = [r for r in recs if r.get("kind") == "metrics"]
+    event_kinds: Dict[str, int] = {}
+    for e in events:
+        k = e.get("kind", "?")
+        event_kinds[k] = event_kinds.get(k, 0) + 1
+    report = {
+        "bundle": path,
+        "reason": header.get("reason"),
+        "ts": header.get("ts"),
+        "pid": header.get("pid"),
+        "argv": header.get("argv"),
+        "registries": {
+            m.get("registry", "?"): {
+                "counters": len(m.get("snapshot", {}).get("counters", {})),
+                "gauges": len(m.get("snapshot", {}).get("gauges", {})),
+                "histograms": len(
+                    m.get("snapshot", {}).get("histograms", {})),
+            }
+            for m in metrics
+        },
+        "span_ring": summarize_spans(spans, top=5) if spans
+        else {"spans": 0},
+        "event_ring": {
+            "events": len(events),
+            "by_kind": dict(sorted(event_kinds.items())),
+            "last": events[-3:],
+        },
+    }
+    # surface the headline counters — the numbers a postmortem reads first
+    for m in metrics:
+        c = m.get("snapshot", {}).get("counters", {})
+        picked = {k: v for k, v in c.items() if k in (
+            "trainer_steps_total", "ps_protocol_errors_total",
+            "master_queued_decisions_total", "ps_store_gated_pulls_total",
+        )}
+        if picked:
+            report.setdefault("headline_counters", {})[
+                m.get("registry", "?")] = picked
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="span JSONL files, flight bundles, or directories "
+                         "of trace-*.jsonl")
+    ap.add_argument("--perfetto", metavar="OUT_JSON",
+                    help="also write a Chrome trace-event / Perfetto JSON")
+    ap.add_argument("--flight", metavar="BUNDLE",
+                    help="summarize a flight-recorder bundle instead")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest-span table length (default 10)")
+    ap.add_argument("--out", help="write the report JSON here too")
+    args = ap.parse_args(argv)
+
+    if args.flight:
+        report = summarize_flight(args.flight)
+    else:
+        if not args.paths:
+            ap.error("give span JSONL paths/directories, or --flight BUNDLE")
+        spans = load_spans(args.paths)
+        report = summarize_spans(spans, top=args.top)
+        if args.perfetto:
+            with open(args.perfetto, "w") as f:
+                json.dump(to_chrome_trace(spans), f)
+            report["perfetto"] = args.perfetto
+
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
