@@ -77,12 +77,24 @@ class HeartbeatMonitor:
         period_s: float = HEARTBEAT_PERIOD_S,
         clock: Callable[[], float] = time.monotonic,
         on_recover: Optional[Callable[[str], None]] = None,
+        on_stale: Optional[Callable[[str], None]] = None,
+        on_stale_clear: Optional[Callable[[str], None]] = None,
     ):
         self._last: Dict[str, float] = {}
         self._dead: set = set()
-        self._listeners: list = []  # (on_dead, on_recover) pairs
-        if on_dead is not None or on_recover is not None:
-            self._listeners.append((on_dead, on_recover))
+        # workers past stale_after_s but not yet dead — the DEGRADED
+        # stage between alive and the dead cliff: entering it fires
+        # on_stale ONCE (the master counts/events the transition); a
+        # beat fires on_stale_clear (a listener tracking the degraded
+        # set must see the improvement too), death supersedes it
+        self._stale: set = set()
+        # listener tuples: (on_dead, on_recover, on_stale, on_stale_clear)
+        self._listeners: list = []
+        if any(cb is not None for cb in
+               (on_dead, on_recover, on_stale, on_stale_clear)):
+            self._listeners.append(
+                (on_dead, on_recover, on_stale, on_stale_clear)
+            )
         self.stale_after_s = stale_after_s
         self.dead_after_s = dead_after_s
         self.period_s = period_s
@@ -104,11 +116,15 @@ class HeartbeatMonitor:
         self,
         on_dead: Optional[Callable[[str], None]] = None,
         on_recover: Optional[Callable[[str], None]] = None,
+        on_stale: Optional[Callable[[str], None]] = None,
+        on_stale_clear: Optional[Callable[[str], None]] = None,
     ) -> None:
-        """Register death/recovery callbacks (the public wiring point for
-        consumers like AsyncParamServer.attach_heartbeat)."""
+        """Register death/recovery/staleness callbacks (the public wiring
+        point for consumers like AsyncParamServer.attach_heartbeat)."""
         with self._lock:
-            self._listeners.append((on_dead, on_recover))
+            self._listeners.append(
+                (on_dead, on_recover, on_stale, on_stale_clear)
+            )
 
     def _dispatch(self) -> None:
         while True:
@@ -118,14 +134,28 @@ class HeartbeatMonitor:
                         return
                     kind, worker = self._events.pop(0)
                     listeners = list(self._listeners)
-                for on_dead, on_recover in listeners:
-                    cb = on_dead if kind == "dead" else on_recover
+                idx = {"dead": 0, "recover": 1, "stale": 2,
+                       "stale_clear": 3}[kind]
+                for cbs in listeners:
+                    cb = cbs[idx]
                     if cb is not None:
                         cb(worker)
 
     def beat(self, worker: str) -> None:
         with self._lock:
             self._last[worker] = self._clock()
+            if worker in self._stale:
+                # returned before the dead line: clear the degraded
+                # stage, drop any queued-but-undispatched stale event,
+                # and tell listeners the degraded set SHRANK — a health
+                # verdict fed only on worsening transitions would stay
+                # degraded forever for a worker that never actually died
+                self._stale.discard(worker)
+                self._events = [
+                    e for e in self._events
+                    if not (e[0] == "stale" and e[1] == worker)
+                ]
+                self._events.append(("stale_clear", worker))
             if worker in self._dead:
                 # re-registration of a returning node is tolerated
                 # (master.h:80-82)
@@ -147,9 +177,16 @@ class HeartbeatMonitor:
             with self._lock:
                 self._last.pop(worker, None)
                 self._dead.discard(worker)
+                was_stale = worker in self._stale
+                self._stale.discard(worker)
                 # also purge queued transitions enqueued by a racing
                 # check() sweep but not yet dispatched
                 self._events = [e for e in self._events if e[1] != worker]
+                if was_stale:
+                    # a clean departure of a degraded worker still shrinks
+                    # the degraded set — listeners must see it
+                    self._events.append(("stale_clear", worker))
+            self._dispatch()
 
     def peek(self) -> Dict[str, str]:
         """READ-ONLY view of worker -> 'alive' | 'stale' | 'dead', computed
@@ -170,6 +207,11 @@ class HeartbeatMonitor:
         with self._lock:
             return set(self._dead)
 
+    def stale_workers(self) -> set:
+        """Copy of the degraded (stale-but-not-dead) set."""
+        with self._lock:
+            return set(self._stale)
+
     def check(self) -> Dict[str, str]:
         """One sweep; returns worker -> 'alive' | 'stale' | 'dead'."""
         now = self._clock()
@@ -179,11 +221,17 @@ class HeartbeatMonitor:
                 age = now - t
                 if age >= self.dead_after_s:
                     out[w] = "dead"
+                    self._stale.discard(w)  # death supersedes degraded
                     if w not in self._dead:
                         self._dead.add(w)
                         self._events.append(("dead", w))
                 elif age >= self.stale_after_s:
                     out[w] = "stale"
+                    if w not in self._stale and w not in self._dead:
+                        # the degraded stage before the dead cliff:
+                        # evented exactly once per silence episode
+                        self._stale.add(w)
+                        self._events.append(("stale", w))
                 else:
                     out[w] = "alive"
         self._dispatch()

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from typing import Optional
 
 from lightctr_tpu.dist.bootstrap import (
     DEAD_AFTER_S,
@@ -30,6 +31,7 @@ from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import emit_event
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import labeled
 
@@ -52,7 +54,14 @@ class MasterService:
     SHARDS heartbeat here too (ids ``SHARD_ID_BASE + shard_index``): a dead
     shard shows up as ``dead`` in the STATS liveness map (the ops plane
     reads it to trigger relaunch+restore), and a returning shard's first
-    beat auto-replays every routing decision it missed while down."""
+    beat auto-replays every routing decision it missed while down.
+
+    There is no binary alive/dead cliff: ``degraded_after_missed`` missed
+    heartbeat periods mark a node DEGRADED first (counted in
+    ``master_degraded_total{kind=...}``, evented, and reflected in the
+    master's own :class:`~lightctr_tpu.obs.health.HealthMonitor` — its
+    verdict rides this service's MSG_STATS replies and ``/healthz``)
+    before ``dead_after_s`` declares it dead."""
 
     def __init__(
         self,
@@ -63,7 +72,15 @@ class MasterService:
         dead_after_s: float = DEAD_AFTER_S,
         period_s: float = HEARTBEAT_PERIOD_S,
         shard_rpc_timeout_s: float = 5.0,
+        degraded_after_missed: Optional[int] = None,
     ):
+        # ``degraded_after_missed`` (k): a node is marked DEGRADED after
+        # k missed heartbeat periods — expressed to the monitor as the
+        # stale threshold, overriding stale_after_s when given
+        if degraded_after_missed is not None:
+            if degraded_after_missed < 1:
+                raise ValueError("degraded_after_missed must be >= 1")
+            stale_after_s = degraded_after_missed * period_s
         # per-op socket timeout: a wedged shard must raise (and be
         # retried), not stall heartbeat processing under the dispatch lock
         self._shard_addresses = [tuple(a) for a in shard_addresses]
@@ -86,6 +103,8 @@ class MasterService:
             period_s=period_s,
             on_dead=self._broadcast_unroute,
             on_recover=self._broadcast_readmit,
+            on_stale=self._on_stale,
+            on_stale_clear=self._on_stale_clear,
         )
         # dummy store: gives the service something to answer STATS with;
         # routing state that matters lives on the shards.  Clean departures
@@ -95,9 +114,16 @@ class MasterService:
         # the master's failover counters live in its store's registry, so
         # they ride the same MSG_STATS wire op as every shard's telemetry
         self.registry = self._store.registry
+        # cluster-liveness health verdict: stale peers degrade it, dead
+        # peers make it unhealthy; the service below serves it over
+        # MSG_STATS (and the ops exporter over /healthz)
+        self.health = obs_health.HealthMonitor(
+            component="master", registry=self.registry,
+        )
+        self.health.ensure_detector(obs_health.HeartbeatGapDetector())
         self._svc = ParamServerService(
             self._store, host=host, port=port, monitor=self.monitor,
-            on_farewell=self._broadcast_readmit_wid,
+            on_farewell=self._broadcast_readmit_wid, health=self.health,
         )
         self.address = self._svc.address
         self.monitor.start()
@@ -198,11 +224,50 @@ class MasterService:
                 self._replay(i)
             return sum(len(p) for p in self._pending)
 
+    def _observe_peers(self) -> None:
+        """Feed the liveness picture into the master's health monitor
+        (called on every stale/dead/recover transition)."""
+        if not obs_health.enabled():
+            return
+        self.health.observe(peers={
+            "stale": sorted(self.monitor.stale_workers()),
+            "dead": sorted(self.monitor.dead_workers()),
+        })
+
+    def _on_stale(self, worker: str) -> None:
+        """A node missed ``degraded_after_missed`` heartbeat periods:
+        DEGRADED — counted and evented, so the binary alive/dead cliff
+        has a visible intermediate stage.  No routing change: routes are
+        only deleted at the dead line."""
+        shard = self._to_shard(worker)
+        kind = "worker" if shard is None else "shard"
+        if obs_gate.enabled():
+            self.registry.inc(labeled("master_degraded_total", kind=kind))
+        if shard is not None:
+            emit_event("failover", action="shard_degraded", shard=shard)
+            logging.getLogger(__name__).warning(
+                "PS shard %d degraded (missed heartbeats)", shard
+            )
+        else:
+            wid = self._to_wid(worker)
+            emit_event("failover", action="worker_degraded",
+                       worker=wid if wid is not None else str(worker))
+        self._observe_peers()
+
+    def _on_stale_clear(self, worker: str) -> None:
+        """A degraded node resumed beating (or departed cleanly) without
+        ever crossing the dead line: re-feed the shrunken degraded set so
+        the health verdict recovers — without this, a stale-then-alive
+        worker would pin the master DEGRADED forever."""
+        del worker
+        self._observe_peers()
+
     def _broadcast_unroute(self, worker: str) -> None:
         wid = self._to_wid(worker)
         if wid is not None:
             emit_event("failover", action="unroute", worker=wid)
             self._broadcast("unroute", wid)
+            self._observe_peers()
             return
         shard = self._to_shard(worker)
         if shard is not None:
@@ -212,16 +277,19 @@ class MasterService:
             logging.getLogger(__name__).warning(
                 "PS shard %d declared dead (heartbeat silence)", shard
             )
+            self._observe_peers()
 
     def _broadcast_readmit(self, worker: str) -> None:
         wid = self._to_wid(worker)
         if wid is not None:
             emit_event("failover", action="readmit", worker=wid)
             self._broadcast("readmit", wid)
+            self._observe_peers()
             return
         shard = self._to_shard(worker)
         if shard is not None:
             self._resync_shard(shard)
+            self._observe_peers()
 
     def _resync_shard(self, shard: int) -> None:
         """A (re)joining shard may be a FRESH process whose store lost
@@ -265,3 +333,4 @@ class MasterService:
                 except OSError:
                     pass
         self._svc.close()
+        self.health.close()

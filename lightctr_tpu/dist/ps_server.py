@@ -44,6 +44,7 @@ from lightctr_tpu.dist import wire
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import default_registry, labeled
 
@@ -150,6 +151,7 @@ class ParamServerService:
         port: int = 0,
         monitor=None,
         on_farewell=None,
+        health=None,
     ):
         """``monitor``: optional HeartbeatMonitor; when given, MSG_BEAT
         frames drive it (workers heartbeat over their PS connection, the
@@ -157,7 +159,10 @@ class ParamServerService:
         and its death/recovery events should be wired to ``ps`` routing by
         the caller (``wire_heartbeat``).  ``on_farewell(wid)``: extra hook
         on clean departures — the master role uses it to clear the
-        departing worker's routes on every shard."""
+        departing worker's routes on every shard.  ``health``: an
+        existing :class:`~lightctr_tpu.obs.health.HealthMonitor` to serve
+        verdicts from (the master passes its own); None builds one for
+        this shard with an SSP-staleness detector wired to the store."""
         self.ps = ps
         self.monitor = monitor
         self.on_farewell = on_farewell
@@ -167,6 +172,19 @@ class ParamServerService:
         # the crash flight recorder snapshot it alongside the default
         self._flight_name = f"ps_shard_{self.address[1]}"
         obs_flight.register_registry(self._flight_name, ps.registry)
+        # per-shard health verdict: served in every MSG_STATS reply and
+        # aggregated cluster-wide by ShardedPSClient.cluster_health()
+        self._owns_health = health is None
+        if health is None:
+            health = obs_health.HealthMonitor(
+                component=self._flight_name, registry=ps.registry,
+            )
+            health.ensure_detector(obs_health.StalenessDetector(
+                slo=getattr(ps, "staleness_threshold", 10),
+            ))
+        self.health = health
+        # the store feeds its SSP ledger drift on every push
+        ps.health = health
         self._peers = []  # [(thread, conn)] of live connections
         self._stop = threading.Event()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -279,6 +297,9 @@ class ParamServerService:
                             # master/clients merge these cluster-wide
                             # (obs.merge_snapshots) — the exposition path
                             stats["telemetry"] = self.ps.registry.snapshot()
+                            # so does the shard's health verdict — the
+                            # cluster_health() aggregation input
+                            stats["health"] = self.health.verdict()
                             if self.monitor is not None:
                                 # liveness map rides the stats op, so the
                                 # launcher/ops plane can read the master's
@@ -342,6 +363,10 @@ class ParamServerService:
     def close(self):
         self._stop.set()
         obs_flight.unregister_registry(self._flight_name)
+        if self._owns_health:
+            self.health.close()
+        if self.ps.health is self.health:
+            self.ps.health = None
         # shutdown() BEFORE close(): the accept thread blocked in accept()
         # holds the kernel's open file description, so close() alone leaves
         # the port listening (and accepting!) until that syscall returns —
@@ -908,6 +933,32 @@ class ShardedPSClient:
                 self._mark_down(i)
                 out.append({"addr": addr, "down": True, "error": str(e)})
         return out
+
+    def cluster_health(self) -> Dict:
+        """Aggregate health verdict over every shard (from the ``health``
+        section each MSG_STATS reply now carries).  A DOWN shard degrades
+        the aggregate instead of crashing the call — and a cluster whose
+        every shard is down is UNHEALTHY outright.  Shards predating the
+        health plane (no ``health`` in stats) count as ok."""
+        shards = []
+        statuses = []
+        down = 0
+        for st in self.stats():
+            entry = {"addr": st.get("addr"), "down": bool(st.get("down"))}
+            if st.get("down"):
+                down += 1
+                entry["status"] = obs_health.DEGRADED
+                entry["error"] = st.get("error")
+            else:
+                v = st.get("health") or {}
+                entry["status"] = v.get("status", obs_health.OK)
+                entry["detectors"] = v.get("detectors", {})
+            statuses.append(entry["status"])
+            shards.append(entry)
+        status = obs_health.worst(statuses)
+        if down and down == self.n_shards:
+            status = obs_health.UNHEALTHY
+        return {"status": status, "down_shards": down, "shards": shards}
 
     def farewell(self, worker_id: int) -> None:
         self._best_effort(lambda c: c.farewell(worker_id))

@@ -121,6 +121,9 @@ class AsyncParamServer:
         # per-STORE registry (not the process default): N shards hosted in
         # one process must report distinct snapshots over the stats op
         self.registry = registry if registry is not None else MetricsRegistry()
+        # optional HealthMonitor (the socket service wires one in): the
+        # store feeds its SSP staleness drift into it on every push
+        self.health = None
         self.dim = dim
         self.updater = updater
         self.lr = learning_rate
@@ -512,6 +515,10 @@ class AsyncParamServer:
             reg.inc("ps_store_gated_pushes_total")
         # staleness drift the SSP ledger currently holds (slowest worker)
         reg.gauge_set("ps_store_staleness", self.staleness)
+        hm = self.health
+        if hm is not None:
+            # SSP SLO detector input — same number the gauge above holds
+            hm.observe(staleness=self.staleness)
         return ok
 
     def _push_batch(

@@ -27,6 +27,7 @@ import optax
 
 from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.obs import trace as trace_mod
 from lightctr_tpu.utils.profiling import annotate
 from lightctr_tpu.core.config import TrainConfig
@@ -40,6 +41,14 @@ from lightctr_tpu.ops.activations import sigmoid
 from lightctr_tpu.obs import ensure_console_logging
 
 _LOG = logging.getLogger(__name__)
+
+
+def _health_pack(loss, grad_norm):
+    """One f32[2] device vector ``[loss, grad_norm]`` — the health feed's
+    single-fetch payload (see ``CTRTrainer._feed_health``)."""
+    return jnp.stack([
+        jnp.asarray(loss, jnp.float32), jnp.asarray(grad_norm, jnp.float32)
+    ])
 
 
 class CompressedRingState(NamedTuple):
@@ -218,6 +227,17 @@ class CTRTrainer:
         # training to isolate a run (benches give each trainer a fresh
         # MetricsRegistry)
         self.telemetry = obs.default_registry()
+        # training-dynamics health: per-step loss + gradient global norm
+        # (the in-jit scalar every step variant returns) feed the process
+        # monitor; reassign ``self.health`` (or None) to isolate/disable
+        self.health = health_mod.default_monitor()
+        health_mod.ensure_trainer_detectors(self.health)
+        # (loss, grad_norm) device scalars of recent steps, oldest first:
+        # the health feed drains the ones ALREADY materialized
+        # (jax.Array.is_ready) — fetching the in-flight step's values
+        # would force a device sync per step and stall the dispatch
+        # pipeline (the <5% overhead guard measures exactly that)
+        self._health_pending: list = []
         self._steps_seen = 0
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
@@ -229,7 +249,14 @@ class CTRTrainer:
     def _build_step(self):
         """The training step: plain (XLA inserts psum for sharded batches),
         compressed-ring data-parallel when ``compress_bits`` is set, or the
-        sharded-weight-update form when ``zero_sharded`` is set."""
+        sharded-weight-update form when ``zero_sharded`` is set.
+
+        Every variant returns ``(params, opt_state, loss, health)`` where
+        ``health`` is one f32[2] device vector ``[loss, grad_norm]``: the
+        gradient GLOBAL norm is reduced to a scalar inside the jitted
+        step and packed next to the loss, so the health monitor's feed
+        costs a single device->host fetch (and nothing at all when
+        unread — XLA dead-code-eliminates it out of the scan paths)."""
         if self.compress_bits is not None:
             return self._make_compressed_step()
         if self.zero_sharded:
@@ -278,6 +305,7 @@ class CTRTrainer:
 
             def step(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                health = _health_pack(loss, optax.global_norm(grads))
                 leaves_w, treedef = jax.tree_util.tree_flatten(params)
                 leaves_a = treedef.flatten_up_to(opt_state.accum)
                 leaves_g = treedef.flatten_up_to(grads)
@@ -293,15 +321,16 @@ class CTRTrainer:
                         treedef, [a for _, a in pairs]
                     )
                 )
-                return params, opt_state, loss
+                return params, opt_state, loss, health
 
             return step
 
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            health = _health_pack(loss, optax.global_norm(grads))
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optim_lib.apply_updates(params, updates)
-            return params, opt_state, loss
+            return params, opt_state, loss, health
 
         return step
 
@@ -332,6 +361,11 @@ class CTRTrainer:
             g_shard = jax.lax.psum_scatter(
                 flat_g, "data", scatter_dimension=0, tiled=True
             ) / n
+            # ||mean grad|| from the disjoint scattered shards: one psum
+            # of per-shard square sums — the health scalar, replicated
+            gnorm = jnp.sqrt(jax.lax.psum(
+                jnp.sum(g_shard * g_shard), "data"
+            ))
             flat_p, _ = ravel_pytree(params)
             if Lpad != L:
                 flat_p = jnp.pad(flat_p, (0, Lpad - L))
@@ -344,13 +378,13 @@ class CTRTrainer:
             p_shard = optim_lib.apply_updates(p_shard, updates)
             full = jax.lax.all_gather(p_shard, "data", tiled=True)[:L]
             loss = jax.lax.pmean(loss, "data")
-            return unravel(full), opt_state, loss
+            return unravel(full), opt_state, loss, _health_pack(loss, gnorm)
 
         return shard_map(
             local_step,
             mesh=mesh,
             in_specs=(P(), P("data"), P("data")),
-            out_specs=(P(), P("data"), P()),
+            out_specs=(P(), P("data"), P(), P()),
             check_vma=False,
         )
 
@@ -396,12 +430,14 @@ class CTRTrainer:
                 )
                 new_res = state.residual[0]
             grads = unravel(flat[:length])
+            # decoded mean gradient is replica-identical: so is its norm
+            gnorm = optax.global_norm(grads)
             loss = jax.lax.pmean(loss, "data")
             updates, inner = tx.update(grads, state.inner, params)
             params = optim_lib.apply_updates(params, updates)
             state = CompressedRingState(inner=inner,
                                         residual=new_res[None])
-            return params, state, loss
+            return params, state, loss, _health_pack(loss, gnorm)
 
         from lightctr_tpu.core.compat import shard_map
 
@@ -410,7 +446,7 @@ class CTRTrainer:
             local_step,
             mesh=mesh,
             in_specs=(P(), state_spec, P("data")),
-            out_specs=(P(), state_spec, P()),
+            out_specs=(P(), state_spec, P(), P()),
             check_vma=False,
         )
 
@@ -469,7 +505,7 @@ class CTRTrainer:
 
     def train_step(self, batch: Dict[str, np.ndarray]) -> float:
         if not obs.enabled():
-            self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, loss, _ = self._step(
                 self.params, self.opt_state, self._put(batch)
             )
             return loss
@@ -479,10 +515,11 @@ class CTRTrainer:
             return self._train_step_traced(batch)
         t0 = time.perf_counter()
         dev_batch = self._put(batch)
-        self.params, self.opt_state, loss = self._step(
+        self.params, self.opt_state, loss, health = self._step(
             self.params, self.opt_state, dev_batch
         )
-        self._record_step(time.perf_counter() - t0, dev_batch)
+        self._record_step(time.perf_counter() - t0, dev_batch,
+                          health=health)
         return loss
 
     def _train_step_traced(self, batch: Dict[str, np.ndarray]) -> float:
@@ -497,18 +534,19 @@ class CTRTrainer:
             with annotate("trainer/input"):
                 dev_batch = self._put(batch)
             with annotate("trainer/exec"):
-                self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, loss, health = self._step(
                     self.params, self.opt_state, dev_batch
                 )
-        self._record_step(time.perf_counter() - t0, dev_batch)
+        self._record_step(time.perf_counter() - t0, dev_batch,
+                          health=health)
         return loss
 
     # -- telemetry ------------------------------------------------------
 
-    def _record_step(self, dt: float, batch) -> None:
-        """Per-step metrics + one JSONL ``step`` event.  On async backends
-        ``trainer_step_seconds`` measures dispatch (the caller's loss read
-        forces the sync); on CPU it is the full step."""
+    def _record_step(self, dt: float, batch, health=None) -> None:
+        """Per-step metrics + one JSONL ``step`` event + the health feed.
+        On async backends ``trainer_step_seconds`` measures dispatch (the
+        caller's loss read forces the sync); on CPU it is the full step."""
         reg = self.telemetry
         self._steps_seen += 1
         n = int(batch["labels"].shape[0]) if "labels" in batch else 0
@@ -520,6 +558,59 @@ class CTRTrainer:
             "step", step=self._steps_seen, duration_s=round(dt, 6),
             examples=n, **self._step_event_fields(),
         )
+        self._feed_health(batch, health)
+
+    #: blocking-fetch backpressure bound on the health scalar queue — a
+    #: device more than this many steps behind gets synced rather than
+    #: letting a NaN hide in an ever-growing backlog
+    _HEALTH_MAX_LAG = 8
+
+    def _feed_health(self, batch, health) -> None:
+        """Per-step ``[loss, grad_norm]`` vectors (and any subclass
+        signals) into the health monitor.  ``wants`` gates the work: a
+        monitor without loss/grad detectors costs nothing here.  The
+        vectors are queued as DEVICE values and drained oldest-first once
+        materialized (``jax.Array.is_ready``) with ONE host fetch each,
+        so the feed never syncs the in-flight step — a NaN step flips
+        the verdict by the next recorded step (or on
+        :meth:`flush_health`), at zero pipeline stalls."""
+        hm = self.health
+        if hm is None or not health_mod.enabled():
+            return
+        sig = self._health_signals(batch)
+        if sig:
+            hm.observe(**sig)
+        if health is None or not hm.wants("loss", "grad_norm"):
+            return
+        pend = self._health_pending
+        pend.append(health)
+        while pend:
+            head = pend[0]
+            if (hasattr(head, "is_ready") and not head.is_ready()
+                    and len(pend) <= self._HEALTH_MAX_LAG):
+                break
+            self._observe_scalars(hm, pend.pop(0))
+
+    @staticmethod
+    def _observe_scalars(hm, health) -> None:
+        vals = np.asarray(health, np.float32)  # the single host fetch
+        hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
+
+    def flush_health(self) -> None:
+        """Drain every queued health vector NOW, blocking on any still in
+        flight (end of a run, or a test that wants the verdict without
+        running another step)."""
+        hm = self.health
+        pend, self._health_pending = self._health_pending, []
+        if hm is None or not health_mod.enabled():
+            return
+        for entry in pend:
+            self._observe_scalars(hm, entry)
+
+    def _health_signals(self, batch) -> Dict:
+        """Extra health signals subclasses contribute per step (the sparse
+        trainer reports per-table touched-uid counts here)."""
+        return {}
 
     def _step_event_fields(self) -> Dict:
         """Extra fields subclasses contribute to each ``step`` event (the
@@ -548,7 +639,7 @@ class CTRTrainer:
         full_batch = self._put(arrays) if batch_size is None else None
         for epoch in range(epochs):
             if batch_size is None:
-                self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, loss, _ = self._step(
                     self.params, self.opt_state, full_batch
                 )
             else:
@@ -566,6 +657,7 @@ class CTRTrainer:
                 ensure_console_logging()
                 _LOG.info("epoch %d: loss=%.5f%s", epoch, float(loss),
                           f" {ev}" if ev is not None else "")
+        self.flush_health()  # the last step's pending scalars
         history["wall_time_s"] = time.perf_counter() - t0
         return history
 
@@ -600,7 +692,11 @@ class CTRTrainer:
             def body_fn(batch):
                 def body(carry, _):
                     params, opt_state = carry
-                    params, opt_state, loss = step(params, opt_state, batch)
+                    # the grad-norm health scalar is unused here, so XLA
+                    # DCEs it out of the scanned program — scan stays free
+                    params, opt_state, loss, _ = step(
+                        params, opt_state, batch
+                    )
                     return (params, opt_state), loss
 
                 return body

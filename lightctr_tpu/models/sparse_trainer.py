@@ -82,10 +82,12 @@ from typing import Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from lightctr_tpu import obs
 from lightctr_tpu.embed.table import SparseAdagradState, sparse_adagrad_update
-from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
+from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.utils.profiling import annotate
 
 
@@ -171,6 +173,10 @@ class SparseTableCTRTrainer(CTRTrainer):
             compress_range=compress_range, compress_mode=compress_mode,
             error_feedback=error_feedback,
         )
+        # table trainers also watch per-table touched-uid skew (the same
+        # id streams the sparse exchange dedups — hot/dead detection)
+        if self.health is not None:
+            health_mod.ensure_trainer_detectors(self.health, tables=True)
 
     # -- state -------------------------------------------------------------
 
@@ -259,6 +265,9 @@ class SparseTableCTRTrainer(CTRTrainer):
             loss, (g_rows, g_dense) = jax.value_and_grad(
                 loss_on, argnums=(0, 1)
             )(rows, dense)
+            # grad global norm over touched rows + dense leaves: the
+            # health scalar (one reduction; fetched only when monitored)
+            gnorm = optax.global_norm((g_rows, g_dense))
 
             updates, new_dense_state = tx.update(g_dense, opt_state["dense"], dense)
             dense = jax.tree_util.tree_map(
@@ -283,7 +292,8 @@ class SparseTableCTRTrainer(CTRTrainer):
                     new_accum[k] = st.accum
 
             params = {**dense, **tables}
-            return params, {"dense": new_dense_state, "accum": new_accum}, loss
+            return (params, {"dense": new_dense_state, "accum": new_accum},
+                    loss, _health_pack(loss, gnorm))
 
         return step
 
@@ -381,6 +391,10 @@ class SparseTableCTRTrainer(CTRTrainer):
                     lambda g: jax.lax.pmean(g, "data"), g_dense
                 )
 
+            # post-exchange gradients are replica-identical, so the norm
+            # accumulated below is too (health scalar, out_specs P())
+            gn2 = optax.global_norm(g_dense) ** 2
+
             updates, new_dense_state = tx.update(
                 g_dense, opt_state["dense"], dense
             )
@@ -408,6 +422,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                             compress_range=crange if bits is not None else 1.0,
                             compress_mode=cmode,
                         )
+                    gn2 = gn2 + jnp.sum(merged * merged)
                     # identical (gu, merged) on every replica -> identical
                     # update; duplicate ids across replicas were merged by
                     # the exchange, padded slots carry zero rows (no-op)
@@ -429,6 +444,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                             g_rows[k]
                         )
                         g = dense_table_exchange(g)
+                    gn2 = gn2 + jnp.sum(g * g)
                     # dense elementwise Adagrad without state decay — the
                     # same trajectory as the sparse recipe (untouched rows
                     # have g == 0: neither weights nor accum move)
@@ -443,7 +459,8 @@ class SparseTableCTRTrainer(CTRTrainer):
             new_state = {"dense": new_dense_state, "accum": new_accum}
             if bits is not None:
                 new_state["residual"] = new_res[None]
-            return params, new_state, loss
+            return params, new_state, loss, _health_pack(loss,
+                                                         jnp.sqrt(gn2))
 
         state_spec = {"dense": P(), "accum": {k: P() for k in spec}}
         if bits is not None:
@@ -452,7 +469,7 @@ class SparseTableCTRTrainer(CTRTrainer):
             local_step,
             mesh=mesh,
             in_specs=(P(), state_spec, P("data")),
-            out_specs=(P(), state_spec, P()),
+            out_specs=(P(), state_spec, P(), P()),
             check_vma=False,
         )
 
@@ -480,8 +497,28 @@ class SparseTableCTRTrainer(CTRTrainer):
             "dense_ring_bytes": dense_b,
         }
 
-    def _record_step(self, dt: float, batch) -> None:
-        super()._record_step(dt, batch)
+    def _health_signals(self, batch) -> Dict:
+        """Per-table touched-uid counts for the skew detector — the same
+        id streams ``_dedup_and_gather`` dedups in-jit, counted host-side
+        (cheap: a few thousand int32 ids).  Skipped entirely unless a
+        table_skew detector is installed."""
+        hm = self.health
+        if hm is None or not hm.wants("table_touch"):
+            return {}
+        touch = {}
+        for k, fields in self._spec.items():
+            ids = np.concatenate(
+                [np.asarray(batch[f]).reshape(-1) for f in fields]
+            )
+            touch[k] = {
+                "unique": int(np.unique(ids).size),
+                "ids": int(ids.size),
+                "vocab": int(self.params[k].shape[0]),
+            }
+        return {"table_touch": touch}
+
+    def _record_step(self, dt: float, batch, health=None) -> None:
+        super()._record_step(dt, batch, health=health)
         if not (self._hybrid_dp and self.exchange_policy):
             return
         reg = self.telemetry

@@ -25,7 +25,15 @@ Entry points
     ``LIGHTCTR_TRACE_DIR`` streams span JSONL per process.
 ``flight`` (submodule)
     crash flight recorder — ``LIGHTCTR_FLIGHT=<dir>`` dumps the span
-    ring, event ring, and registry snapshots on crash/SIGTERM/SIGUSR1.
+    ring, event ring, registry snapshots, and health verdicts on
+    crash/SIGTERM/SIGUSR1 (and at anomaly time via ``health``).
+``health`` (submodule)
+    training-dynamics health monitors — NaN/spike/grad-norm/skew/
+    staleness/heartbeat detectors behind an OK/DEGRADED/UNHEALTHY
+    state machine; ``LIGHTCTR_HEALTH=0`` disables.
+``exporter`` (submodule)
+    HTTP ops endpoints — ``LIGHTCTR_OPS_PORT=<port>`` serves
+    ``/metrics`` ``/varz`` ``/healthz`` ``/tracez`` ``/flightz``.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -50,10 +58,15 @@ from lightctr_tpu.obs.events import emit as emit_event  # noqa: F401
 from lightctr_tpu.obs.events import get_event_log  # noqa: F401
 from lightctr_tpu.obs import trace  # noqa: F401  (obs.trace.span / export)
 from lightctr_tpu.obs import flight  # noqa: F401  (crash flight recorder)
+from lightctr_tpu.obs import health  # noqa: F401  (health monitors)
+from lightctr_tpu.obs import exporter  # noqa: F401  (HTTP ops endpoints)
 
 # LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
 # inherits the variable — the multi-process PS run's postmortem switch
 flight.maybe_install_from_env()
+# LIGHTCTR_OPS_PORT=<port> serves /metrics /varz /healthz /tracez /flightz
+# in every process that inherits it (0 auto-assigns; telemetry-off wins)
+exporter.maybe_install_from_env()
 
 import logging as _logging
 

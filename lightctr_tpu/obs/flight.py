@@ -38,7 +38,7 @@ import signal
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from lightctr_tpu.obs import events as events_mod
 from lightctr_tpu.obs import trace as trace_mod
@@ -56,9 +56,15 @@ _state = {
     "dying": False,         # lethal signal seen; next delivery is final
 }
 _extra_registries: Dict[str, MetricsRegistry] = {}
+_health_providers: Dict[str, Callable[[], Dict]] = {}
 _reg_lock = threading.Lock()
+# ONE re-entrancy guard for every dump path — signal/excepthook dumps AND
+# health-anomaly dumps: a dump triggered while another is mid-write is
+# COALESCED (returns None, counted), never interleaved or queued behind it
+# (the in-progress bundle captures ~the same rings anyway)
 _dump_lock = threading.Lock()
 _dump_seq = [0]  # same-second dumps (SIGUSR1 pokes) must not collide
+_coalesced = [0]
 
 
 def register_registry(name: str, registry: MetricsRegistry) -> None:
@@ -74,47 +80,111 @@ def unregister_registry(name: str) -> None:
         _extra_registries.pop(str(name), None)
 
 
+def registered_registries() -> Dict[str, MetricsRegistry]:
+    """Copy of the extra-registry map (the ops exporter scrapes these
+    alongside the default registry)."""
+    with _reg_lock:
+        return dict(_extra_registries)
+
+
+def register_health_provider(name: str,
+                             provider: Callable[[], Dict]) -> None:
+    """Register a zero-arg callable returning a JSON-ready health verdict
+    (``HealthMonitor.verdict``); every bundle — and the ops exporter's
+    ``/healthz`` — includes one ``health`` record per provider."""
+    with _reg_lock:
+        _health_providers[str(name)] = provider
+
+
+def unregister_health_provider(name: str) -> None:
+    with _reg_lock:
+        _health_providers.pop(str(name), None)
+
+
+def health_verdicts() -> Dict[str, Dict]:
+    """Current verdict per registered provider; a failing provider is
+    skipped (a sick monitor must not take the health plane down)."""
+    with _reg_lock:
+        providers = dict(_health_providers)
+    out: Dict[str, Dict] = {}
+    for name, provider in providers.items():
+        try:
+            out[name] = provider()
+        except Exception:
+            continue
+    return out
+
+
+def armed() -> bool:
+    """True when a bundle destination is configured (``install`` ran or
+    ``LIGHTCTR_FLIGHT`` was set) — anomaly triggers check this so an
+    unarmed process never litters its cwd with bundles."""
+    return _state["dir"] is not None
+
+
+def coalesced_dumps() -> int:
+    """How many dump requests were dropped because one was in progress."""
+    return _coalesced[0]
+
+
 def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
-    """Write one flight bundle; returns its path (None on failure).  Safe
-    to call from signal handlers and excepthooks — never raises."""
+    """Write one flight bundle; returns its path (None on failure, or
+    when COALESCED with a dump already in progress).  Safe to call from
+    signal handlers, excepthooks, and health-anomaly triggers — never
+    raises."""
+    if not _dump_lock.acquire(blocking=False):
+        _coalesced[0] += 1
+        return None
     try:
         dest = dir or _state["dir"] or "."
         os.makedirs(dest, exist_ok=True)
         ts = time.time()
-        with _dump_lock:
-            _dump_seq[0] += 1
-            path = os.path.join(
-                dest,
-                f"flight-{int(ts)}-{os.getpid()}-{_dump_seq[0]}.jsonl",
-            )
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+        _dump_seq[0] += 1
+        path = os.path.join(
+            dest,
+            f"flight-{int(ts)}-{os.getpid()}-{_dump_seq[0]}.jsonl",
+        )
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({
+                "kind": "flight", "v": FLIGHT_SCHEMA_VERSION,
+                "reason": str(reason), "ts": round(ts, 6),
+                "pid": os.getpid(), "argv": list(sys.argv),
+            }, sort_keys=True) + "\n")
+            regs = [("default", default_registry())]
+            with _reg_lock:
+                regs.extend(_extra_registries.items())
+                providers = dict(_health_providers)
+            for name, reg in regs:
+                try:
+                    snap = reg.snapshot()
+                except Exception:
+                    continue
                 f.write(json.dumps({
-                    "kind": "flight", "v": FLIGHT_SCHEMA_VERSION,
-                    "reason": str(reason), "ts": round(ts, 6),
-                    "pid": os.getpid(), "argv": list(sys.argv),
+                    "kind": "metrics", "registry": name,
+                    "snapshot": snap,
                 }, sort_keys=True) + "\n")
-                regs = [("default", default_registry())]
-                with _reg_lock:
-                    regs.extend(_extra_registries.items())
-                for name, reg in regs:
-                    try:
-                        snap = reg.snapshot()
-                    except Exception:
-                        continue
-                    f.write(json.dumps({
-                        "kind": "metrics", "registry": name,
-                        "snapshot": snap,
-                    }, sort_keys=True) + "\n")
-                # per-record tolerance: ONE unserializable span/event must
-                # not cost the whole postmortem (registry snapshots and
-                # every other record) on the crash it exists to explain
-                for rec in trace_mod.finished():
-                    f.write(events_mod.EventLog._dump_record(rec) + "\n")
-                for rec in events_mod.get_event_log().records():
-                    f.write(events_mod.EventLog._dump_record(
-                        {"kind": "flight_event", "record": rec}) + "\n")
-            os.replace(tmp, path)  # atomic: readers never see a torn bundle
+            # health verdicts ride every bundle, so an anomaly-triggered
+            # dump says WHICH detector tripped without cross-referencing
+            # the event ring (tools/trace_report.py --flight prints them)
+            for name, provider in providers.items():
+                try:
+                    verdict = provider()
+                except Exception:
+                    continue
+                f.write(events_mod.EventLog._dump_record({
+                    "kind": "health", "component": name,
+                    "verdict": verdict,
+                }) + "\n")
+            # per-record tolerance: ONE unserializable span/event must
+            # not cost the whole postmortem (registry snapshots and
+            # every other record) on the crash it exists to explain
+            for rec in trace_mod.finished():
+                f.write(events_mod.EventLog._dump_record(rec) + "\n")
+            for rec in events_mod.get_event_log().records():
+                f.write(events_mod.EventLog._dump_record(
+                    {"kind": "flight_event", "record": rec}) + "\n")
+        os.replace(tmp, path)  # atomic: readers never see a torn bundle
         # flush the streaming sinks too — the bundle holds the rings, the
         # JSONL files hold everything already emitted
         try:
@@ -128,6 +198,8 @@ def dump(reason: str, dir: Optional[str] = None) -> Optional[str]:
         return path
     except Exception:
         return None
+    finally:
+        _dump_lock.release()
 
 
 def _on_signal(signum, frame):

@@ -424,9 +424,16 @@ def test_trainer_telemetry_overhead_under_5_percent():
     Covers the span-creation paths too: tracing is pinned to its default
     (rate 0), so the timed path includes every ``trace.enabled()`` guard
     the span instrumentation added — the acceptance bar for PR 3 is that
-    those guards, not the spans, are what a disabled run pays for."""
+    those guards, not the spans, are what a disabled run pays for.
+
+    PR 4 extends the bar to HEALTH MONITORING: the timed path carries a
+    monitor with the full standard trainer detector set (NaN loss, loss
+    spike, grad norm), so the per-step [loss, grad_norm] device fetch
+    and the detector checks are inside the <5% budget — and the feed is
+    asserted to have actually run (no passing by silently skipping)."""
     from lightctr_tpu import TrainConfig
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
+    from lightctr_tpu.obs import health as health_mod
     from lightctr_tpu.obs import trace as trace_mod
 
     rng = np.random.default_rng(0)
@@ -438,6 +445,10 @@ def test_trainer_telemetry_overhead_under_5_percent():
     params = {"w": np.zeros((d,), np.float32)}
     tr = CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
                     TrainConfig(learning_rate=0.1))
+    hm = health_mod.HealthMonitor(component="overhead_guard",
+                                  registry=obs.MetricsRegistry())
+    health_mod.ensure_trainer_detectors(hm)
+    tr.health = hm
     obs.configure_event_log()  # fresh in-memory ring (no disk writes)
     try:
         with trace_mod.override_rate(0.0):  # the documented default
@@ -452,10 +463,16 @@ def test_trainer_telemetry_overhead_under_5_percent():
 
             with obs.override(False):
                 t_off = min(run() for _ in range(4))
+            obs_before = hm.observations
             with obs.override(True):
                 t_on = min(run() for _ in range(4))
+            # the monitors were genuinely fed on the timed path (the
+            # drain lags a bounded number of steps, never all of them)
+            assert hm.observations - obs_before >= 4 * 60 - tr._HEALTH_MAX_LAG
+            assert hm.status() == "ok"
     finally:
         obs.configure_event_log()
+        hm.close()
     # small absolute slack keeps the guard robust to scheduler noise while
     # still catching any real regression (a disk flush or sync per step
     # would blow far past this)
@@ -542,6 +559,76 @@ def test_every_ps_wire_op_has_a_latency_series_name():
     # and the flag bit can never collide with an op type
     from lightctr_tpu.dist import wire
     assert all(v < wire.TRACE_FLAG for v in ops.values())
+
+
+def test_every_health_detector_is_registered_and_series_declared():
+    """No silent dark detectors: every ``*Detector`` class in obs/health.py
+    must declare literal ``name``/``signals`` class attributes and be
+    listed in ``KNOWN_DETECTORS``; and every gauge/counter series the
+    module writes (the first argument of each ``labeled(...)`` call) must
+    appear in ``HEALTH_SERIES`` — a detector whose metric is not declared
+    there would never make it into dashboards or docs."""
+    from lightctr_tpu.obs import health
+
+    src = (LIB_ROOT / "obs" / "health.py").read_text()
+    tree = ast.parse(src, filename="obs/health.py")
+
+    detectors = {}
+    labeled_series = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "labeled"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            labeled_series.add(node.args[0].value)
+        if not (isinstance(node, ast.ClassDef)
+                and node.name.endswith("Detector")
+                and node.name != "Detector"):
+            continue
+        attrs = {}
+        for stmt in node.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                attrs[stmt.targets[0].id] = stmt.value
+        assert isinstance(attrs.get("name"), ast.Constant) and \
+            isinstance(attrs["name"].value, str) and attrs["name"].value, \
+            f"{node.name} must declare a literal class-level name"
+        sig = attrs.get("signals")
+        assert isinstance(sig, ast.Tuple) and sig.elts, \
+            f"{node.name} must declare a non-empty literal signals tuple"
+        detectors[node.name] = attrs["name"].value
+
+    assert detectors, "no Detector subclasses found (lint is miswired)"
+    names = set(detectors.values())
+    assert len(names) == len(detectors), "duplicate detector names"
+    # every subclass is in the registry, and vice versa
+    assert names == set(health.KNOWN_DETECTORS), (
+        names, set(health.KNOWN_DETECTORS))
+    for cname, dname in detectors.items():
+        assert health.KNOWN_DETECTORS[dname] is getattr(health, cname)
+    # every series written is declared, and nothing declared is dead
+    assert labeled_series == set(health.HEALTH_SERIES), (
+        labeled_series, set(health.HEALTH_SERIES))
+
+    # and a tripped detector really lights its gauge + transition counter
+    reg = obs.MetricsRegistry()
+    hm = health.HealthMonitor(component="lint", registry=reg)
+    try:
+        hm.add_detector(health.NaNLossDetector())
+        hm.observe(loss=float("nan"))
+        snap = reg.snapshot()
+        assert snap["gauges"][obs.labeled(
+            "health_status", component="lint", detector="nan_loss")] == 2
+        assert snap["gauges"][obs.labeled(
+            "health_component_status", component="lint")] == 2
+        assert snap["counters"][obs.labeled(
+            "health_transitions_total", component="lint",
+            detector="nan_loss", to="unhealthy")] == 1
+    finally:
+        hm.close()
 
 
 # -- tools/metrics_report ----------------------------------------------------

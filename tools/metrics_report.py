@@ -1,5 +1,6 @@
-"""Summarize a telemetry run: JSONL event log -> one report JSON, or a
-registry snapshot -> Prometheus text.
+"""Summarize a telemetry run: JSONL event log -> one report JSON, a
+registry snapshot -> Prometheus text, or health events -> verdict
+timeline.
 
 The obs layer (lightctr_tpu/obs/) leaves two artifacts behind: the JSONL
 event log (``obs.configure_event_log(path=...)``) and registry snapshots
@@ -12,11 +13,16 @@ turns either into something readable:
   python -m tools.metrics_report --prom snapshot.json
       # -> Prometheus text exposition of a registry snapshot (the JSON a
       #    shard's stats()["telemetry"] returns, or a merge of several)
+  python -m tools.metrics_report --health RUN_DIR_or_FILE
+      # -> health-plane report: transition timeline across every *.jsonl
+      #    in a directory (one per process), final verdict per
+      #    component/detector, anomaly-triggered flight bundles
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -97,6 +103,56 @@ def summarize(records) -> dict:
             {k: v for k, v in f.items() if k not in ("v",)}
             for f in failovers
         ]
+    health = by_kind.get("health", [])
+    if health:
+        report["health"] = summarize_health(health)
+    return report
+
+
+def _expand_jsonl(path: str):
+    """A directory expands to every ``*.jsonl`` inside it (the per-process
+    event logs one run leaves behind); a file is itself."""
+    if os.path.isdir(path):
+        return sorted(glob.glob(os.path.join(path, "*.jsonl")))
+    return [path]
+
+
+def summarize_health(records) -> dict:
+    """``health`` events -> transition timeline + final verdict per
+    component/detector (the aggregate rows use the pseudo-detector name
+    ``aggregate``) + any anomaly-triggered flight bundles."""
+    health = sorted(
+        (r for r in records if r.get("kind") == "health"),
+        key=lambda r: r.get("ts", 0.0),
+    )
+    timeline = []
+    final: dict = {}
+    dumps = []
+    for r in health:
+        comp = r.get("component", "?")
+        det = r.get("detector", "?")
+        entry = {
+            "ts": r.get("ts"), "component": comp, "detector": det,
+            "from": r.get("prev"), "to": r.get("status"),
+        }
+        if r.get("detail"):
+            entry["detail"] = r["detail"]
+        timeline.append(entry)
+        comp_final = final.setdefault(comp, {})
+        if det == "aggregate":
+            comp_final["status"] = r.get("status")
+        else:
+            comp_final.setdefault("detectors", {})[det] = r.get("status")
+        if r.get("flight_bundle"):
+            dumps.append({"ts": r.get("ts"), "component": comp,
+                          "bundle": r["flight_bundle"]})
+    report = {
+        "transitions": len(timeline),
+        "timeline": timeline,
+        "final": final,
+    }
+    if dumps:
+        report["flight_dumps"] = dumps
     return report
 
 
@@ -107,6 +163,10 @@ def main(argv=None):
     ap.add_argument("--prom", metavar="SNAPSHOT_JSON",
                     help="render a registry-snapshot JSON as Prometheus "
                          "text instead of summarizing an event log")
+    ap.add_argument("--health", metavar="PATH",
+                    help="summarize health events (verdict timeline + "
+                         "final states) from a JSONL file or a directory "
+                         "of per-process JSONL logs")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -114,8 +174,19 @@ def main(argv=None):
             snap = json.load(f)
         sys.stdout.write(render_prometheus(snap, prefix="lightctr_"))
         return 0
+    if args.health:
+        records = []
+        for p in _expand_jsonl(args.health):
+            records.extend(read_jsonl(p))
+        report = summarize_health(records)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
-        ap.error("give an event-log path or --prom SNAPSHOT_JSON")
+        ap.error("give an event-log path, --prom SNAPSHOT_JSON, or "
+                 "--health PATH")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

@@ -142,6 +142,7 @@ def summarize_flight(path: str) -> Dict:
     events = [r["record"] for r in recs
               if r.get("kind") == "flight_event" and "record" in r]
     metrics = [r for r in recs if r.get("kind") == "metrics"]
+    health = [r for r in recs if r.get("kind") == "health"]
     event_kinds: Dict[str, int] = {}
     for e in events:
         k = e.get("kind", "?")
@@ -169,6 +170,21 @@ def summarize_flight(path: str) -> Dict:
             "last": events[-3:],
         },
     }
+    if health:
+        # the health plane's verdicts at dump time: which detector put the
+        # bundle on disk (anomaly-triggered dumps carry a health: reason)
+        report["health"] = {
+            h.get("component", "?"): {
+                "status": h.get("verdict", {}).get("status"),
+                "detectors": {
+                    name: {k: d.get(k) for k in ("status", "detail")
+                           if k in d}
+                    for name, d in h.get("verdict", {})
+                    .get("detectors", {}).items()
+                },
+            }
+            for h in health
+        }
     # surface the headline counters — the numbers a postmortem reads first
     for m in metrics:
         c = m.get("snapshot", {}).get("counters", {})
