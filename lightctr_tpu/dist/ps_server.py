@@ -128,14 +128,24 @@ def _recv_msg(
 
 
 def _keys_and_rows(payload: bytes, dim: int, dtype) -> Tuple[np.ndarray, np.ndarray]:
-    """Split a payload framed as pack_keys(keys) ++ rows into both parts."""
-    keys, consumed = wire.split_keys(payload)
+    """Split a payload framed as pack_keys(keys) ++ rows into both parts.
+    The fp16 hot path IS the unified sparse-rows frame (wire.unpack_rows);
+    fp32 stays the admin-op exact encoding."""
     if dtype is np.float16:
-        rows = wire.unpack_values(payload[consumed:], (len(keys), dim))
-    else:
-        rows = np.frombuffer(payload[consumed:], dtype)
-        rows = rows.reshape(len(keys), dim).astype(np.float32)
-    return keys, rows
+        keys, rows, consumed = wire.unpack_rows(payload, dim)
+        if consumed != len(payload):
+            # unpack_rows is frame-composable (tolerates trailing bytes);
+            # the PS protocol is not — a peer whose configured dim differs
+            # must fail loud (protocol-error reply), not silently decode
+            # the first dim columns of every row as a valid gradient
+            raise ValueError(
+                f"sparse-rows frame length mismatch: consumed {consumed} "
+                f"of {len(payload)} bytes (peer dim skew?)"
+            )
+        return keys, rows
+    keys, consumed = wire.split_keys(payload)
+    rows = np.frombuffer(payload[consumed:], dtype)
+    return keys, rows.reshape(len(keys), dim).astype(np.float32)
 
 
 class ParamServerService:
@@ -251,8 +261,10 @@ class ParamServerService:
                             if rows is None:
                                 send(struct.pack("<IB", 1, 0) + b"\x01")
                             else:
-                                body = (wire.pack_keys(keys)
-                                        + wire.pack_values(rows)[0])
+                                # the unified sparse-rows frame (varint ids
+                                # + fp16 rows) — same bytes the on-mesh
+                                # exchange's host boundary ships
+                                body = wire.pack_rows(keys, rows)
                                 send(
                                     struct.pack("<IB", 1 + len(body), 0)
                                     + b"\x00" + body
@@ -505,7 +517,7 @@ class PSClient:
             # wrong rows with ok=True
             raise ValueError("push_arrays keys must be sorted unique")
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
-        payload = hdr + wire.pack_keys(keys_arr) + wire.pack_values(r)[0]
+        payload = hdr + wire.pack_rows(keys_arr, r)
         with obs_trace.span("ps_client/push", n_keys=int(keys_arr.size)):
             ok = self._rpc(MSG_PUSH, payload) == b"\x00"
         if not ok:
@@ -817,11 +829,7 @@ class ShardedPSClient:
                     state["ok"] = False
                     continue
                 try:
-                    c._send(
-                        MSG_PUSH,
-                        hdr + wire.pack_keys(part)
-                        + wire.pack_values(r[idx])[0],
-                    )
+                    c._send(MSG_PUSH, hdr + wire.pack_rows(part, r[idx]))
                     live.append((i, c))
                 except (ConnectionError, OSError):
                     self._mark_down(i)

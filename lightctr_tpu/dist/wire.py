@@ -154,3 +154,30 @@ def unpack_values(buf: bytes, shape: tuple) -> np.ndarray:
         n = int(np.prod(shape)) if shape else 1
         return bindings.f16_decode_native(buf, n).reshape(shape)
     return np.frombuffer(buf, np.float16).astype(np.float32).reshape(shape)
+
+
+def pack_rows(uids: np.ndarray, rows: np.ndarray) -> bytes:
+    """ONE self-describing frame for a sparse (uids, rows) payload — the
+    socket-wire form of the on-mesh ``(uids, g_rows)`` exchange
+    (dist/collectives.py sparse_all_reduce): ``n`` varint, the delta-coded
+    sorted id stream, then the fp16 rows in that id order.
+
+    Byte-compatible BY CONSTRUCTION with the framing the PS protocol has
+    always used (``pack_keys(uids) ++ pack_values(rows)``) — unifying the
+    codec changes zero wire bytes, old and new peers interoperate
+    unconditionally (tested in test_wire_codec.py).  ``uids`` must be
+    sorted (the id stream is delta-coded; rows keep the caller's order, so
+    an unsorted input would silently misalign — callers validate, as
+    PSClient.push_arrays does)."""
+    return pack_keys(uids) + pack_values(np.asarray(rows, np.float32))[0]
+
+
+def unpack_rows(buf: bytes, dim: int) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Inverse of :func:`pack_rows` -> (sorted int64 uids, [n, dim] fp32
+    rows, bytes consumed).  ``dim`` is connection-level config in the PS
+    protocol (the server's row width), not part of the frame."""
+    keys, consumed = split_keys(buf)
+    n_vals = len(keys) * int(dim)
+    rows = unpack_values(buf[consumed:consumed + 2 * n_vals],
+                         (len(keys), int(dim)))
+    return keys, rows, consumed + 2 * n_vals

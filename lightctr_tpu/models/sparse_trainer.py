@@ -43,19 +43,31 @@ variable type (arXiv:1808.02621) fused with SparCML's sparse allreduce
 (arXiv:1802.08021), per step, one shard_map program:
 
   - each replica dedups its LOCAL batch shard's ids and differentiates
-    w.r.t. its gathered rows (O(touched) as above);
-  - table-leaf gradients ride ``sparse_all_reduce``: one all_gather of
-    (uids, g_rows) pairs — O(touched) ids+values on the interconnect
-    instead of the dense ring's O(vocab) — merged across replicas with a
-    segment_sum; every replica then applies the IDENTICAL
-    ``sparse_adagrad_update`` on the merged union, so replicas cannot
-    diverge;
-  - per table, a static trace-time density switch
-    (``prefer_sparse_exchange``) falls back to the dense (optionally
-    quantized) ring when the padded sparse payload would cost more than
-    the [vocab, dim] buffer — SparCML's dense switch-over, so the worst
-    case never regresses.  The taken decision is recorded in
-    ``self.exchange_policy`` ({table: "sparse" | "dense"});
+    w.r.t. its gathered rows (O(touched) as above); tables listing the
+    IDENTICAL field tuple share one id stream — unique runs once per
+    stream and the exchange ships the ids once per (stream, algorithm)
+    group;
+  - table-leaf gradients ride the cheaper of TWO sparse collectives:
+    ``sparse_all_reduce`` (one all_gather of (uids, g_rows) pairs —
+    O(touched) ids+values instead of the dense ring's O(vocab)) or the
+    owner-partitioned ``sparse_reduce_scatter`` (contributions routed to
+    the id's ``uid % n`` owner over a ppermute ring, merged there, only
+    merged owner shards all-gathered — O(touched) TOTAL, roughly flat in
+    world size where the allgather grows linearly); either way every
+    replica applies the IDENTICAL ``sparse_adagrad_update`` on the merged
+    union, so replicas cannot diverge;
+  - per table, a static trace-time three-way pick
+    (``pick_exchange_algo``: dense ring | sparse allgather | sparse
+    reduce-scatter, from density, vocab, dim and world size) falls back
+    to the dense (optionally quantized) ring when neither sparse payload
+    beats the [vocab, dim] buffer — SparCML's dense switch-over, so the
+    worst case never regresses.  The taken decision is recorded in
+    ``self.exchange_policy`` ({table: "sparse" | "sparse_rs" | "dense"});
+  - reduce-scatter capacities are expected sizes with slack, so every
+    batch is checked host-side (``rs_fits``) before dispatch; a batch
+    that would overflow runs an allgather fallback program instead
+    (counted in ``trainer_rs_fallback_total``) — exactness never rides
+    on the capacity guess;
   - dense leaves keep the existing exchange: the quantile-compressed
     explicit ring when ``compress_bits`` is set (EF-SGD residual and all,
     exactly CTRTrainer's compressed path), a plain psum mean otherwise.
@@ -157,16 +169,33 @@ class SparseTableCTRTrainer(CTRTrainer):
         # param_shardings (embed-axis row sharding) GSPMD owns the
         # collectives and the single-program step below is kept.
         self._hybrid_dp = mesh is not None and param_shardings is None
-        # {table: "sparse" | "dense"} — the density-switch decision each
-        # table leaf got at trace time (diagnostics / tests)
+        # {table: "sparse" | "sparse_rs" | "dense"} — the three-way
+        # trace-time pick each table leaf got (diagnostics / tests):
+        # allgather sparse exchange, owner-partitioned reduce-scatter, or
+        # the dense ring past the density switch
         self.exchange_policy: Dict[str, str] = {}
         # {table: bytes each member transmits per step under the decision
         # above} — written at trace time with the SAME accounting helpers
         # the benches use (dist.collectives.sparse_exchange_bytes /
-        # dense_ring_bytes), so live counters and BENCH JSONs cannot
-        # disagree
+        # sparse_rs_bytes / dense_ring_bytes), so live counters and BENCH
+        # JSONs cannot disagree
         self.exchange_bytes_per_step: Dict[str, int] = {}
         self._exchange_logged = False
+        # reduce-scatter capacity safety net: rs capacities are EXPECTED
+        # sizes with slack (dist.collectives.rs_default_caps), so every
+        # batch is checked HOST-side (rs_fits) before dispatch and one
+        # that would overflow runs the allgather fallback program instead
+        # — exactness never rides on the capacity guess.  The (rare)
+        # fallback trace records into its own dicts so it cannot shadow
+        # the primary program's decisions.
+        self._force_ag = False
+        self._step_ag = None
+        self._fallback_policy: Dict[str, str] = {}
+        self._fallback_bytes: Dict[str, int] = {}
+        self._last_step_fallback = False
+        self._fallback_logged = False
+        self._plan_cache: Dict = {}
+        self._scan_cache_ag: Dict = {}
         super().__init__(
             params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
             param_shardings=param_shardings, compress_bits=compress_bits,
@@ -221,24 +250,43 @@ class SparseTableCTRTrainer(CTRTrainer):
         return self._make_step()
 
     @staticmethod
+    def _field_groups(spec) -> Dict[tuple, list]:
+        """{field_tuple: [table, ...]} in spec order — tables whose field
+        lists concatenate to the SAME id stream share one dedup (and, in
+        the hybrid exchange, one wire id stream)."""
+        groups: Dict[tuple, list] = {}
+        for k, fields in spec.items():
+            groups.setdefault(tuple(fields), []).append(k)
+        return groups
+
+    @staticmethod
     def _dedup_and_gather(spec, params, batch):
         """Steps 1-3 of the module recipe: per-table batch-id dedup,
         position rewrite, and the O(touched) row gather.  Shared by the
         single-program step and the per-replica hybrid step (where
-        ``batch`` is the replica's local shard)."""
+        ``batch`` is the replica's local shard).
+
+        Tables listing the IDENTICAL field tuple run ``unique`` once and
+        share the resulting ``(uids, inv)`` — their position rewrites
+        coincide by construction (the __init__ overlap check guarantees
+        no other sharing shape exists), so dedup FLOPs are paid per
+        distinct id stream, not per table."""
         tables = {k: params[k] for k in spec}
         dense = {k: v for k, v in params.items() if k not in spec}
         batch2 = dict(batch)
         uids = {}
-        with annotate("sparse_tables/dedup_gather", tables=len(spec)):
-            for k, fields in spec.items():
+        groups = SparseTableCTRTrainer._field_groups(spec)
+        with annotate("sparse_tables/dedup_gather", tables=len(spec),
+                      id_streams=len(groups)):
+            for fields, keys in groups.items():
                 ids = jnp.concatenate(
                     [batch[f].reshape(-1) for f in fields]
                 ).astype(jnp.int32)
                 u, inv = jnp.unique(
                     ids, return_inverse=True, size=ids.shape[0], fill_value=0
                 )
-                uids[k] = u
+                for k in keys:
+                    uids[k] = u
                 ofs = 0
                 for f in fields:
                     m = batch[f].size
@@ -300,19 +348,31 @@ class SparseTableCTRTrainer(CTRTrainer):
     def _make_hybrid_dp_step(self):
         """Replicated data-parallel step with the hybrid explicit exchange
         (module docstring): per-replica O(touched) grads, table leaves over
-        ``sparse_all_reduce`` (or the dense ring past the density switch),
-        dense leaves over the compressed ring / psum mean.  One shard_map
-        program — jit it whole, exactly like CTRTrainer's compressed step."""
+        the three-way-picked sparse exchange (allgather ``sparse_all_reduce``,
+        the owner-partitioned reduce-scatter variant, or the dense ring past
+        the density switch), dense leaves over the compressed ring / psum
+        mean.  One shard_map program — jit it whole, exactly like
+        CTRTrainer's compressed step.  Tables sharing a field tuple share
+        the exchanged ID stream: the id plumbing (gather / owner partition /
+        shard merge) runs once per (stream, algo) group and only the first
+        table of a group pays the wire id bytes."""
         from jax.flatten_util import ravel_pytree
         from jax.sharding import PartitionSpec as P
 
         from lightctr_tpu.core.compat import shard_map
         from lightctr_tpu.dist.collectives import (
+            _ag_gather_ids,
+            _ag_merge_rows,
             _ring_all_reduce_local,
-            _sparse_all_reduce_local,
+            _rs_merge_ids,
+            _rs_ring_exchange,
+            _rs_gather_rows,
             dense_ring_bytes,
-            prefer_sparse_exchange,
+            pick_exchange_algo,
+            rs_default_caps,
+            rs_owner_partition,
             sparse_exchange_bytes,
+            sparse_rs_bytes,
         )
 
         loss_fn = self._make_loss_fn()
@@ -320,6 +380,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         spec = self._spec
         lr, eps = self.cfg.learning_rate, self._eps
         dedup_and_gather = self._dedup_and_gather
+        groups = self._field_groups(spec)
         mesh = self.mesh
         n = mesh.shape["data"]
         bits = self.compress_bits
@@ -327,8 +388,16 @@ class SparseTableCTRTrainer(CTRTrainer):
         use_ef = self.error_feedback
         ring_pad = self._ring_pad if bits is not None else 0
         margin = self._dense_margin
-        policy = self.exchange_policy  # written at trace time
-        xbytes = self.exchange_bytes_per_step  # ditto (live telemetry)
+        force_ag = self._force_ag
+        # written at trace time; the overflow-fallback program (force_ag)
+        # records into its own dicts so a traced fallback cannot shadow the
+        # primary program's decisions
+        if force_ag:
+            policy = self._fallback_policy
+            xbytes = self._fallback_bytes
+        else:
+            policy = self.exchange_policy
+            xbytes = self.exchange_bytes_per_step
 
         def dense_table_exchange(g):
             """SparCML's switch-over target: the table gradient as one
@@ -402,65 +471,143 @@ class SparseTableCTRTrainer(CTRTrainer):
                 lambda p, u: p + u.astype(p.dtype), dense, updates
             )
 
-            # -- table leaves: sparse exchange, dense ring past the switch --
+            # -- table leaves: three-way pick per table, id streams shared
+            # within each (field-tuple, algo) group ------------------------
             new_accum = {}
-            for k in spec:
-                vocab = tables[k].shape[0]
-                dim = int(np.prod(tables[k].shape[1:]))
-                if prefer_sparse_exchange(
-                    n, uids[k].shape[0], vocab, dim,
-                    sparse_bits=bits, dense_bits=bits, margin=margin,
-                ):
-                    policy[k] = "sparse"
-                    xbytes[k] = sparse_exchange_bytes(
-                        n, uids[k].shape[0], dim, bits
+            # in-jit rs overflow tally: the host-side rs_fits check should
+            # make this identically zero, but if the two ever disagree the
+            # count rides the health vector (third slot) instead of being
+            # silent gradient loss — _observe_scalars surfaces it
+            over_total = jnp.zeros((), jnp.int32)
+
+            def apply_sparse(k, gu, merged):
+                # identical (gu, merged) on every replica -> identical
+                # update; duplicate ids across replicas were merged by
+                # the exchange, padded slots carry zero rows (no-op)
+                with annotate("sparse_tables/apply"):
+                    tables[k], st = sparse_adagrad_update(
+                        tables[k],
+                        SparseAdagradState(accum=opt_state["accum"][k]),
+                        gu,
+                        merged,
+                        lr,
+                        eps=eps,
                     )
-                    with annotate("sparse_tables/sparse_exchange", table=k):
-                        gu, merged = _sparse_all_reduce_local(
-                            uids[k], g_rows[k], "data", n, average=True,
-                            compress_bits=bits,
-                            compress_range=crange if bits is not None else 1.0,
-                            compress_mode=cmode,
-                        )
-                    gn2 = gn2 + jnp.sum(merged * merged)
-                    # identical (gu, merged) on every replica -> identical
-                    # update; duplicate ids across replicas were merged by
-                    # the exchange, padded slots carry zero rows (no-op)
-                    with annotate("sparse_tables/apply"):
-                        tables[k], st = sparse_adagrad_update(
-                            tables[k],
-                            SparseAdagradState(accum=opt_state["accum"][k]),
-                            gu,
-                            merged,
-                            lr,
-                            eps=eps,
-                        )
-                    new_accum[k] = st.accum
-                else:
-                    policy[k] = "dense"
-                    xbytes[k] = dense_ring_bytes(vocab, dim, n, bits)
-                    with annotate("sparse_tables/dense_exchange", table=k):
-                        g = jnp.zeros_like(tables[k]).at[uids[k]].add(
-                            g_rows[k]
-                        )
-                        g = dense_table_exchange(g)
-                    gn2 = gn2 + jnp.sum(g * g)
-                    # dense elementwise Adagrad without state decay — the
-                    # same trajectory as the sparse recipe (untouched rows
-                    # have g == 0: neither weights nor accum move)
-                    with annotate("sparse_tables/apply"):
-                        acc = opt_state["accum"][k] + g * g
-                        tables[k] = tables[k] - lr * g * jax.lax.rsqrt(
-                            acc + eps
-                        )
-                    new_accum[k] = acc
+                new_accum[k] = st.accum
+
+            for fields, keys in groups.items():
+                u = uids[keys[0]]
+                kpad = u.shape[0]
+                # static trace-time pick per table, then share the id
+                # plumbing within each (algo, caps) subgroup
+                sub: Dict = {}
+                for k in keys:
+                    vocab = tables[k].shape[0]
+                    dim = int(np.prod(tables[k].shape[1:]))
+                    algo, _ = pick_exchange_algo(
+                        n, kpad, vocab, dim,
+                        sparse_bits=bits, dense_bits=bits, margin=margin,
+                    )
+                    if force_ag and algo == "sparse_rs":
+                        # the overflow-fallback program: this batch's ids
+                        # exceed the rs capacities, allgather stays exact
+                        algo = "sparse"
+                    caps = (rs_default_caps(n, kpad, vocab)
+                            if algo == "sparse_rs" else None)
+                    sub.setdefault((algo, caps), []).append(k)
+                for (algo, caps), ks in sub.items():
+                    if algo == "dense":
+                        for k in ks:
+                            vocab = tables[k].shape[0]
+                            dim = int(np.prod(tables[k].shape[1:]))
+                            policy[k] = "dense"
+                            xbytes[k] = dense_ring_bytes(vocab, dim, n, bits)
+                            with annotate("sparse_tables/dense_exchange",
+                                          table=k):
+                                g = jnp.zeros_like(tables[k]).at[uids[k]].add(
+                                    g_rows[k]
+                                )
+                                g = dense_table_exchange(g)
+                            gn2 = gn2 + jnp.sum(g * g)
+                            # dense elementwise Adagrad without state decay
+                            # — the same trajectory as the sparse recipe
+                            # (untouched rows have g == 0: neither weights
+                            # nor accum move)
+                            with annotate("sparse_tables/apply"):
+                                acc = opt_state["accum"][k] + g * g
+                                tables[k] = tables[k] - lr * g * \
+                                    jax.lax.rsqrt(acc + eps)
+                            new_accum[k] = acc
+                    elif algo == "sparse":
+                        with annotate("sparse_tables/sparse_exchange",
+                                      tables=len(ks)):
+                            _, uniq, inv = _ag_gather_ids(u, "data")
+                        for i, k in enumerate(ks):
+                            dim = int(np.prod(tables[k].shape[1:]))
+                            policy[k] = "sparse"
+                            xbytes[k] = sparse_exchange_bytes(
+                                n, kpad, dim, bits, include_ids=(i == 0)
+                            )
+                            with annotate("sparse_tables/sparse_exchange",
+                                          table=k):
+                                merged = _ag_merge_rows(
+                                    g_rows[k], inv, "data", n,
+                                    num_segments=uniq.shape[0], average=True,
+                                    compress_bits=bits,
+                                    compress_range=(crange if bits is not None
+                                                    else 1.0),
+                                    compress_mode=cmode,
+                                )
+                            gn2 = gn2 + jnp.sum(merged * merged)
+                            apply_sparse(k, uniq, merged)
+                    else:  # sparse_rs
+                        bucket_cap, shard_cap = caps
+                        with annotate("sparse_tables/rs_exchange",
+                                      tables=len(ks), bucket_cap=bucket_cap,
+                                      shard_cap=shard_cap):
+                            dest, order, bucket_ids, ov_b = \
+                                rs_owner_partition(u, n, bucket_cap)
+                            all_ids = _rs_ring_exchange(bucket_ids, "data", n)
+                            uniq, inv, ov_s = _rs_merge_ids(
+                                all_ids, shard_cap
+                            )
+                            over_total = over_total + ov_b + ov_s
+                            out_ids = jax.lax.all_gather(
+                                uniq, "data", tiled=True
+                            )
+                        for i, k in enumerate(ks):
+                            dim = int(np.prod(tables[k].shape[1:]))
+                            policy[k] = "sparse_rs"
+                            xbytes[k] = sparse_rs_bytes(
+                                n, bucket_cap, shard_cap, dim, bits,
+                                include_ids=(i == 0),
+                            )
+                            with annotate("sparse_tables/rs_exchange",
+                                          table=k):
+                                out_rows = _rs_gather_rows(
+                                    g_rows[k], dest, order, inv, "data", n,
+                                    bucket_cap, shard_cap, average=True,
+                                    compress_bits=bits,
+                                    compress_range=(crange if bits is not None
+                                                    else 1.0),
+                                    compress_mode=cmode,
+                                )
+                            gn2 = gn2 + jnp.sum(out_rows * out_rows)
+                            apply_sparse(k, out_ids, out_rows)
 
             params = {**dense, **tables}
             new_state = {"dense": new_dense_state, "accum": new_accum}
             if bits is not None:
                 new_state["residual"] = new_res[None]
-            return params, new_state, loss, _health_pack(loss,
-                                                         jnp.sqrt(gn2))
+            # health vector gains a third slot: the cross-member rs
+            # overflow count (psum -> replica-identical, like the rest).
+            # Scan paths DCE it with the vector; the train_step feed
+            # surfaces any nonzero count (trainer_rs_overflow_total).
+            health = jnp.concatenate([
+                _health_pack(loss, jnp.sqrt(gn2)),
+                jax.lax.psum(over_total, "data").astype(jnp.float32)[None],
+            ])
+            return params, new_state, loss, health
 
         state_spec = {"dense": P(), "accum": {k: P() for k in spec}}
         if bits is not None:
@@ -473,27 +620,192 @@ class SparseTableCTRTrainer(CTRTrainer):
             check_vma=False,
         )
 
+    # -- reduce-scatter capacity plan / overflow fallback ---------------
+
+    def _exchange_plan(self, batch) -> Dict[str, tuple]:
+        """Host-side mirror of the trace-time pick: {table: (fields, algo,
+        caps)} from static shapes — the SAME ``pick_exchange_algo`` /
+        ``rs_default_caps`` calls the traced program makes, so host plan
+        and compiled program cannot disagree.  Cached per batch field-shape
+        signature."""
+        from lightctr_tpu.dist.collectives import (
+            pick_exchange_algo, rs_default_caps,
+        )
+
+        n = self.mesh.shape["data"]
+        groups = self._field_groups(self._spec)
+        sig = tuple(
+            (fields, tuple(tuple(np.shape(batch[f])) for f in fields))
+            for fields in groups
+        )
+        plan = self._plan_cache.get(sig)
+        if plan is not None:
+            return plan
+        plan = {}
+        for fields, keys in groups.items():
+            kpad = sum(
+                int(np.prod(np.shape(batch[f]))) for f in fields
+            ) // n
+            for k in keys:
+                vocab = int(self.params[k].shape[0])
+                dim = int(np.prod(self.params[k].shape[1:]))
+                algo, _ = pick_exchange_algo(
+                    n, kpad, vocab, dim,
+                    sparse_bits=self.compress_bits,
+                    dense_bits=self.compress_bits,
+                    margin=self._dense_margin,
+                )
+                caps = (rs_default_caps(n, kpad, vocab)
+                        if algo == "sparse_rs" else None)
+                plan[k] = (fields, algo, caps)
+        self._plan_cache[sig] = plan
+        return plan
+
+    def _rs_batch_fits(self, batch, plan) -> bool:
+        """Exact host-side capacity check for this batch's reduce-scatter
+        tables (numpy over the raw id streams — one unique pass per member
+        per distinct stream, shared across that stream's cap combos).
+        True when every rs (stream, caps) combo fits; False routes the
+        batch to the allgather fallback program."""
+        from lightctr_tpu.dist.collectives import rs_fits
+
+        by_stream: Dict[tuple, set] = {}
+        for fields, algo, caps in plan.values():
+            if algo == "sparse_rs":
+                by_stream.setdefault(fields, set()).add(caps)
+        if not by_stream:
+            return True
+        n = self.mesh.shape["data"]
+        for fields, cap_set in by_stream.items():
+            per_member = [
+                np.concatenate([
+                    # each field shards by ITS OWN leading dim (fields of
+                    # one tuple may have different axis-0 sizes)
+                    np.asarray(batch[f])[
+                        m * (np.shape(batch[f])[0] // n):
+                        (m + 1) * (np.shape(batch[f])[0] // n)
+                    ].reshape(-1)
+                    for f in fields
+                ])
+                for m in range(n)
+            ]
+            for bucket_cap, shard_cap in cap_set:
+                if not rs_fits(per_member, n, bucket_cap, shard_cap):
+                    return False
+        return True
+
+    def _fallback_step_fn(self):
+        if self._step_ag is None:
+            self._force_ag = True
+            try:
+                self._step_ag = jax.jit(
+                    self._make_hybrid_dp_step(), donate_argnums=(0, 1)
+                )
+            finally:
+                self._force_ag = False
+        return self._step_ag
+
+    def train_step(self, batch):
+        self._last_step_fallback = False
+        if self._hybrid_dp:
+            plan = self._exchange_plan(batch)
+            if not self._rs_batch_fits(batch, plan):
+                self._last_step_fallback = True
+                self.telemetry.inc("trainer_rs_fallback_total")
+                primary, self._step = self._step, self._fallback_step_fn()
+                try:
+                    return super().train_step(batch)
+                finally:
+                    self._step = primary
+        return super().train_step(batch)
+
+    def fit(self, arrays, epochs=None, batch_size=None, eval_arrays=None,
+            eval_every=0, verbose=False):
+        # the full-batch epoch path dispatches self._step directly, so the
+        # rs capacity check must happen here (minibatch fits go through
+        # train_step, which guards itself)
+        kw = dict(epochs=epochs, batch_size=batch_size,
+                  eval_arrays=eval_arrays, eval_every=eval_every,
+                  verbose=verbose)
+        if (self._hybrid_dp and batch_size is None
+                and not self._rs_batch_fits(arrays,
+                                            self._exchange_plan(arrays))):
+            self.telemetry.inc("trainer_rs_fallback_total")
+            primary, self._step = self._step, self._fallback_step_fn()
+            try:
+                return super().fit(arrays, **kw)
+            finally:
+                self._step = primary
+        return super().fit(arrays, **kw)
+
+    def fit_fullbatch_scan(self, arrays, epochs):
+        if (self._hybrid_dp
+                and not self._rs_batch_fits(arrays,
+                                            self._exchange_plan(arrays))):
+            self.telemetry.inc("trainer_rs_fallback_total")
+            self._force_ag = True
+            try:
+                return super().fit_fullbatch_scan(arrays, epochs)
+            finally:
+                self._force_ag = False
+        return super().fit_fullbatch_scan(arrays, epochs)
+
+    def _get_scan_fn(self, epochs: int):
+        if self._force_ag:
+            # the fallback scan compiles against its own cache so the two
+            # program families never collide under one epochs key
+            main, self._scan_cache = self._scan_cache, self._scan_cache_ag
+            try:
+                return super()._get_scan_fn(epochs)
+            finally:
+                self._scan_cache = main
+        return super()._get_scan_fn(epochs)
+
     # -- telemetry ------------------------------------------------------
 
+    def _live_exchange_dicts(self):
+        """(policy, bytes) dicts of the program that actually ran the last
+        step — the fallback program records into its own pair."""
+        if self._last_step_fallback:
+            return self._fallback_policy, self._fallback_bytes
+        return self.exchange_policy, self.exchange_bytes_per_step
+
+    def _observe_scalars(self, hm, health) -> None:
+        """The hybrid step's health vector carries a third slot: the
+        in-jit rs overflow count.  Nonzero means the host capacity check
+        and the compiled program disagreed — gradient entries were
+        dropped; surface it loudly instead of silently."""
+        vals = np.asarray(health, np.float32)
+        hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
+        if vals.shape[0] > 2 and vals[2] > 0:
+            self.telemetry.inc("trainer_rs_overflow_total", int(vals[2]))
+            obs.emit_event("rs_overflow", count=int(vals[2]))
+
     def _exchange_byte_totals(self):
-        """(sparse_bytes, dense_bytes) each member transmits per step under
-        the trace-time decisions; populated after the first step."""
-        sparse_b = dense_b = 0
-        for k, pol in self.exchange_policy.items():
-            b = self.exchange_bytes_per_step.get(k, 0)
+        """(sparse_bytes, rs_bytes, dense_bytes) each member transmits per
+        step under the trace-time decisions; populated after the first
+        step."""
+        policy, xbytes = self._live_exchange_dicts()
+        sparse_b = rs_b = dense_b = 0
+        for k, pol in policy.items():
+            b = xbytes.get(k, 0)
             if pol == "sparse":
                 sparse_b += b
+            elif pol == "sparse_rs":
+                rs_b += b
             else:
                 dense_b += b
-        return sparse_b, dense_b
+        return sparse_b, rs_b, dense_b
 
     def _step_event_fields(self) -> Dict:
-        if not (self._hybrid_dp and self.exchange_policy):
+        if not (self._hybrid_dp and self._live_exchange_dicts()[0]):
             return {}
-        sparse_b, dense_b = self._exchange_byte_totals()
+        sparse_b, rs_b, dense_b = self._exchange_byte_totals()
+        policy, _ = self._live_exchange_dicts()
         return {
-            "exchange_policy": dict(self.exchange_policy),
+            "exchange_policy": dict(policy),
             "sparse_exchange_bytes": sparse_b,
+            "sparse_rs_bytes": rs_b,
             "dense_ring_bytes": dense_b,
         }
 
@@ -519,27 +831,41 @@ class SparseTableCTRTrainer(CTRTrainer):
 
     def _record_step(self, dt: float, batch, health=None) -> None:
         super()._record_step(dt, batch, health=health)
-        if not (self._hybrid_dp and self.exchange_policy):
+        policy, xbytes = self._live_exchange_dicts()
+        if not (self._hybrid_dp and policy):
             return
         reg = self.telemetry
-        for k, pol in self.exchange_policy.items():
-            b = self.exchange_bytes_per_step.get(k, 0)
+        for k, pol in policy.items():
+            b = xbytes.get(k, 0)
             reg.inc(
                 obs.labeled("trainer_exchange_bytes_total",
                             table=k, policy=pol),
                 b,
             )
-            reg.inc(
-                "trainer_sparse_exchange_bytes_total" if pol == "sparse"
-                else "trainer_dense_ring_bytes_total",
-                b,
-            )
-        if not self._exchange_logged:
-            # the density-switch decision is static post-trace: one
-            # ``exchange`` event per table, not one per step
-            self._exchange_logged = True
-            for k, pol in self.exchange_policy.items():
+            # per-table algorithm counter: which exchange each table leaf
+            # actually ran this step (the three-way pick, fallback included)
+            reg.inc(obs.labeled("trainer_exchange_algo_total",
+                                table=k, algo=pol))
+            if pol == "sparse":
+                reg.inc("trainer_sparse_exchange_bytes_total", b)
+            elif pol == "sparse_rs":
+                reg.inc("trainer_sparse_rs_bytes_total", b)
+            else:
+                reg.inc("trainer_dense_ring_bytes_total", b)
+        # the pick is static post-trace: one ``exchange`` event per table
+        # per PROGRAM, not one per step.  Primary and fallback decisions
+        # log independently (a fallback first step must not be
+        # immortalized as the run's choice, and a run whose every batch
+        # overflows still records what it actually ran).
+        if self._last_step_fallback:
+            logged, flag = self._fallback_logged, "_fallback_logged"
+        else:
+            logged, flag = self._exchange_logged, "_exchange_logged"
+        if not logged:
+            setattr(self, flag, True)
+            for k, pol in policy.items():
                 obs.emit_event(
                     "exchange", table=k, policy=pol,
-                    bytes_per_step=self.exchange_bytes_per_step.get(k, 0),
+                    bytes_per_step=xbytes.get(k, 0),
+                    fallback=self._last_step_fallback,
                 )

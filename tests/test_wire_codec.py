@@ -100,6 +100,118 @@ def test_native_f16_codec_bit_parity_with_numpy(rng):
         bindings.f16_decode_native(enc.tobytes(), v.size + 1)
 
 
+def test_pack_rows_unifies_adhoc_framing(rng):
+    """The sparse-rows frame (``pack_rows``: n varint, delta-coded sorted
+    uids, fp16 rows) is byte-identical to the ad-hoc ``pack_keys ++
+    pack_values`` concatenation the PS protocol always shipped — the codec
+    unification changes ZERO wire bytes, so old and new peers
+    interoperate unconditionally."""
+    keys = np.unique(rng.integers(0, 1 << 20, size=300)).astype(np.int64)
+    rows = (rng.normal(size=(keys.size, 7)) * 0.1).astype(np.float32)
+    new = wire.pack_rows(keys, rows)
+    old = wire.pack_keys(keys) + wire.pack_values(rows)[0]
+    assert new == old
+    k2, r2, used = wire.unpack_rows(new, 7)
+    assert used == len(new)
+    np.testing.assert_array_equal(k2, keys)
+    np.testing.assert_allclose(r2, rows, atol=2e-4)
+    # frames built the OLD way decode through the new unpacker, and a
+    # trailing section (e.g. a following frame) is left untouched
+    k3, r3, used3 = wire.unpack_rows(old + b"TRAILER", 7)
+    assert used3 == len(old)
+    np.testing.assert_array_equal(k3, keys)
+    # empty payload is a defined frame
+    e = wire.pack_rows(np.zeros(0, np.int64), np.zeros((0, 7), np.float32))
+    ke, re_, usede = wire.unpack_rows(e, 7)
+    assert ke.size == 0 and re_.shape == (0, 7) and usede == len(e)
+
+
+def test_push_pull_ride_unified_rows_frame(rng):
+    """MSG_PUSH payloads and MSG_PULL replies are the pack_rows frame:
+    a hand-rolled OLD-format push (pack_keys + pack_values) is applied by
+    the new server, and the new client's pull reply parses with the OLD
+    manual unpacking — wire compatibility in both directions."""
+    import socket
+    import struct
+
+    from lightctr_tpu.dist.ps_server import (
+        MSG_PULL, MSG_PUSH, PSClient, ParamServerService, _recv_msg,
+    )
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    dim = 4
+    ps = AsyncParamServer(dim=dim, n_workers=1, seed=0,
+                          learning_rate=0.5, updater="sgd")
+    svc = ParamServerService(ps)
+    try:
+        keys = np.arange(1, 9, dtype=np.int64)
+        c = PSClient(svc.address, dim)
+        try:
+            before = c.pull_arrays(keys, worker_epoch=0, worker_id=0)[1]
+            grads = np.full((keys.size, dim), 0.25, np.float32)
+            # OLD-format push on a raw socket (ad-hoc concat framing)
+            hdr = wire.pack_varint(np.array([0, 0], np.int64))
+            payload = (hdr + wire.pack_keys(keys)
+                       + wire.pack_values(grads)[0])
+            raw = socket.create_connection(svc.address)
+            try:
+                raw.sendall(
+                    struct.pack("<IB", len(payload), MSG_PUSH) + payload
+                )
+                _, reply = _recv_msg(raw)
+                assert reply == b"\x00"
+            finally:
+                raw.close()
+            # new client's pull reply, parsed the OLD manual way
+            hdr = wire.pack_varint(np.array([1, 0], np.int64))
+            c._send(MSG_PULL, hdr + wire.pack_keys(keys))
+            reply = c._recv_reply()
+            assert reply[:1] == b"\x00"
+            got_keys, consumed = wire.split_keys(reply[1:])
+            got_rows = wire.unpack_values(
+                reply[1 + consumed:], (keys.size, dim)
+            )
+            np.testing.assert_array_equal(got_keys, keys)
+            # sgd at lr 0.5: rows moved by -0.125 under the pushed grads
+            np.testing.assert_allclose(
+                got_rows, before - 0.125, rtol=0, atol=2e-3
+            )
+        finally:
+            c.close()
+    finally:
+        svc.close()
+
+
+def test_dim_skew_push_rejected_loud():
+    """A peer whose configured row width disagrees with the server's must
+    get the protocol-error reply, not have the first `dim` columns of
+    every row silently applied as a valid gradient (unpack_rows tolerates
+    trailing bytes; the PS frame boundary must not)."""
+    import socket
+    import struct
+
+    from lightctr_tpu.dist.ps_server import MSG_PUSH, ParamServerService, \
+        _recv_msg
+    from lightctr_tpu.embed.async_ps import AsyncParamServer
+
+    ps = AsyncParamServer(dim=4, n_workers=1, seed=0)
+    svc = ParamServerService(ps)
+    try:
+        keys = np.arange(1, 9, dtype=np.int64)
+        wide_rows = np.ones((keys.size, 8), np.float32)  # dim 8 != 4
+        hdr = wire.pack_varint(np.array([0, 0], np.int64))
+        payload = hdr + wire.pack_rows(keys, wide_rows)
+        raw = socket.create_connection(svc.address)
+        try:
+            raw.sendall(struct.pack("<IB", len(payload), MSG_PUSH) + payload)
+            _, reply = _recv_msg(raw)
+            assert reply == b"\xff"  # protocol error, nothing applied
+        finally:
+            raw.close()
+    finally:
+        svc.close()
+
+
 def test_trace_ctx_header_roundtrip():
     """The optional wire trace header: varint-framed, self-delimiting, and
     63-bit-id safe through the zigzag codec."""

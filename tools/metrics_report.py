@@ -74,9 +74,11 @@ def summarize(records) -> dict:
         if durations:
             step_rep["step_time"] = _percentiles(durations)
         sparse_b = sum(s.get("sparse_exchange_bytes", 0) for s in steps)
+        rs_b = sum(s.get("sparse_rs_bytes", 0) for s in steps)
         dense_b = sum(s.get("dense_ring_bytes", 0) for s in steps)
-        if sparse_b or dense_b:
+        if sparse_b or rs_b or dense_b:
             step_rep["sparse_exchange_bytes_total"] = sparse_b
+            step_rep["sparse_rs_bytes_total"] = rs_b
             step_rep["dense_ring_bytes_total"] = dense_b
         report["steps"] = step_rep
 
@@ -92,7 +94,8 @@ def summarize(records) -> dict:
     exchanges = by_kind.get("exchange", [])
     if exchanges:
         report["exchange_decisions"] = [
-            {k: e[k] for k in ("table", "policy", "bytes_per_step")
+            {k: e[k] for k in ("table", "policy", "bytes_per_step",
+                               "fallback")
              if k in e}
             for e in exchanges
         ]
