@@ -8,13 +8,24 @@ to disk" TODO in the PS (paramserver.h:309).  This module exceeds it by design
 data cursor, sharded-array aware, via Orbax.
 
 API: ``save(dir, step, state)`` / ``restore(dir, step=None, like=None)`` plus
-a ``Checkpointer`` with retention.
+a ``Checkpointer`` with retention, and the crash-safe PS-shard row snapshot
+pair ``save_arrays`` / ``load_latest_arrays`` — the migration SOURCE when a
+shard dies without a farewell (docs/ELASTICITY.md).
+
+Crash safety: every non-Orbax write lands in a same-directory tmp path,
+fsyncs, and atomically renames into place (Orbax does its own tmp+commit
+dance), so a writer killed mid-save leaves a ``*.tmp-*`` turd, never a
+half-written ``step_N`` a reader could mistake for a checkpoint.  Readers
+and the retention GC skip torn/partial directories instead of crashing.
 """
 
 from __future__ import annotations
 
+import logging
 import os
-from typing import Any, Optional
+import shutil
+import zipfile
+from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
@@ -26,41 +37,129 @@ try:  # orbax is in the image; guard anyway so the module imports everywhere
 except Exception:  # pragma: no cover
     _HAVE_ORBAX = False
 
+_LOG = logging.getLogger(__name__)
+
 
 def _np_tree(tree):
     return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durability for the RENAME itself: the directory entry must hit disk
+    or a crash can forget a fully-written checkpoint."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # platforms without O_RDONLY dirs: rename is still atomic
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _commit_dir(tmp: str, final: str) -> None:
+    """fsync every file in ``tmp``, then atomically rename over ``final``.
+    A previous complete checkpoint at ``final`` is replaced (save(force)
+    semantics); a previous TORN one is replaced too — strictly better."""
+    for root, _, files in os.walk(tmp):
+        for f in files:
+            _fsync_file(os.path.join(root, f))
+        _fsync_dir(root)
+    if os.path.isdir(final):
+        shutil.rmtree(final, ignore_errors=True)
+    os.replace(tmp, final)
+    _fsync_dir(os.path.dirname(final) or ".")
+
+
 def save(directory: str, step: int, state: Any) -> str:
-    """Write one checkpoint under ``directory/step_N``; returns the path."""
+    """Write one checkpoint under ``directory/step_N``; returns the path.
+    Crash-safe: the non-Orbax path stages into a tmp dir, fsyncs, and
+    renames into place, so readers never observe a torn ``step_N``."""
     path = os.path.join(directory, f"step_{step}")
     if _HAVE_ORBAX:
         ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.abspath(path), _np_tree(state), force=True)
         ckptr.wait_until_finished()
     else:  # fallback: flat npz of leaves (keeps tests hermetic)
-        os.makedirs(path, exist_ok=True)
+        os.makedirs(directory, exist_ok=True)
+        tmp = os.path.join(directory, f".step_{step}.tmp-{os.getpid()}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
         leaves, treedef = jax.tree_util.tree_flatten(_np_tree(state))
-        np.savez(os.path.join(path, "state.npz"), *leaves)
-        with open(os.path.join(path, "treedef.txt"), "w") as f:
+        np.savez(os.path.join(tmp, "state.npz"), *leaves)
+        with open(os.path.join(tmp, "treedef.txt"), "w") as f:
             f.write(str(treedef))
+        _commit_dir(tmp, path)
     return path
 
 
-def latest_step(directory: str) -> Optional[int]:
+def _writer_is_dead(pid_str: str) -> bool:
+    """True only when the staging dir's writer pid PROVABLY no longer
+    exists — anything ambiguous (unparseable, alive, or not ours to
+    signal) keeps the dir, because a live writer may still be mid-commit."""
+    try:
+        pid = int(pid_str)
+    except ValueError:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        return False  # e.g. EPERM: the pid exists under another user
+    return False
+
+
+def _step_dirs(directory: str):
+    """(step, name) for every WELL-FORMED step dir — tmp/torn names
+    (``step_5.orbax-checkpoint-tmp-...``, ``.step_5.tmp-123``) never
+    parse as a step."""
     if not os.path.isdir(directory):
-        return None
+        return []
+    out = []
+    for d in os.listdir(directory):
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit():
+            out.append((int(d.split("_", 1)[1]), d))
+    return sorted(out)
+
+
+def _is_complete(path: str) -> bool:
+    """A step dir a reader may trust.  The npz fallback's commit is atomic
+    (rename), so presence of the payload file IS completeness; Orbax
+    likewise only materializes the final name on commit.  An empty or
+    payload-less directory — e.g. mkdir'd then killed under an older
+    layout, or a partial copy — is torn."""
+    if not os.path.isdir(path):
+        return False
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    return bool(entries)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Newest COMPLETE step (torn/partial dirs are skipped, not trusted)."""
     steps = [
-        int(d.split("_", 1)[1])
-        for d in os.listdir(directory)
-        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+        s for s, d in _step_dirs(directory)
+        if _is_complete(os.path.join(directory, d))
     ]
     return max(steps) if steps else None
 
 
 def restore(directory: str, step: Optional[int] = None, like: Any = None) -> Any:
-    """Load a checkpoint (latest if ``step`` is None).  ``like`` is a template
-    pytree for structure/dtype restoration."""
+    """Load a checkpoint (latest complete one if ``step`` is None).
+    ``like`` is a template pytree for structure/dtype restoration."""
     if step is None:
         step = latest_step(directory)
         if step is None:
@@ -77,6 +176,87 @@ def restore(directory: str, step: Optional[int] = None, like: Any = None) -> Any
         raise ValueError("fallback restore needs a `like` template")
     treedef = jax.tree_util.tree_structure(like)
     return jax.tree_util.tree_unflatten(treedef, vals)
+
+
+# -- PS-shard row snapshots (the elastic-rebalance migration source) --------
+
+
+def save_arrays(
+    directory: str, step: int, keys: np.ndarray, rows: np.ndarray
+) -> str:
+    """Crash-safe (tmp + fsync + atomic rename) snapshot of a PS shard's
+    (keys, rows) — written on the shard's checkpoint cadence so the master
+    can migrate a DEAD shard's rows to its ring successors
+    (paramserver.h:309's missing backup, now closed).  Plain npz, no
+    Orbax: the writer may be SIGKILLed at any byte, and the reader is a
+    different process."""
+    os.makedirs(directory, exist_ok=True)
+    final = os.path.join(directory, f"rows_{int(step)}.npz")
+    tmp = os.path.join(directory, f".rows_{int(step)}.tmp-{os.getpid()}.npz")
+    keys = np.ascontiguousarray(keys, np.int64)
+    rows = np.ascontiguousarray(rows, np.float32)
+    if rows.shape[0] != keys.shape[0]:
+        raise ValueError("keys/rows length mismatch")
+    with open(tmp, "wb") as f:
+        np.savez(f, keys=keys, rows=rows, step=np.int64(step))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    _fsync_dir(directory)
+    return final
+
+
+def load_latest_arrays(
+    directory: str,
+) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+    """Newest readable ``rows_N.npz`` -> (step, keys, rows); None when the
+    directory holds none.  A torn/unreadable snapshot (crash mid-write
+    under a non-atomic filesystem, or a stray file) is SKIPPED with a
+    warning — restore walks back to the newest intact one instead of
+    crashing the rebalance that needs it."""
+    if not os.path.isdir(directory):
+        return None
+    cands = []
+    for fn in os.listdir(directory):
+        if fn.startswith("rows_") and fn.endswith(".npz"):
+            stem = fn[len("rows_"):-len(".npz")]
+            if stem.isdigit():
+                cands.append((int(stem), fn))
+    for step, fn in sorted(cands, reverse=True):
+        path = os.path.join(directory, fn)
+        try:
+            with np.load(path) as z:
+                keys = np.asarray(z["keys"], np.int64)
+                rows = np.asarray(z["rows"], np.float32)
+            if rows.shape[0] != keys.shape[0]:
+                raise ValueError("keys/rows length mismatch")
+            return step, keys, rows
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile) as e:
+            _LOG.warning("skipping torn shard snapshot %s: %s", path, e)
+    return None
+
+
+def gc_array_snapshots(directory: str, keep: int = 3) -> None:
+    """Drop all but the newest ``keep`` row snapshots + any tmp turds."""
+    if not os.path.isdir(directory):
+        return
+    cands = []
+    for fn in os.listdir(directory):
+        if fn.startswith(".rows_") and ".tmp-" in fn:
+            try:
+                os.unlink(os.path.join(directory, fn))
+            except OSError:
+                pass
+        elif fn.startswith("rows_") and fn.endswith(".npz"):
+            stem = fn[len("rows_"):-len(".npz")]
+            if stem.isdigit():
+                cands.append((int(stem), fn))
+    for _, fn in sorted(cands, reverse=True)[keep:]:
+        try:
+            os.unlink(os.path.join(directory, fn))
+        except OSError:
+            pass
 
 
 class Checkpointer:
@@ -99,13 +279,28 @@ class Checkpointer:
         return restore(self.directory, like=like)
 
     def _gc(self):
-        steps = sorted(
-            int(d.split("_", 1)[1])
-            for d in os.listdir(self.directory)
-            # ignore e.g. orbax tmp dirs ("step_5.orbax-checkpoint-tmp-...")
-            if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-        )
+        """Retention sweep over COMPLETE checkpoints only.  Torn/partial
+        step directories (a sibling writer SIGKILLed mid-save) are
+        ignored — they neither count against ``keep`` nor crash the
+        sweep — and never deleted here: the live writer may still be
+        committing the one we'd be looking at.  STAGING turds
+        (``.step_N.tmp-<pid>``) whose writer pid is provably gone ARE
+        reaped, or crash/restart cycles would accumulate them without
+        bound."""
+        try:
+            steps = [
+                s for s, d in _step_dirs(self.directory)
+                if _is_complete(os.path.join(self.directory, d))
+            ]
+            entries = os.listdir(self.directory)
+        except OSError:
+            return
         for s in steps[: -self.keep]:
-            import shutil
-
-            shutil.rmtree(os.path.join(self.directory, f"step_{s}"), ignore_errors=True)
+            shutil.rmtree(
+                os.path.join(self.directory, f"step_{s}"), ignore_errors=True
+            )
+        for d in entries:
+            if d.startswith(".step_") and ".tmp-" in d \
+                    and _writer_is_dead(d.rsplit("-", 1)[-1]):
+                shutil.rmtree(os.path.join(self.directory, d),
+                              ignore_errors=True)

@@ -79,6 +79,7 @@ class HeartbeatMonitor:
         on_recover: Optional[Callable[[str], None]] = None,
         on_stale: Optional[Callable[[str], None]] = None,
         on_stale_clear: Optional[Callable[[str], None]] = None,
+        on_join: Optional[Callable[[str], None]] = None,
     ):
         self._last: Dict[str, float] = {}
         self._dead: set = set()
@@ -88,12 +89,16 @@ class HeartbeatMonitor:
         # beat fires on_stale_clear (a listener tracking the degraded
         # set must see the improvement too), death supersedes it
         self._stale: set = set()
-        # listener tuples: (on_dead, on_recover, on_stale, on_stale_clear)
+        # listener tuples:
+        # (on_dead, on_recover, on_stale, on_stale_clear, on_join) —
+        # on_join fires on a NEVER-SEEN worker's first beat (elastic
+        # membership: a fresh node announcing itself is a join event the
+        # master turns into an epoch bump, master.h:80-82 registration)
         self._listeners: list = []
         if any(cb is not None for cb in
-               (on_dead, on_recover, on_stale, on_stale_clear)):
+               (on_dead, on_recover, on_stale, on_stale_clear, on_join)):
             self._listeners.append(
-                (on_dead, on_recover, on_stale, on_stale_clear)
+                (on_dead, on_recover, on_stale, on_stale_clear, on_join)
             )
         self.stale_after_s = stale_after_s
         self.dead_after_s = dead_after_s
@@ -118,12 +123,13 @@ class HeartbeatMonitor:
         on_recover: Optional[Callable[[str], None]] = None,
         on_stale: Optional[Callable[[str], None]] = None,
         on_stale_clear: Optional[Callable[[str], None]] = None,
+        on_join: Optional[Callable[[str], None]] = None,
     ) -> None:
-        """Register death/recovery/staleness callbacks (the public wiring
-        point for consumers like AsyncParamServer.attach_heartbeat)."""
+        """Register death/recovery/staleness/join callbacks (the public
+        wiring point for consumers like AsyncParamServer.attach_heartbeat)."""
         with self._lock:
             self._listeners.append(
-                (on_dead, on_recover, on_stale, on_stale_clear)
+                (on_dead, on_recover, on_stale, on_stale_clear, on_join)
             )
 
     def _dispatch(self) -> None:
@@ -135,7 +141,7 @@ class HeartbeatMonitor:
                     kind, worker = self._events.pop(0)
                     listeners = list(self._listeners)
                 idx = {"dead": 0, "recover": 1, "stale": 2,
-                       "stale_clear": 3}[kind]
+                       "stale_clear": 3, "join": 4}[kind]
                 for cbs in listeners:
                     cb = cbs[idx]
                     if cb is not None:
@@ -143,7 +149,12 @@ class HeartbeatMonitor:
 
     def beat(self, worker: str) -> None:
         with self._lock:
+            joined = worker not in self._last
             self._last[worker] = self._clock()
+            if joined:
+                # first-ever beat: a join event (clean departures forget()
+                # the worker, so a later return is a fresh join again)
+                self._events.append(("join", worker))
             if worker in self._stale:
                 # returned before the dead line: clear the degraded
                 # stage, drop any queued-but-undispatched stale event,

@@ -18,18 +18,26 @@ different roles.
 from __future__ import annotations
 
 import logging
+import os
+import random
 import threading
+import time
 from typing import Optional
 
+import numpy as np
+
+from lightctr_tpu.ckpt import checkpoint as ckpt_mod
 from lightctr_tpu.dist.bootstrap import (
     DEAD_AFTER_S,
     HEARTBEAT_PERIOD_S,
     STALE_AFTER_S,
     HeartbeatMonitor,
 )
+from lightctr_tpu.dist.elastic import RoutingTable, plan_migration
 from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import emit_event
+from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import trace as obs_trace
@@ -73,7 +81,21 @@ class MasterService:
         period_s: float = HEARTBEAT_PERIOD_S,
         shard_rpc_timeout_s: float = 5.0,
         degraded_after_missed: Optional[int] = None,
+        elastic: bool = False,
+        partition: str = "ring",
+        dim: int = 1,
+        ckpt_dir: Optional[str] = None,
+        grace_factor: float = 3.0,
     ):
+        """``elastic=True`` turns detection into ACTION (docs/ELASTICITY.md):
+        the master owns an epoch-numbered :class:`RoutingTable` (served
+        over ``MSG_ROUTE``), and membership transitions drive checkpointed
+        row migration — a dead shard's rows move from its latest snapshot
+        under ``ckpt_dir/shard_<i>`` to its ring successors, a joining
+        shard receives (and the donors evict) exactly its arcs' rows.
+        ``dim`` must then be the PS row width (admin migrate/snapshot ops
+        decode rows); ``grace_factor`` widens every surviving shard's SSP
+        staleness budget for the duration of a rebalance."""
         # ``degraded_after_missed`` (k): a node is marked DEGRADED after
         # k missed heartbeat periods — expressed to the monitor as the
         # stale threshold, overriding stale_after_s when given
@@ -85,6 +107,25 @@ class MasterService:
         # retried), not stall heartbeat processing under the dispatch lock
         self._shard_addresses = [tuple(a) for a in shard_addresses]
         self._timeout = shard_rpc_timeout_s
+        self.elastic = bool(elastic)
+        self.dim = int(dim)
+        self.ckpt_dir = ckpt_dir
+        self.grace_factor = float(grace_factor)
+        # routing state: membership sets are the source of truth; every
+        # publish derives a fresh immutable RoutingTable at epoch+1
+        self._route_lock = threading.Lock()
+        self._members = list(range(len(self._shard_addresses)))
+        self._workers: set = set()
+        self._partition_name = str(partition)
+        self._routing = RoutingTable(
+            epoch=0,
+            members=self._members,
+            addresses=self._addr_map(),
+            partition=self._partition_name,
+        )
+        # serializes whole rebalances (a second death mid-migration waits)
+        self._rebalance_lock = threading.Lock()
+        self.migrations: list = []  # verification records, newest last
         # admin connections are LAZY (None until first use, re-None'd on
         # failure): a shard that is down at master startup — or dies later —
         # must degrade to queued decisions, not crash the control plane
@@ -94,9 +135,12 @@ class MasterService:
         self._pending = [[] for _ in self._shard_addresses]
         # serializes ALL admin traffic: _broadcast arrives from the
         # monitor's dispatch thread AND per-connection farewell handlers,
-        # and flush_pending from arbitrary callers — the admin PSClients'
-        # sockets and the pending queues are not thread-safe
-        self._admin_lock = threading.Lock()
+        # flush_pending from arbitrary callers, and the rebalance threads'
+        # migrate/evict/grace traffic — the admin PSClients' sockets and
+        # the pending queues are not thread-safe.  RLock: _admin_rpc
+        # acquires it itself, and _broadcast/_replay call it while already
+        # holding it
+        self._admin_lock = threading.RLock()
         self.monitor = HeartbeatMonitor(
             stale_after_s=stale_after_s,
             dead_after_s=dead_after_s,
@@ -105,6 +149,7 @@ class MasterService:
             on_recover=self._broadcast_readmit,
             on_stale=self._on_stale,
             on_stale_clear=self._on_stale_clear,
+            on_join=self._on_join,
         )
         # dummy store: gives the service something to answer STATS with;
         # routing state that matters lives on the shards.  Clean departures
@@ -123,7 +168,8 @@ class MasterService:
         self.health.ensure_detector(obs_health.HeartbeatGapDetector())
         self._svc = ParamServerService(
             self._store, host=host, port=port, monitor=self.monitor,
-            on_farewell=self._broadcast_readmit_wid, health=self.health,
+            on_farewell=self._on_farewell_wid, health=self.health,
+            route_provider=self.routing_dict,
         )
         self.address = self._svc.address
         self.monitor.start()
@@ -145,28 +191,56 @@ class MasterService:
             return None
         return wid - SHARD_ID_BASE if wid >= SHARD_ID_BASE else None
 
-    def _deliver(self, i: int, op: str, wid: int, attempts: int = 3) -> bool:
-        """Try an admin op against shard ``i`` up to ``attempts`` times,
+    # retry pacing for admin delivery: capped exponential backoff with
+    # jitter BETWEEN attempts — back-to-back retries against a shard that
+    # is restarting all land in the same refused window, and a jitterless
+    # fleet of masters (tests run many) would synchronize
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 2.0
+
+    @classmethod
+    def _backoff_s(cls, attempt: int) -> float:
+        return min(cls.BACKOFF_CAP_S, cls.BACKOFF_BASE_S * (2 ** attempt)) \
+            * (0.5 + 0.5 * random.random())
+
+    def _admin_rpc(self, i: int, fn, attempts: int = 3):
+        """Run ``fn(client)`` against shard ``i`` up to ``attempts`` times,
         reconnecting between tries (so every reconnect is followed by an
-        op retry, never wasted on the final slot)."""
-        for attempt in range(attempts):
-            try:
-                if self._shards[i] is None:
-                    self._shards[i] = PSClient(
-                        self._shard_addresses[i], 1, timeout=self._timeout
-                    )
-                getattr(self._shards[i], op)(wid)
-                return True
-            except (ConnectionError, OSError, RuntimeError):
-                if self._shards[i] is not None:
-                    try:
-                        self._shards[i].close()
-                    except OSError:
-                        pass
-                    self._shards[i] = None
-                if attempt == attempts - 1:
-                    return False
-        return False
+        op retry, never wasted on the final slot) with capped exponential
+        backoff + jitter before each retry.  Returns (ok, result-or-error);
+        retries and exhaustions land in the metrics registry."""
+        telem = obs_gate.enabled()
+        err = None
+        with self._admin_lock:
+            for attempt in range(attempts):
+                if attempt:
+                    if telem:
+                        self.registry.inc("master_delivery_retries_total")
+                    time.sleep(self._backoff_s(attempt - 1))
+                try:
+                    if self._shards[i] is None:
+                        self._shards[i] = PSClient(
+                            self._shard_addresses[i], self.dim,
+                            timeout=self._timeout,
+                        )
+                    return True, fn(self._shards[i])
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    err = e
+                    if self._shards[i] is not None:
+                        try:
+                            self._shards[i].close()
+                        except OSError:
+                            pass
+                        self._shards[i] = None
+        if telem:
+            self.registry.inc("master_delivery_exhausted_total")
+        return False, err
+
+    def _deliver(self, i: int, op: str, wid: int, attempts: int = 3) -> bool:
+        ok, _ = self._admin_rpc(
+            i, lambda c: getattr(c, op)(wid), attempts=attempts
+        )
+        return ok
 
     def _replay(self, i: int) -> bool:
         """Drain shard ``i``'s missed-decision queue in order, stopping at
@@ -181,6 +255,303 @@ class MasterService:
             if obs_gate.enabled():
                 self.registry.inc("master_replayed_decisions_total")
         return True
+
+    # -- elastic membership: routing + row migration (docs/ELASTICITY.md) ---
+
+    def _addr_map(self):
+        return {i: a for i, a in enumerate(self._shard_addresses)}
+
+    def routing_dict(self):
+        """Current routing table as a JSON-ready dict — the MSG_ROUTE
+        payload (and the thing clients poll)."""
+        with self._route_lock:
+            return self._routing.to_dict()
+
+    @property
+    def routing(self) -> RoutingTable:
+        with self._route_lock:
+            return self._routing
+
+    def _publish(self, members=None, workers_add=None, workers_remove=None,
+                 rebalancing=None, bump=True,
+                 action="route_update") -> RoutingTable:
+        """Derive + install a new routing table from the membership sets.
+        One lock, one swap: clients fetching MSG_ROUTE see either the old
+        epoch or the new one, never a half-built table.  Worker changes
+        are expressed as add/remove MUTATIONS applied under the lock — a
+        read-modify-write against a snapshot would let a concurrent
+        join/leave on another thread be lost."""
+        with self._route_lock:
+            if members is not None:
+                self._members = sorted(int(m) for m in members)
+            if workers_add is not None:
+                self._workers.add(int(workers_add))
+            if workers_remove is not None:
+                self._workers.discard(int(workers_remove))
+            flag = (self._routing.rebalancing if rebalancing is None
+                    else bool(rebalancing))
+            table = RoutingTable(
+                epoch=self._routing.epoch + (1 if bump else 0),
+                members=self._members,
+                addresses=self._addr_map(),
+                partition=self._partition_name,
+                workers=sorted(self._workers),
+                rebalancing=flag,
+            )
+            self._routing = table
+        if obs_gate.enabled():
+            self.registry.gauge_set("master_route_epoch", table.epoch)
+            self.registry.inc("master_route_publishes_total")
+        emit_event("failover", action=action, epoch=table.epoch,
+                   members=list(table.members),
+                   workers=list(table.workers),
+                   rebalancing=table.rebalancing)
+        return table
+
+    def _broadcast_grace(self, members, factor: float) -> None:
+        """Widen (or restore, factor=1) the SSP staleness budget on every
+        given shard — the rebalance grace window.  Best effort with the
+        usual retry/backoff; a shard that misses the restore re-syncs on
+        its next grace cycle."""
+        for i in members:
+            self._admin_rpc(i, lambda c: c.grace(factor))
+
+    def _migrate_ranges(self, keys, rows, new_table, reason="shard_death"):
+        """Ship (keys, rows) to their owners under ``new_table`` with
+        per-range row-count + FNV read-back verification; appends one
+        record per range to ``self.migrations`` and returns
+        (all_verified, records)."""
+        records = []
+        ok_all = True
+        plan = plan_migration(keys, new_table)
+        order = np.argsort(keys, kind="stable")
+        sorted_keys = keys[order]
+        for dst, dkeys in sorted(plan.items()):
+            pos = np.searchsorted(sorted_keys, dkeys)
+            drows = rows[order[pos]]
+            ok, rep = self._admin_rpc(
+                dst, lambda c: c.migrate_rows(dkeys, drows, new_table.epoch)
+            )
+            rec = {
+                "dst": int(dst), "n": int(len(dkeys)), "reason": reason,
+                "epoch": int(new_table.epoch),
+            }
+            if ok:
+                rec.update(rep)
+            else:
+                rec.update({"verified": False, "error": str(rep)})
+            if not rec.get("verified"):
+                ok_all = False
+            records.append(rec)
+            if obs_gate.enabled():
+                self.registry.inc(labeled(
+                    "master_migrated_rows_total", verified=str(
+                        bool(rec.get("verified"))).lower(),
+                ), len(dkeys))
+        self.migrations.extend(records)
+        return ok_all, records
+
+    def _rebalance_episode(self, action, shard, target_members,
+                           publish_action, work_fn):
+        """Shared rebalance choreography: serialize episodes, widen the
+        SSP budget on the surviving members, run ``work_fn`` (the actual
+        row movement; returns the records), publish the epoch bump with
+        ``target_members``, then restore the budget and publish the
+        settled flag.  The membership publish happens in a ``finally`` ON
+        PURPOSE: these run on fire-and-forget threads, and a work_fn
+        crash (bad checkpoint, dim skew) must degrade to
+        members-published-rows-unverified — evented and counted — never
+        to routing stranded at the dead epoch forever.  The episode is
+        evented begin/done and — when the flight recorder is armed —
+        dumped as a bundle, so the postmortem story survives the run."""
+        with self._rebalance_lock:
+            t0 = time.monotonic()
+            emit_event("failover", action=f"{action}_begin", shard=shard)
+            with obs_trace.span(f"master/{action}", shard=shard):
+                survivors = [m for m in self.routing.members
+                             if m != shard] or list(self.routing.members)
+                self._broadcast_grace(survivors, self.grace_factor)
+                verified, records = False, []
+                try:
+                    verified, records = work_fn()
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "%s: row migration for shard %s failed; publishing "
+                        "the membership change anyway (rows unverified)",
+                        action, shard,
+                    )
+                    emit_event("failover", action=f"{action}_error",
+                               shard=shard)
+                    if obs_gate.enabled():
+                        self.registry.inc(labeled(
+                            "master_rebalance_errors_total", kind=action))
+                finally:
+                    self._publish(members=target_members, rebalancing=True,
+                                  action=publish_action)
+                    self._broadcast_grace(survivors, 1.0)
+                    self._publish(rebalancing=False, bump=False,
+                                  action=f"{action}_settled")
+            dt = time.monotonic() - t0
+            if obs_gate.enabled():
+                self.registry.inc(labeled("master_rebalances_total",
+                                          kind=action))
+                self.registry.observe("master_rebalance_seconds", dt)
+            emit_event("failover", action=f"{action}_done", shard=shard,
+                       verified=verified, seconds=round(dt, 6),
+                       ranges=records, epoch=self.routing.epoch)
+            logging.getLogger(__name__).warning(
+                "%s: shard %s rebalanced in %.3fs (%d ranges, verified=%s, "
+                "epoch %d)", action, shard, dt, len(records), verified,
+                self.routing.epoch,
+            )
+            # the flight recorder captures the episode at act time — the
+            # chaos harness reads this bundle back via trace_report --flight
+            if obs_flight.armed():
+                obs_flight.dump(f"{action}:shard{shard}")
+            return verified
+
+    def _shard_ckpt_source(self, shard: int):
+        """(keys, rows) from the dead shard's newest intact snapshot under
+        ``ckpt_dir/shard_<i>`` — the migration source when the process is
+        gone.  Empty when no checkpoint exists (rows are then lazily
+        re-initialized by their new owners, counted as lost)."""
+        if self.ckpt_dir is None:
+            return np.zeros(0, np.int64), np.zeros((0, self.dim), np.float32)
+        out = ckpt_mod.load_latest_arrays(
+            os.path.join(self.ckpt_dir, f"shard_{int(shard)}")
+        )
+        if out is None:
+            return np.zeros(0, np.int64), np.zeros((0, self.dim), np.float32)
+        _, keys, rows = out
+        return keys, rows
+
+    def _rebalance_drop(self, shard: int) -> bool:
+        """A member shard died: migrate its rows (from its checkpoint) to
+        their new owners under the shrunken ring, THEN publish the epoch
+        bump — clients keep retrying the dead address until the rows are
+        in place, so no pull ever lazily re-initializes a row the
+        migration is about to land (zero row loss, checksum-verified)."""
+        if shard not in self.routing.members:
+            return False
+        if len(self.routing.members) <= 1:
+            logging.getLogger(__name__).error(
+                "last PS shard %d died: nothing to rebalance onto", shard,
+            )
+            return False
+
+        new_table = self.routing.without_shard(shard)
+
+        def work():
+            keys, rows = self._shard_ckpt_source(shard)
+            if not len(keys):
+                emit_event("failover", action="migration_source_empty",
+                           shard=shard)
+            return self._migrate_ranges(
+                keys, rows, new_table, reason="shard_death",
+            )
+
+        return self._rebalance_episode(
+            "rebalance_drop", shard, new_table.members, "shard_dropped",
+            work,
+        )
+
+    def _rebalance_join(self, shard: int) -> bool:
+        """A shard (re)joined: donors snapshot, the joiner receives
+        exactly the keys the grown ring maps onto it (checksum-verified),
+        donors evict what they handed off, and only then does the epoch
+        bump route traffic at the joiner.  A re-joining shard is wiped
+        first — its rows predate the epochs it missed."""
+
+        with self._route_lock:
+            members = sorted(set(self._members) | {int(shard)})
+
+        def work():
+            joined = RoutingTable(
+                epoch=self.routing.epoch + 1, members=members,
+                addresses=self._addr_map(),
+                partition=self._partition_name,
+            )
+            # wipe the joiner: whatever it holds is from before it left
+            ok, snap = self._admin_rpc(shard, lambda c: c.snapshot_arrays())
+            if ok and len(snap[0]):
+                self._admin_rpc(shard, lambda c, k=snap[0]: c.evict(k))
+            verified = True
+            records = []
+            for donor in self.routing.members:
+                if donor == shard:
+                    continue
+                ok, snap = self._admin_rpc(
+                    donor, lambda c: c.snapshot_arrays()
+                )
+                if not ok:
+                    verified = False
+                    records.append({"dst": int(shard), "donor": int(donor),
+                                    "verified": False, "error": str(snap)})
+                    continue
+                dkeys, drows = snap
+                moving = plan_migration(dkeys, joined).get(int(shard))
+                if moving is None or not len(moving):
+                    continue
+                pos = np.searchsorted(dkeys, moving)
+                v, recs = self._migrate_ranges(
+                    moving, drows[pos], joined, reason="shard_join",
+                )
+                for r in recs:
+                    r["donor"] = int(donor)
+                verified = verified and v
+                if v:
+                    # hand-off complete: the donor must not keep stale
+                    # duplicates of rows it no longer owns
+                    self._admin_rpc(
+                        donor, lambda c, k=moving: c.evict(k)
+                    )
+                records.extend(recs)
+            return verified, records
+
+        return self._rebalance_episode(
+            "rebalance_join", shard, members, "shard_joined", work,
+        )
+
+    def admit_shard(self, address) -> int:
+        """Admit a NEW shard process into the cluster: allocates the next
+        stable shard id, migrates its ring share over (donors evict), and
+        publishes the epoch.  Returns the shard id (its heartbeats should
+        use ``SHARD_ID_BASE + id``)."""
+        if not self.elastic:
+            raise RuntimeError("admit_shard requires elastic=True")
+        with self._admin_lock:
+            shard = len(self._shard_addresses)
+            self._shard_addresses.append(tuple(address))
+            self._shards.append(None)
+            self._pending.append([])
+        emit_event("failover", action="shard_admitted", shard=shard,
+                   address=list(address))
+        self._rebalance_join(shard)
+        return shard
+
+    def _on_join(self, worker: str) -> None:
+        """First-ever beat: a WORKER joining bumps the membership epoch so
+        every process derives the same data-shard map from the same table
+        (elastic worker join).  Shard first-beats are just startup."""
+        if not self.elastic:
+            return
+        wid = self._to_wid(worker)
+        if wid is None:
+            return
+        with self._route_lock:
+            known = wid in self._workers
+        if not known:
+            emit_event("failover", action="worker_joined", worker=wid)
+            self._publish(workers_add=wid, action="worker_joined")
+
+    def _on_farewell_wid(self, wid: int) -> None:
+        """Clean worker departure: readmit routes (historic behavior) and,
+        in elastic mode, shrink the worker set under a new epoch so the
+        departed worker's data shards are re-dealt."""
+        self._broadcast("readmit", wid)
+        if self.elastic and wid in self.routing.workers:
+            emit_event("failover", action="worker_left", worker=wid)
+            self._publish(workers_remove=wid, action="worker_left")
 
     def _broadcast(self, op: str, wid: int) -> None:
         """Deliver a routing decision to every shard; decisions a shard
@@ -267,6 +638,12 @@ class MasterService:
         if wid is not None:
             emit_event("failover", action="unroute", worker=wid)
             self._broadcast("unroute", wid)
+            if self.elastic and wid in self.routing.workers:
+                # elastic worker LEAVE: shrink the worker set under a new
+                # epoch — survivors re-deal the dead worker's data shards
+                # from the same table, no coordination needed
+                emit_event("failover", action="worker_left", worker=wid)
+                self._publish(workers_remove=wid, action="worker_left")
             self._observe_peers()
             return
         shard = self._to_shard(worker)
@@ -277,6 +654,13 @@ class MasterService:
             logging.getLogger(__name__).warning(
                 "PS shard %d declared dead (heartbeat silence)", shard
             )
+            if self.elastic and shard in self.routing.members:
+                # ACT, off the monitor's dispatch thread: migration does
+                # socket I/O with retries, and the monitor must keep
+                # sweeping other peers while rows move
+                threading.Thread(
+                    target=self._rebalance_drop, args=(shard,), daemon=True,
+                ).start()
             self._observe_peers()
 
     def _broadcast_readmit(self, worker: str) -> None:
@@ -284,11 +668,24 @@ class MasterService:
         if wid is not None:
             emit_event("failover", action="readmit", worker=wid)
             self._broadcast("readmit", wid)
+            if self.elastic and wid not in self.routing.workers:
+                # a readmitted worker resumes from the NEW epoch's shard
+                # map, exactly like a fresh join
+                emit_event("failover", action="worker_joined", worker=wid)
+                self._publish(workers_add=wid, action="worker_joined")
             self._observe_peers()
             return
         shard = self._to_shard(worker)
         if shard is not None:
             self._resync_shard(shard)
+            if self.elastic and shard not in self.routing.members \
+                    and 0 <= shard < len(self._shard_addresses):
+                # partition healed / fresh incarnation on a known address:
+                # fold the shard back in with a join migration (its store
+                # predates the epochs it missed and is wiped first)
+                threading.Thread(
+                    target=self._rebalance_join, args=(shard,), daemon=True,
+                ).start()
             self._observe_peers()
 
     def _resync_shard(self, shard: int) -> None:

@@ -41,33 +41,69 @@ def fnv1a64_keys(keys: np.ndarray) -> np.ndarray:
     return h
 
 
+def _resolve_members(n_shards, members):
+    """Normalize the (n_shards, members) pair every policy accepts:
+    ``members`` is the LIVE subset of stable shard ids (elastic membership);
+    None means all of ``range(n_shards)`` — the static pre-elastic form."""
+    if members is None:
+        if n_shards is None:
+            raise ValueError("need n_shards or members")
+        members = range(n_shards)
+    out = sorted({int(m) for m in members})
+    if not out:
+        raise ValueError("partition needs at least one member shard")
+    if any(m < 0 for m in out):
+        raise ValueError("shard ids must be >= 0")
+    return out
+
+
 class ModuloPartition:
     """Static ``key % n`` routing — uniform for folded ids, but a shard
-    count change remaps ~the whole keyspace (no elastic story)."""
+    count change remaps ~the whole keyspace (no elastic story).  With a
+    ``members`` subset it routes ``key % len(members)`` into the sorted
+    member list — still non-elastic (membership change remaps ~all keys),
+    kept only so both policies share the cluster-map interface."""
 
     name = "modulo"
 
-    def __init__(self, n_shards: int):
-        self.n_shards = n_shards
+    def __init__(self, n_shards: int = None, members=None):
+        self.members = _resolve_members(n_shards, members)
+        self.n_shards = (self.members[-1] + 1) if n_shards is None \
+            else n_shards
+        self._members_arr = np.array(self.members, np.int64)
 
     def shard_of(self, keys: np.ndarray) -> np.ndarray:
-        return (np.asarray(keys, np.int64) % self.n_shards).astype(np.int64)
+        k = np.asarray(keys, np.int64)
+        if len(self.members) == self.n_shards and \
+                self.members == list(range(self.n_shards)):
+            # dense membership: the historical key % n mapping, unchanged
+            return (k % self.n_shards).astype(np.int64)
+        return self._members_arr[k % len(self.members)]
 
 
 class RingPartition:
     """Virtual-node consistent-hash ring (consistent_hash.h:18-67; the
     reference plants ``VIRTUAL_NODE=5`` points per shard at
     ``consistent_hash.h:23-31``).  A key routes to the first vnode
-    clockwise of its hash, wrapping past 2^64."""
+    clockwise of its hash, wrapping past 2^64.
+
+    Vnode labels are keyed by STABLE shard id, so the ring over live
+    members ``{0, 2}`` is exactly the ring over ``{0, 1, 2}`` with shard
+    1's arcs absorbed by their clockwise successors: removing a member
+    moves ONLY that member's keys, adding one moves only the keys landing
+    on the new member's arcs (~1/n) — the property elastic rebalancing
+    relies on to bound row migration (docs/ELASTICITY.md)."""
 
     name = "ring"
 
-    def __init__(self, n_shards: int, vnodes: int = 5):
-        self.n_shards = n_shards
+    def __init__(self, n_shards: int = None, vnodes: int = 5, members=None):
+        self.members = _resolve_members(n_shards, members)
+        self.n_shards = (self.members[-1] + 1) if n_shards is None \
+            else n_shards
         self.vnodes = vnodes
         points = [
             (fnv1a64_bytes(f"shard-{s}#vnode-{v}".encode()), s)
-            for s in range(n_shards)
+            for s in self.members
             for v in range(vnodes)
         ]
         points.sort()
@@ -80,9 +116,12 @@ class RingPartition:
         return self._shard[idx]
 
 
-def make_partition(name: str, n_shards: int):
+def make_partition(name: str, n_shards: int = None, members=None,
+                   vnodes: int = 5):
+    """Build a key->shard policy over the live member set (None = all of
+    ``range(n_shards)``, the static form every pre-elastic caller uses)."""
     if name == "modulo":
-        return ModuloPartition(n_shards)
+        return ModuloPartition(n_shards, members=members)
     if name == "ring":
-        return RingPartition(n_shards)
+        return RingPartition(n_shards, vnodes=vnodes, members=members)
     raise ValueError(f"unknown partition policy {name!r}")

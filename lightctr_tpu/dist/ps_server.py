@@ -32,6 +32,8 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
+import random
 import socket
 import struct
 import threading
@@ -41,6 +43,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from lightctr_tpu.dist import wire
+from lightctr_tpu.dist.elastic import frame_checksum
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
@@ -62,13 +65,31 @@ MSG_FAREWELL = 8
 # decides, network.h:148-151 the PS obeys)
 MSG_UNROUTE = 9
 MSG_READMIT = 10
+# elastic-membership ops (docs/ELASTICITY.md):
+#   ROUTE   -> empty; reply JSON routing table (epoch, members, addresses,
+#              workers, rebalancing) — the master publishes, clients poll;
+#              a shard with no route provider replies {"epoch": -1}
+#   MIGRATE -> varint([epoch]) ++ pack_rows(keys, rows); the shard applies
+#              the rows (preload semantics) then replies JSON {"n", "fnv"}
+#              where fnv is the lane-FNV checksum of the rows RE-READ from
+#              its store — the zero-row-loss verification the rebalance
+#              protocol asserts on
+#   EVICT   -> pack_keys(keys); reply JSON {"evicted": n} — rows migrated
+#              away must not survive as stale duplicates
+#   GRACE   -> varint([factor_x1000]); widens (1000 restores) the SSP
+#              staleness budget while a rebalance is in flight
+MSG_ROUTE = 11
+MSG_MIGRATE = 12
+MSG_EVICT = 13
+MSG_GRACE = 14
 
 # wire-op names for the telemetry series (obs registry)
 _OP_NAMES = {
     MSG_PULL: "pull", MSG_PUSH: "push", MSG_PRELOAD: "preload",
     MSG_SNAPSHOT: "snapshot", MSG_BEAT: "beat", MSG_STATS: "stats",
     MSG_FAREWELL: "farewell", MSG_UNROUTE: "unroute",
-    MSG_READMIT: "readmit",
+    MSG_READMIT: "readmit", MSG_ROUTE: "route", MSG_MIGRATE: "migrate",
+    MSG_EVICT: "evict", MSG_GRACE: "grace",
 }
 
 # One garbage length prefix must not make the server buffer gigabytes before
@@ -162,6 +183,7 @@ class ParamServerService:
         monitor=None,
         on_farewell=None,
         health=None,
+        route_provider=None,
     ):
         """``monitor``: optional HeartbeatMonitor; when given, MSG_BEAT
         frames drive it (workers heartbeat over their PS connection, the
@@ -172,10 +194,14 @@ class ParamServerService:
         departing worker's routes on every shard.  ``health``: an
         existing :class:`~lightctr_tpu.obs.health.HealthMonitor` to serve
         verdicts from (the master passes its own); None builds one for
-        this shard with an SSP-staleness detector wired to the store."""
+        this shard with an SSP-staleness detector wired to the store.
+        ``route_provider``: zero-arg callable returning the current
+        routing-table dict — the MASTER role passes its cluster map so
+        clients can poll ``MSG_ROUTE``; plain shards leave it None."""
         self.ps = ps
         self.monitor = monitor
         self.on_farewell = on_farewell
+        self.route_provider = route_provider
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         # the store's registry is where this shard's numbers live — make
@@ -324,6 +350,41 @@ class ParamServerService:
                                 stats["liveness"] = self.monitor.peek()
                             body = json.dumps(stats).encode()
                             send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_ROUTE:
+                            rp = self.route_provider
+                            table = rp() if rp is not None else {"epoch": -1}
+                            body = json.dumps(table).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_MIGRATE:
+                            hdr, hdr_len = wire.split_varint(payload, 1)
+                            epoch = int(hdr[0])
+                            keys, rows = _keys_and_rows(
+                                payload[hdr_len:], dim, np.float16
+                            )
+                            if len(keys) and not (np.diff(keys) > 0).all():
+                                raise ValueError(
+                                    "migrate keys must be sorted unique"
+                                )
+                            # apply + read back: the checksum certifies the
+                            # rows LANDED in this store (docs/ELASTICITY.md)
+                            back = self.ps.migrate_in(keys, rows)
+                            fnv = frame_checksum(wire.pack_rows(keys, back))
+                            body = json.dumps({
+                                "n": int(len(keys)), "fnv": fnv,
+                                "epoch": epoch,
+                            }).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
+                            if telem:
+                                reg.inc("ps_migrated_rows_total", len(keys))
+                        elif msg_type == MSG_EVICT:
+                            keys = wire.unpack_keys(payload)
+                            n = self.ps.evict_batch(keys)
+                            body = json.dumps({"evicted": int(n)}).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_GRACE:
+                            f = int(wire.unpack_varint(payload, 1)[0])
+                            self.ps.set_staleness_grace(f / 1000.0)
+                            send(struct.pack("<IB", 1, 0) + b"\x00")
                         elif msg_type == MSG_UNROUTE:
                             wid = int(wire.unpack_varint(payload, 1)[0])
                             self.ps.unroute_worker(wid)
@@ -412,28 +473,65 @@ class PSClient:
     Tracks ``bytes_sent``/``bytes_received`` so tests can assert the
     compaction is real."""
 
+    # one bounded reconnect per failed rpc, with exponential backoff +
+    # jitter between the failure and the retry: a single transient RST
+    # (peer restart, accept-queue overflow, conntrack flush) must look
+    # like latency, not like a dead shard — only EXHAUSTED retries reach
+    # ShardedPSClient._mark_down and the rebalance machinery above it
+    RECONNECT_ATTEMPTS = 1
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 1.0
+
     def __init__(self, address: Tuple[str, int], dim: int,
                  timeout: Optional[float] = None):
         """``timeout``: per-socket-op deadline in seconds (None = block
         forever).  Control-plane clients (the master's shard admins) set
         one so a wedged shard raises instead of stalling heartbeats."""
         self.dim = dim
-        self._sock = socket.create_connection(address, timeout=timeout)
-        if self._sock.getsockname() == self._sock.getpeername():
+        self.address = tuple(address)
+        self.timeout = timeout
+        self._sock = self._connect()
+        self.bytes_sent = 0
+        self.bytes_received = 0
+        self.withheld_pulls = 0
+        self.dropped_pushes = 0
+        self.reconnects = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self.address, timeout=self.timeout)
+        if sock.getsockname() == sock.getpeername():
             # Linux TCP self-connect: a connect() to a FREE port in the
             # ephemeral range can be assigned that same port as its source
             # and succeed against itself — observed when reconnecting to a
             # dead shard's old address; the "server" would then be this
             # client's own echo.  Treat it as the refusal it really is.
-            self._sock.close()
+            sock.close()
             raise ConnectionRefusedError(
-                f"self-connect to {address} (no listener)"
+                f"self-connect to {self.address} (no listener)"
             )
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self.bytes_sent = 0
-        self.bytes_received = 0
-        self.withheld_pulls = 0
-        self.dropped_pushes = 0
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    @classmethod
+    def _backoff_s(cls, attempt: int) -> float:
+        """Capped exponential backoff with full jitter (attempt 0 -> up to
+        BACKOFF_BASE_S): decorrelates a thundering herd of workers all
+        retrying the same restarted shard."""
+        return min(cls.BACKOFF_CAP_S, cls.BACKOFF_BASE_S * (2 ** attempt)) \
+            * random.random()
+
+    def reconnect(self) -> None:
+        """Tear down and re-dial the same address (the transport may have
+        died while the service lives on — or a fresh incarnation may be
+        serving on it)."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._connect()
+        self.reconnects += 1
+        if obs_gate.enabled():
+            default_registry().inc("ps_client_reconnects_total")
 
     def _send(self, msg_type: int, payload: bytes) -> None:
         """Fire a request without waiting for the reply (pipelining
@@ -460,8 +558,26 @@ class PSClient:
         return reply
 
     def _rpc(self, msg_type: int, payload: bytes) -> bytes:
-        self._send(msg_type, payload)
-        return self._recv_reply()
+        """Round-trip with bounded retry: a socket-level failure (RST,
+        timeout, peer restart) gets RECONNECT_ATTEMPTS reconnect+resend
+        cycles, each preceded by capped exponential backoff with jitter,
+        before the error propagates.  Retried requests are at-least-once:
+        a PUSH whose reply was lost may apply twice — the same lossy
+        async-push semantics the reference accepts (push.h:55-66)."""
+        try:
+            self._send(msg_type, payload)
+            return self._recv_reply()
+        except (ConnectionError, OSError) as first_err:
+            err = first_err
+            for attempt in range(self.RECONNECT_ATTEMPTS):
+                time.sleep(self._backoff_s(attempt))
+                try:
+                    self.reconnect()
+                    self._send(msg_type, payload)
+                    return self._recv_reply()
+                except (ConnectionError, OSError) as e:
+                    err = e
+            raise err
 
     def pull_arrays(
         self,
@@ -601,6 +717,63 @@ class PSClient:
             MSG_READMIT, wire.pack_varint(np.array([worker_id], np.int64))
         )
 
+    # -- elastic membership ops (docs/ELASTICITY.md) ------------------------
+
+    def route(self) -> Dict:
+        """Fetch the current routing table (master op).  A peer with no
+        route provider answers ``{"epoch": -1}`` — callers treat any
+        epoch below their own as 'no news'."""
+        return json.loads(self._rpc(MSG_ROUTE, b"").decode())
+
+    def migrate_rows(
+        self, keys: np.ndarray, rows: np.ndarray, epoch: int
+    ) -> Dict:
+        """Ship a sorted-unique (keys, rows) range to this shard as part
+        of an epoch's rebalance.  Returns the verification record::
+
+            {"n": rows landed, "fnv": dest read-back checksum,
+             "src_fnv": this side's frame checksum, "verified": bool}
+
+        ``verified`` means the destination re-read the rows from its
+        store and their lane-FNV matches the frame this side shipped —
+        zero row loss AND zero corruption, end to end."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        if len(keys_arr) > 1 and not (np.diff(keys_arr) > 0).all():
+            raise ValueError("migrate_rows keys must be sorted unique")
+        frame = wire.pack_rows(keys_arr, r)
+        src_fnv = frame_checksum(
+            # checksum what the destination will be able to reproduce:
+            # the fp16-coded frame round-trips losslessly through the
+            # store (fp16 -> fp32 -> fp16), so equal checksums == landed
+            frame
+        )
+        hdr = wire.pack_varint(np.array([int(epoch)], np.int64))
+        with obs_trace.span("ps_client/migrate", n_keys=int(keys_arr.size)):
+            reply = json.loads(self._rpc(MSG_MIGRATE, hdr + frame).decode())
+        reply["src_fnv"] = src_fnv
+        reply["verified"] = (
+            int(reply.get("n", -1)) == int(keys_arr.size)
+            and int(reply.get("fnv", -1)) == src_fnv
+        )
+        return reply
+
+    def evict(self, keys: np.ndarray) -> int:
+        """Drop keys from this shard's store (rows migrated away must not
+        survive as stale duplicates).  Returns how many were present."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        reply = json.loads(
+            self._rpc(MSG_EVICT, wire.pack_keys(keys_arr)).decode()
+        )
+        return int(reply.get("evicted", 0))
+
+    def grace(self, factor: float) -> None:
+        """Widen (factor > 1) or restore (factor == 1) the shard's SSP
+        staleness budget for the duration of a rebalance."""
+        self._rpc(MSG_GRACE, wire.pack_varint(
+            np.array([int(round(factor * 1000))], np.int64)
+        ))
+
     def close(self) -> None:
         try:
             _send_msg(self._sock, MSG_CLOSE, b"")
@@ -628,18 +801,47 @@ class ShardedPSClient:
     its OWN staleness ledger: a push may be dropped by one shard and
     applied by another (the return value is False if ANY shard dropped),
     and a pull withheld by any shard is retried whole.
+
+    ELASTIC MEMBERSHIP: routing is epoch-numbered (dist/elastic.py).  The
+    client holds one immutable :class:`RoutingTable`; every data op
+    snapshots it ONCE at entry, so an epoch swap (``apply_routing`` — the
+    master's rebalance publishing a new member set) lands atomically
+    BETWEEN batches: no pull/push ever splits one batch across two
+    epochs.  With a route source attached (``attach_route_source``), a
+    failed batch polls the master for a newer table before the caller's
+    retry, so shard death -> rebalance -> resume needs no restart.
     """
 
     def __init__(self, addresses, dim: int, partition: str = "modulo"):
         if not addresses:
             raise ValueError("need at least one PS shard address")
+        from .elastic import RoutingTable
+
         self.dim = dim
         self.addresses = [tuple(a) for a in addresses]
-        self.clients = [PSClient(a, dim) for a in self.addresses]
+        # a shard that is down at CLIENT construction must not abort it:
+        # a worker (re)starting mid-outage leaves the slot None — every
+        # data op attempts a reconnect per call (_ensure), same as a shard
+        # that dies later
+        self.clients = []
+        for a in self.addresses:
+            try:
+                self.clients.append(PSClient(a, dim))
+            except OSError:
+                self.clients.append(None)
         self.n_shards = len(self.clients)
-        from .partition import make_partition
-
-        self.partition = make_partition(partition, self.n_shards)
+        # epoch-numbered routing: every data op snapshots ONE (epoch,
+        # partition, members) view at entry and uses it for the whole
+        # batch — apply_routing swaps the snapshot atomically between
+        # batches, never inside one (the atomicity test_chaos.py asserts)
+        self._route_lock = threading.Lock()
+        self._route_source = None  # zero-arg callable -> table dict | None
+        self._apply_table_locked(RoutingTable(
+            epoch=0,
+            members=range(self.n_shards),
+            addresses={i: a for i, a in enumerate(self.addresses)},
+            partition=partition,
+        ))
         # shard-failure tolerance: a dead shard's client slot goes None and
         # every data op attempts one reconnect per call (the reference
         # worker likewise reconnects to a relaunched paramserver); counters
@@ -647,6 +849,116 @@ class ShardedPSClient:
         self.reconnects = 0
         self._base = {"bytes_sent": 0, "bytes_received": 0,
                       "withheld_pulls": 0, "dropped_pushes": 0}
+
+    # -- routing epochs (elastic membership, docs/ELASTICITY.md) ------------
+
+    def _apply_table_locked(self, table) -> None:
+        """Install a routing table (caller context: ctor or under
+        _route_lock).  Grows the shard-id-indexed address/client lists for
+        newly admitted shards; departed members keep their slots (ids are
+        stable forever) but leave the live set."""
+        self._table = table
+        self.partition = table.partition()
+        self.members = list(table.members)
+
+    def _route(self):
+        """The immutable routing snapshot a single batch operates under:
+        (epoch, members, partition).  One acquisition per data op — the
+        table object is never mutated in place, so using the captured
+        reference for the whole batch is race-free by construction."""
+        with self._route_lock:
+            return self._table, self.partition, self.members
+
+    @property
+    def routing(self):
+        """The current (immutable) RoutingTable — workers read its epoch
+        + worker list to derive their data-shard assignment."""
+        with self._route_lock:
+            return self._table
+
+    @property
+    def route_epoch(self) -> int:
+        with self._route_lock:
+            return self._table.epoch
+
+    @property
+    def rebalancing(self) -> bool:
+        with self._route_lock:
+            return self._table.rebalancing
+
+    def apply_routing(self, table) -> bool:
+        """Adopt a newer routing table (dict or RoutingTable).  Stale or
+        same-epoch tables are ignored (False) EXCEPT a same-epoch change
+        of the rebalancing flag, which is advisory and adopted in place.
+        New member addresses are dialed lazily on first use."""
+        from .elastic import RoutingTable
+
+        if isinstance(table, dict):
+            if int(table.get("epoch", -1)) < 0:
+                return False  # "no route provider" sentinel
+            table = RoutingTable.from_dict(table)
+        with self._route_lock:
+            if table.partition_name != self._table.partition_name:
+                # a policy swap would re-home ~the whole keyspace under
+                # rows placed by the OLD policy — silent loss far beyond
+                # any membership change.  This is a deployment
+                # misconfiguration (client and master must agree);
+                # refuse loudly and keep serving under the local policy.
+                logging.getLogger(__name__).error(
+                    "refusing routing table at epoch %d: partition policy "
+                    "%r != client's %r (client/master misconfiguration)",
+                    table.epoch, table.partition_name,
+                    self._table.partition_name,
+                )
+                return False
+            if table.epoch < self._table.epoch:
+                return False
+            if (table.epoch == self._table.epoch
+                    and table.rebalancing == self._table.rebalancing):
+                return False
+            for sid in table.members:
+                while len(self.addresses) <= sid:
+                    self.addresses.append(None)
+                    self.clients.append(None)
+                addr = tuple(table.addresses[sid])
+                if self.addresses[sid] != addr:
+                    # new shard, or a shard re-homed to a new address:
+                    # drop the stale transport, dial lazily on first use
+                    old = self.clients[sid]
+                    if old is not None:
+                        for k in self._base:
+                            self._base[k] += getattr(old, k)
+                        try:
+                            old.close()
+                        except OSError:
+                            pass
+                    self.addresses[sid] = addr
+                    self.clients[sid] = None
+            self.n_shards = len(self.addresses)
+            self._apply_table_locked(table)
+        return True
+
+    def attach_route_source(self, source) -> None:
+        """``source`` is a zero-arg callable returning the latest routing
+        table dict (or None/raising when the master is unreachable) —
+        typically ``master_client.route``.  ``refresh_route`` polls it;
+        data ops do so automatically after a failed batch, so a rebalance
+        is adopted without restart the moment the master publishes it."""
+        self._route_source = source
+
+    def refresh_route(self) -> bool:
+        """Poll the route source once; adopt the table if it is newer.
+        Never raises (an unreachable master is a retry-later)."""
+        src = self._route_source
+        if src is None:
+            return False
+        try:
+            table = src()
+        except (ConnectionError, OSError, RuntimeError, ValueError):
+            return False
+        if not table:
+            return False
+        return self.apply_routing(table)
 
     # -- shard liveness -----------------------------------------------------
 
@@ -665,12 +977,32 @@ class ShardedPSClient:
         """Client for shard i, attempting one reconnect if it is down.
         Returns None while the shard stays unreachable."""
         if self.clients[i] is None:
+            if self.addresses[i] is None:
+                return None
             try:
                 self.clients[i] = PSClient(self.addresses[i], self.dim)
                 self.reconnects += 1
             except OSError:
                 return None
         return self.clients[i]
+
+    def _retry_shard(self, i: int, send_fn):
+        """One reconnect + resend for shard ``i`` after a socket-level
+        failure (PSClient._backoff_s jitter applied): a transient RST must
+        cost one retry, not a _mark_down — only when the retry ALSO fails
+        does the shard get declared down (and the caller's rebalance
+        machinery above it get a say).  Returns the live client or None."""
+        self._mark_down(i)
+        time.sleep(PSClient._backoff_s(0))
+        c = self._ensure(i)
+        if c is None:
+            return None
+        try:
+            send_fn(c)
+            return c
+        except (ConnectionError, OSError):
+            self._mark_down(i)
+            return None
 
     # -- accounting (aggregated over shards) --------------------------------
 
@@ -695,19 +1027,23 @@ class ShardedPSClient:
     def dropped_pushes(self) -> int:
         return self._sum("dropped_pushes")
 
-    def _split(self, keys: np.ndarray):
+    def _split(self, keys: np.ndarray, partition=None, members=None):
         """shard id per key (partition policy: modulo or consistent-hash
-        ring) + the per-shard sorted key arrays (sorted input stays sorted
-        within each shard) + scatter indices to merge replies back into
-        request order."""
-        shard = self.partition.shard_of(keys)
-        order = []
-        parts = []
-        for s in range(self.n_shards):
+        ring, over the LIVE members of one routing epoch) + the per-shard
+        sorted key arrays (sorted input stays sorted within each shard) +
+        scatter indices to merge replies back into request order.
+        Returns [(shard_id, keys, idx)] for non-empty destinations.
+        ``partition``/``members`` come from ONE _route() snapshot so a
+        concurrent epoch swap cannot split the batch across epochs."""
+        if partition is None:
+            _, partition, members = self._route()
+        shard = partition.shard_of(keys)
+        out = []
+        for s in members:
             idx = np.flatnonzero(shard == s)
-            order.append(idx)
-            parts.append(keys[idx])
-        return parts, order
+            if idx.size:
+                out.append((s, keys[idx], idx))
+        return out
 
     @staticmethod
     def _check_sorted(keys_arr: np.ndarray, *, unique: bool, op: str) -> None:
@@ -744,7 +1080,10 @@ class ShardedPSClient:
     def pull_arrays(self, keys, worker_epoch, worker_id=None):
         keys_arr = np.ascontiguousarray(keys, np.int64)
         self._check_sorted(keys_arr, unique=False, op="pull_arrays")
-        parts, order = self._split(keys_arr)
+        # ONE routing snapshot for the whole batch: the epoch the reply
+        # is merged under is the epoch every sub-request was split under
+        table, partition, members = self._route()
+        parts = self._split(keys_arr, partition, members)
         hdr = wire.pack_varint(np.array(
             [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
             np.int64,
@@ -754,13 +1093,25 @@ class ShardedPSClient:
         rows = np.empty((len(keys_arr), self.dim), np.float32)
 
         def handle(item):
-            i, c, idx = item
+            i, c, idx, msg = item
             try:
                 reply = c._recv_reply()
             except (ConnectionError, OSError):
-                self._mark_down(i)  # died between send and reply
-                state["failed"] = True
-                return
+                # died between send and reply.  After an RST the first
+                # send usually lands in the kernel buffer and the failure
+                # only surfaces HERE — so the transient-blip retry must
+                # cover this side too.  Pulls are idempotent: reconnect,
+                # resend this shard's sub-request, read once.
+                c = self._retry_shard(i, lambda cc: cc._send(MSG_PULL, msg))
+                if c is None:
+                    state["failed"] = True
+                    return
+                try:
+                    reply = c._recv_reply()
+                except (ConnectionError, OSError):
+                    self._mark_down(i)
+                    state["failed"] = True
+                    return
             if reply[:1] == b"\x01":
                 # any shard withholding means the whole pull retries — the
                 # reference worker likewise blocks until every PS replies
@@ -773,23 +1124,35 @@ class ShardedPSClient:
         # one span covers the whole fan-out: every per-shard _send fires
         # inside it, so each shard's server span is this span's child
         with obs_trace.span("ps_client/pull", n_keys=int(keys_arr.size),
-                            shards=self.n_shards):
-            for i, (part, idx) in enumerate(zip(parts, order)):
-                if not len(part):
-                    continue
+                            shards=len(members), epoch=table.epoch):
+            for i, part, idx in parts:
                 c = self._ensure(i)
                 if c is None:
                     # shard down: same retry contract as a withheld pull —
                     # the caller backs off and retries until it returns
                     state["failed"] = True
                     continue
+                msg = hdr + wire.pack_keys(part)
                 try:
-                    c._send(MSG_PULL, hdr + wire.pack_keys(part))
-                    live.append((i, c, idx))
+                    c._send(MSG_PULL, msg)
                 except (ConnectionError, OSError):
-                    self._mark_down(i)
-                    state["failed"] = True
+                    # transient-RST tolerance: one reconnect+resend before
+                    # the shard is declared down (satellite: a blip must
+                    # not trigger a rebalance)
+                    c = self._retry_shard(i, lambda cc: cc._send(
+                        MSG_PULL, msg))
+                    if c is None:
+                        state["failed"] = True
+                        continue
+                live.append((i, c, idx, msg))
             self._drain(live, handle)
+        if state["failed"]:
+            # a shard died or the route is mid-rebalance: adopt a newer
+            # epoch if the master published one, so the caller's retry
+            # re-splits instead of hammering the dead address.  Withheld
+            # (SSP backpressure) is NOT a membership signal — polling the
+            # master once per stall retry would hammer its admin plane.
+            self.refresh_route()
         if state["withheld"] or state["failed"]:
             return None
         return keys_arr, rows
@@ -798,7 +1161,8 @@ class ShardedPSClient:
         keys_arr = np.ascontiguousarray(keys, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
         self._check_sorted(keys_arr, unique=True, op="push_arrays")
-        parts, order = self._split(keys_arr)
+        table, partition, members = self._route()
+        parts = self._split(keys_arr, partition, members)
         hdr = wire.pack_varint(np.array([worker_id, worker_epoch], np.int64))
         live = []
         state = {"ok": True}
@@ -818,23 +1182,29 @@ class ShardedPSClient:
                 # semantics match the reference's lossy async pushes
 
         with obs_trace.span("ps_client/push", n_keys=int(keys_arr.size),
-                            shards=self.n_shards):
-            for i, (part, idx) in enumerate(zip(parts, order)):
-                if not len(part):
-                    continue
+                            shards=len(members), epoch=table.epoch):
+            for i, part, idx in parts:
                 c = self._ensure(i)
                 if c is None:
                     # shard down: that slice of the push is lost — the
                     # reference's async pushes are likewise lossy
                     state["ok"] = False
                     continue
+                msg = hdr + wire.pack_rows(part, r[idx])
                 try:
-                    c._send(MSG_PUSH, hdr + wire.pack_rows(part, r[idx]))
-                    live.append((i, c))
+                    c._send(MSG_PUSH, msg)
                 except (ConnectionError, OSError):
-                    self._mark_down(i)
-                    state["ok"] = False
+                    # send never reached the server: resending after one
+                    # reconnect cannot double-apply
+                    c = self._retry_shard(i, lambda cc: cc._send(
+                        MSG_PUSH, msg))
+                    if c is None:
+                        state["ok"] = False
+                        continue
+                live.append((i, c))
             self._drain(live, handle)
+        if not state["ok"]:
+            self.refresh_route()
         return state["ok"]
 
     def preload_arrays(self, keys, rows) -> None:
@@ -843,12 +1213,10 @@ class ShardedPSClient:
         keys_arr = np.ascontiguousarray(keys, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
         self._check_sorted(keys_arr, unique=True, op="preload_arrays")
-        parts, order = self._split(keys_arr)
+        parts = self._split(keys_arr)
         live = []
         err = None
-        for i, (part, idx) in enumerate(zip(parts, order)):
-            if not len(part):
-                continue
+        for i, part, idx in parts:
             c = self._ensure(i)
             if c is None:
                 err = err or ConnectionError(
@@ -892,7 +1260,7 @@ class ShardedPSClient:
 
     def snapshot_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
         keys_parts, rows_parts = [], []
-        for i in range(self.n_shards):
+        for i in self._route()[2]:
             k, r = self.snapshot_shard(i)
             keys_parts.append(k)
             rows_parts.append(r)
@@ -903,9 +1271,9 @@ class ShardedPSClient:
         return keys[order], rows[order]
 
     def _best_effort(self, fn) -> None:
-        """Run a liveness/courtesy op against every reachable shard,
-        marking unreachable ones down instead of raising."""
-        for i in range(self.n_shards):
+        """Run a liveness/courtesy op against every reachable LIVE-member
+        shard, marking unreachable ones down instead of raising."""
+        for i in self._route()[2]:
             c = self._ensure(i)
             if c is None:
                 continue
@@ -925,7 +1293,7 @@ class ShardedPSClient:
         so aggregators can count unreachable shards instead of treating
         them as zero traffic."""
         out = []
-        for i in range(self.n_shards):
+        for i in self._route()[2]:
             addr = list(self.addresses[i])
             c = self._ensure(i)
             if c is None:
@@ -964,7 +1332,7 @@ class ShardedPSClient:
             statuses.append(entry["status"])
             shards.append(entry)
         status = obs_health.worst(statuses)
-        if down and down == self.n_shards:
+        if down and down == len(statuses):
             status = obs_health.UNHEALTHY
         return {"status": status, "down_shards": down, "shards": shards}
 

@@ -169,6 +169,12 @@ class AsyncParamServer:
         self._unrouted: set = set()
         self.rejected_pushes = 0
         self.rejected_pulls = 0
+        # elastic-rebalance grace: while a row migration is in flight the
+        # SSP budget runs widened (workers stall on dead-shard retries, so
+        # honest drift grows without anything being wrong) — the BASE
+        # threshold is kept so the budget snaps back when the grace ends
+        self._base_staleness_threshold = staleness_threshold
+        self.evicted_keys = 0
 
     # -- storage -----------------------------------------------------------
 
@@ -569,6 +575,66 @@ class AsyncParamServer:
         with self._lock:
             self._unrouted.discard(int(worker_id))
 
+    # -- elastic membership (rebalance support) -----------------------------
+
+    def set_staleness_grace(self, factor: float) -> None:
+        """Widen (or restore) the SSP staleness budget for the duration of
+        a rebalance: ``factor`` scales the BASE threshold (1.0 restores
+        it).  The widened budget is fed to the health plane's existing
+        staleness detector too — its SLO tracks the effective threshold,
+        so an in-flight rebalance reads as a grace window, not a false
+        staleness alarm (docs/ELASTICITY.md)."""
+        if factor < 1.0:
+            raise ValueError("grace factor must be >= 1.0")
+        with self._lock:
+            self.staleness_threshold = int(
+                round(self._base_staleness_threshold * factor)
+            )
+            eff = self.staleness_threshold
+        hm = self.health
+        if hm is not None:
+            # retune the existing detector instead of stacking a new one
+            det = hm.detector("staleness")
+            if det is not None:
+                det.slo = float(eff)
+        if obs_gate.enabled():
+            self.registry.gauge_set("ps_store_staleness_budget", eff)
+
+    def migrate_in(self, keys: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Apply migrated rows (preload semantics: overwrite, reset
+        accum/shadow — optimizer state does not survive a membership
+        change, the row values do) and return the rows RE-READ from the
+        store.  The read-back is what the migration protocol checksums:
+        a matching FNV certifies the rows landed in this store, not
+        merely that the bytes arrived."""
+        self.preload_batch(keys, rows)
+        with self._lock:
+            slots = self._dict_slots(np.ascontiguousarray(keys, np.int64))
+            return self._W[slots].copy()
+
+    def evict_batch(self, keys: np.ndarray) -> int:
+        """Remove keys from the store (rows migrated AWAY during a
+        rebalance must not survive as stale duplicates — a later epoch
+        migrating them back would resurrect pre-migration values).
+        Returns how many of ``keys`` were present.  Slots are NOT
+        recycled (slot immutability is what keeps concurrent readers of
+        the sorted lookup snapshot safe); the snapshot itself is
+        invalidated, because its contract is "every key it contains is
+        live" and these no longer are."""
+        with self._lock:
+            keys_arr = np.ascontiguousarray(keys, np.int64)
+            n = 0
+            for k in keys_arr.tolist():
+                if self._slot.pop(k, None) is not None:
+                    n += 1
+            if n:
+                self._key_cache = None
+                self._pending = []
+                self.evicted_keys += n
+        if n and obs_gate.enabled():
+            self.registry.inc("ps_store_evicted_keys_total", n)
+        return n
+
     def attach_heartbeat(self, monitor) -> None:
         """Wire a :class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` so
         its death/recovery events drive routing: dead -> unroute, returning
@@ -646,7 +712,9 @@ class AsyncParamServer:
                 "unrouted": sorted(self._unrouted),
                 "last_epoch_version": self.last_epoch_version,
                 "staleness": self.staleness,
-                "n_keys": self._n,
+                "staleness_budget": self.staleness_threshold,
+                "evicted_keys": self.evicted_keys,
+                "n_keys": len(self._slot),
                 # sorted-lookup snapshot health (async_ps._alloc_slots):
                 "pending_depth": len(self._pending),
                 "key_cache_drift": (

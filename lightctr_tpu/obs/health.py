@@ -457,6 +457,15 @@ class HealthMonitor:
             return st.det
         return self.add_detector(det, **kw)
 
+    def detector(self, name: str) -> Optional[Detector]:
+        """The installed detector with that name, or None — services that
+        retune a detector in place (e.g. the SSP staleness SLO widening
+        with the store's rebalance grace window) reach it here instead of
+        poking monitor internals."""
+        with self._lock:
+            st = self._states.get(str(name))
+        return st.det if st is not None else None
+
     def wants(self, *signals: str) -> bool:
         """True when any installed detector consumes one of ``signals`` —
         producers check this before building an expensive signal."""

@@ -1,0 +1,122 @@
+"""Crash-safe checkpointing: tmp+fsync+atomic-rename saves, torn-state
+tolerance in readers and GC, and the PS-shard row-snapshot pair the
+elastic rebalance migrates from (docs/ELASTICITY.md).
+
+The writer here may be SIGKILLed at any byte (the chaos harness does
+exactly that), so the contract is: a reader NEVER trusts a torn artifact
+and NEVER crashes on one."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.ckpt import checkpoint as ck
+
+
+# -- row snapshots (the migration source) ------------------------------------
+
+
+def test_save_arrays_round_trips_and_is_atomic(tmp_path, rng):
+    d = str(tmp_path)
+    keys = np.arange(50, dtype=np.int64)
+    rows = rng.normal(size=(50, 3)).astype(np.float32)
+    path = ck.save_arrays(d, 7, keys, rows)
+    assert os.path.basename(path) == "rows_7.npz"
+    # no tmp turd survives a completed save
+    assert not [f for f in os.listdir(d) if ".tmp-" in f]
+    step, k, r = ck.load_latest_arrays(d)
+    assert step == 7
+    np.testing.assert_array_equal(k, keys)
+    np.testing.assert_array_equal(r, rows)
+    with pytest.raises(ValueError):
+        ck.save_arrays(d, 8, keys, rows[:10])  # length mismatch fails loud
+
+
+def test_load_latest_arrays_skips_torn_snapshots(tmp_path, rng):
+    d = str(tmp_path)
+    ck.save_arrays(d, 1, np.arange(5, dtype=np.int64),
+                   rng.normal(size=(5, 2)).astype(np.float32))
+    # a newer but TORN snapshot (writer killed mid-write on a filesystem
+    # without atomic rename, or a stray partial copy)
+    with open(os.path.join(d, "rows_2.npz"), "wb") as f:
+        f.write(b"PK\x03\x04 definitely not a full zip")
+    step, k, _ = ck.load_latest_arrays(d)
+    assert step == 1 and len(k) == 5  # fell back to the intact one
+    assert ck.load_latest_arrays(str(tmp_path / "nope")) is None
+
+
+def test_gc_array_snapshots_keeps_newest_and_sweeps_turds(tmp_path, rng):
+    d = str(tmp_path)
+    for s in range(5):
+        ck.save_arrays(d, s, np.arange(3, dtype=np.int64),
+                       np.zeros((3, 2), np.float32))
+    open(os.path.join(d, ".rows_9.tmp-123.npz"), "wb").close()
+    ck.gc_array_snapshots(d, keep=2)
+    left = sorted(f for f in os.listdir(d))
+    assert left == ["rows_3.npz", "rows_4.npz"]
+
+
+# -- pytree checkpoints ------------------------------------------------------
+
+
+def test_npz_fallback_save_is_staged_then_renamed(tmp_path, monkeypatch):
+    """The non-Orbax path must stage into a tmp dir and rename: a reader
+    listing the directory mid-save sees either nothing or a complete
+    step_N, never a half-written one."""
+    monkeypatch.setattr(ck, "_HAVE_ORBAX", False)
+    d = str(tmp_path)
+    state = {"w": np.arange(6.0), "b": np.float32(2.0)}
+    path = ck.save(d, 3, state)
+    assert os.path.isdir(path)
+    assert sorted(os.listdir(path)) == ["state.npz", "treedef.txt"]
+    assert not [f for f in os.listdir(d) if ".tmp-" in f]
+    out = ck.restore(d, like=state)
+    np.testing.assert_array_equal(out["w"], state["w"])
+    # overwrite of an existing step (save force semantics) still works
+    ck.save(d, 3, {"w": np.zeros(6), "b": np.float32(0.0)})
+    out = ck.restore(d, step=3, like=state)
+    assert float(out["b"]) == 0.0
+
+
+def test_latest_step_and_restore_ignore_torn_directories(tmp_path,
+                                                         monkeypatch):
+    monkeypatch.setattr(ck, "_HAVE_ORBAX", False)
+    d = str(tmp_path)
+    state = {"w": np.arange(4.0)}
+    ck.save(d, 1, state)
+    ck.save(d, 2, state)
+    os.makedirs(os.path.join(d, "step_9"))  # torn: empty (mkdir then kill)
+    # tmp-style dirs never parse as steps at all
+    os.makedirs(os.path.join(d, "step_5.orbax-checkpoint-tmp-42"))
+    assert ck.latest_step(d) == 2
+    out = ck.restore(d, like=state)  # picks 2, not the torn 9
+    np.testing.assert_array_equal(out["w"], state["w"])
+    assert ck.latest_step(str(tmp_path / "missing")) is None
+
+
+def test_checkpointer_gc_ignores_torn_dirs_and_keeps_retention(
+        tmp_path, monkeypatch):
+    """_gc must neither crash on torn/partial directories nor delete them
+    (a live sibling writer may still be committing), and torn dirs must
+    not consume retention slots."""
+    monkeypatch.setattr(ck, "_HAVE_ORBAX", False)
+    d = str(tmp_path)
+    c = ck.Checkpointer(d, keep=2, every=1)
+    state = {"w": np.arange(3.0)}
+    for s in (1, 2, 3):
+        c.maybe_save(s, state)
+    os.makedirs(os.path.join(d, "step_9"), exist_ok=True)  # torn
+    # staging turd from a provably-dead writer pid: reaped; one from a
+    # LIVE pid (ours): kept — its writer may still be committing
+    os.makedirs(os.path.join(d, ".step_7.tmp-999999999"))
+    os.makedirs(os.path.join(d, f".step_8.tmp-{os.getpid()}"))
+    c._gc()
+    kept = sorted(x for x in os.listdir(d) if x.startswith("step_"))
+    assert kept == ["step_2", "step_3", "step_9"]  # torn ignored, not reaped
+    assert not os.path.isdir(os.path.join(d, ".step_7.tmp-999999999"))
+    assert os.path.isdir(os.path.join(d, f".step_8.tmp-{os.getpid()}"))
+    out = c.restore_latest(like=state)
+    np.testing.assert_array_equal(out["w"], state["w"])
+    # a Checkpointer pointed at a directory that vanished must not crash
+    ck.Checkpointer(str(tmp_path / "gone"), keep=1)._gc()

@@ -61,6 +61,86 @@ def test_make_partition_rejects_unknown():
         make_partition("rendezvous", 4)
 
 
+# -- elastic-rebalance properties (the guarantees row migration rides on) ----
+
+
+def test_ring_member_removal_moves_only_the_dead_shards_keys():
+    """Removing one member re-homes EXACTLY that member's keys (onto the
+    survivors), ~1/n of the keyspace — the bound on how many rows a drop
+    rebalance must migrate."""
+    keys = np.arange(100_000, dtype=np.int64)
+    full = RingPartition(4)
+    shrunk = RingPartition(members=[0, 1, 3])  # shard 2 died
+    old = full.shard_of(keys)
+    new = shrunk.shard_of(keys)
+    moved = old != new
+    # only the dead shard's keys moved, and ALL of them did
+    np.testing.assert_array_equal(moved, old == 2)
+    assert set(np.unique(new[moved])) <= {0, 1, 3}
+    frac = moved.mean()
+    assert 0.02 < frac < 0.6, frac  # ~1/4 in expectation, 5-vnode variance
+
+
+def test_ring_membership_subset_equals_full_ring_minus_member():
+    """The property the epoch protocol relies on: a ring built over live
+    members {0,2} IS the 3-shard ring with shard 1's arcs absorbed — so
+    master and every worker agree on placement from the member list alone,
+    with no migration history needed."""
+    keys = np.arange(50_000, dtype=np.int64)
+    sub = RingPartition(members=[0, 2]).shard_of(keys)
+    full = RingPartition(3).shard_of(keys)
+    kept = full != 1
+    np.testing.assert_array_equal(sub[kept], full[kept])
+    assert set(np.unique(sub[~kept])) <= {0, 2}
+
+
+def test_ring_mapping_is_deterministic_across_processes():
+    """Every process derives the same placement from the same member list
+    (no shared state, no RNG): a worker computing its split in one process
+    must agree with the master's migration plan in another."""
+    import json
+    import subprocess
+    import sys
+
+    prog = (
+        "import numpy as np, json, sys; "
+        "from lightctr_tpu.dist.partition import RingPartition; "
+        "p = RingPartition(members=[0, 2, 5], vnodes=7); "
+        "s = p.shard_of(np.arange(20000, dtype=np.int64)); "
+        "print(json.dumps([int(x) for x in np.bincount(s, minlength=6)])"
+        " + '|' + hex(int(np.bitwise_xor.reduce(s * "
+        "np.arange(1, 20001, dtype=np.int64)))))"
+    )
+    outs = set()
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", prog],
+                           capture_output=True, text=True, check=True)
+        outs.add(r.stdout.strip())
+    assert len(outs) == 1  # distinct interpreters, identical placement
+    here = RingPartition(members=[0, 2, 5], vnodes=7).shard_of(
+        np.arange(20000, dtype=np.int64))
+    counts = json.loads(outs.pop().split("|")[0])
+    assert counts == [int(x) for x in np.bincount(here, minlength=6)]
+
+
+def test_ring_vnode_count_bounds_imbalance():
+    """More vnodes -> tighter balance: the max/ideal share ratio shrinks
+    monotonically-ish with vnode count, and at 64 vnodes stays within 2x
+    ideal for 4 shards — the knob that bounds per-shard load (and
+    migration volume) after a membership change."""
+    keys = np.arange(200_000, dtype=np.int64)
+
+    def max_share(vnodes):
+        s = RingPartition(4, vnodes=vnodes).shard_of(keys)
+        return np.bincount(s, minlength=4).max() / len(keys)
+
+    coarse, mid, fine = max_share(1), max_share(8), max_share(64)
+    ideal = 1.0 / 4
+    assert fine < coarse  # more vnodes, less imbalance
+    assert fine < 2.0 * ideal, fine
+    assert mid < 3.0 * ideal, mid
+
+
 def test_sharded_client_ring_partition_matches_single_store(rng):
     """2-shard ring-partitioned deployment == one store, same contract the
     modulo test asserts (per-key updater math is shard-independent)."""
