@@ -82,6 +82,15 @@ MSG_ROUTE = 11
 MSG_MIGRATE = 12
 MSG_EVICT = 13
 MSG_GRACE = 14
+# serving-plane ops (lightctr_tpu/serve, docs/SERVING.md) — dispatched by
+# the PredictionServer, which shares this module's framing/trace machinery
+# (a ParamServerService receiving one replies with the protocol-error
+# byte, same as any op it does not serve):
+#   PREDICT       -> wire.pack_predict_batch frame with B == 1; reply
+#                    status 0x00 ++ fp16 scores, or 0x02 = overloaded/shed
+#   PREDICT_BATCH -> same frame, any B (client-side batching)
+MSG_PREDICT = 15
+MSG_PREDICT_BATCH = 16
 
 # wire-op names for the telemetry series (obs registry)
 _OP_NAMES = {
@@ -89,7 +98,8 @@ _OP_NAMES = {
     MSG_SNAPSHOT: "snapshot", MSG_BEAT: "beat", MSG_STATS: "stats",
     MSG_FAREWELL: "farewell", MSG_UNROUTE: "unroute",
     MSG_READMIT: "readmit", MSG_ROUTE: "route", MSG_MIGRATE: "migrate",
-    MSG_EVICT: "evict", MSG_GRACE: "grace",
+    MSG_EVICT: "evict", MSG_GRACE: "grace", MSG_PREDICT: "predict",
+    MSG_PREDICT_BATCH: "predict_batch",
 }
 
 # One garbage length prefix must not make the server buffer gigabytes before
@@ -277,12 +287,19 @@ class ParamServerService:
                     with span_cm:
                         if msg_type == MSG_PULL:
                             hdr, hdr_len = wire.split_varint(payload, 2)
+                            # hdr[0]: worker_id + 1 (0 = anonymous), or -1
+                            # = anonymous READ-ONLY (the serving plane's
+                            # pulls — unknown keys must not allocate).  An
+                            # old server reading -1 takes this same branch
+                            # with wid=-2 -> anonymous create, today's
+                            # behavior: peers degrade, never misparse.
                             wid = int(hdr[0]) - 1
                             epoch = int(hdr[1])
                             keys = wire.unpack_keys(payload[hdr_len:])
                             rows = self.ps.pull_batch(
                                 keys, worker_epoch=epoch,
                                 worker_id=None if wid < 0 else wid,
+                                create=int(hdr[0]) != -1,
                             )
                             if rows is None:
                                 send(struct.pack("<IB", 1, 0) + b"\x01")
@@ -584,12 +601,21 @@ class PSClient:
         keys: np.ndarray,
         worker_epoch: int,
         worker_id: Optional[int] = None,
+        create: bool = True,
     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
         """Vectorized pull -> (sorted keys, [n, dim] fp32 rows in that
         order), or None when SSP-withheld/unrouted.  The hot path: no
-        per-key Python on either side of the wire."""
+        per-key Python on either side of the wire.  ``create=False`` is
+        the read-only serving form: unknown keys come back as zero rows
+        and allocate nothing server-side (header value -1; an old server
+        treats it as a plain anonymous pull — degrades, never misparses).
+        """
+        if not create and worker_id is not None:
+            raise ValueError("read-only pulls are anonymous (worker_id None)")
         hdr = wire.pack_varint(np.array(
-            [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
+            [-1 if not create
+             else (worker_id if worker_id is not None else -1) + 1,
+             worker_epoch],
             np.int64,
         ))
         keys_arr = np.ascontiguousarray(keys, np.int64)
@@ -1077,15 +1103,19 @@ class ShardedPSClient:
         if err is not None:
             raise err
 
-    def pull_arrays(self, keys, worker_epoch, worker_id=None):
+    def pull_arrays(self, keys, worker_epoch, worker_id=None, create=True):
         keys_arr = np.ascontiguousarray(keys, np.int64)
         self._check_sorted(keys_arr, unique=False, op="pull_arrays")
+        if not create and worker_id is not None:
+            raise ValueError("read-only pulls are anonymous (worker_id None)")
         # ONE routing snapshot for the whole batch: the epoch the reply
         # is merged under is the epoch every sub-request was split under
         table, partition, members = self._route()
         parts = self._split(keys_arr, partition, members)
         hdr = wire.pack_varint(np.array(
-            [(worker_id if worker_id is not None else -1) + 1, worker_epoch],
+            [-1 if not create
+             else (worker_id if worker_id is not None else -1) + 1,
+             worker_epoch],
             np.int64,
         ))
         live = []

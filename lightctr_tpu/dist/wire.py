@@ -181,3 +181,77 @@ def unpack_rows(buf: bytes, dim: int) -> Tuple[np.ndarray, np.ndarray, int]:
     rows = unpack_values(buf[consumed:consumed + 2 * n_vals],
                          (len(keys), int(dim)))
     return keys, rows, consumed + 2 * n_vals
+
+
+# -- prediction frames (serving plane, lightctr_tpu/serve) -------------------
+#
+# A predict request carries the CTR sparse-batch layout the models consume
+# (``fids``/``vals`` and, for the field-representative family, ``rep_fids``/
+# ``rep_mask``).  The id streams ride the zigzag varint codec UNSORTED (row
+# order is the payload's meaning, so no delta trick applies) and the float
+# payloads ride the same fp16 value codec as PS rows — the reference's
+# serving numerics (paramserver.h:161-163 applies fp16 to every PS value,
+# trained and served alike).  ``vals`` must arrive pre-masked
+# (``vals * mask``): every model's logits path multiplies them anyway, so
+# the mask carries no extra information the wire needs to pay for.
+
+
+def pack_predict_batch(arrays: dict) -> bytes:
+    """{"fids" [B, P] int, "vals" [B, P] f32, optional "rep_fids" [B, Fl]
+    int + "rep_mask" [B, Fl] f32} -> one self-describing predict frame:
+    ``varint([B, P, Fl])`` then the varint fid stream, fp16 vals, and (when
+    ``Fl > 0``) the varint rep_fid stream + fp16 rep_mask."""
+    fids = np.asarray(arrays["fids"], np.int64)
+    vals = np.asarray(arrays["vals"], np.float32)
+    if fids.ndim != 2 or vals.shape != fids.shape:
+        raise ValueError(
+            f"predict frame needs matching [B, P] fids/vals, got "
+            f"{fids.shape} / {vals.shape}"
+        )
+    rep = arrays.get("rep_fids")
+    fl = 0 if rep is None else int(np.asarray(rep).shape[1])
+    out = pack_varint(np.array([fids.shape[0], fids.shape[1], fl], np.int64))
+    out += pack_varint(fids.reshape(-1)) + pack_values(vals)[0]
+    if fl:
+        rep_arr = np.asarray(rep, np.int64)
+        rep_mask = np.asarray(arrays["rep_mask"], np.float32)
+        if rep_arr.shape != (fids.shape[0], fl) or \
+                rep_mask.shape != rep_arr.shape:
+            raise ValueError("rep_fids/rep_mask must be [B, Fl] and match")
+        out += pack_varint(rep_arr.reshape(-1)) + pack_values(rep_mask)[0]
+    return out
+
+
+def unpack_predict_batch(buf: bytes) -> Tuple[dict, int]:
+    """Inverse of :func:`pack_predict_batch` -> (arrays, bytes consumed).
+    The decoded dict is model-ready: ``mask`` is reconstructed as ones
+    (``vals`` arrive pre-masked, see above) and ids are int32."""
+    hdr, pos = split_varint(buf, 3)
+    b, p, fl = (int(x) for x in hdr)
+    if b < 0 or p < 0 or fl < 0:
+        raise ValueError(f"negative predict frame dims {(b, p, fl)}")
+    # bound the claimed dims against the bytes actually present BEFORE
+    # allocating decode buffers (a varint is >= 1 byte and an fp16 value
+    # is 2): a 20-byte frame claiming b*p = 2^62 must fail loud here, not
+    # reach np.empty
+    if b * p > len(buf) or b * fl > len(buf):
+        raise ValueError(
+            f"predict frame dims {(b, p, fl)} exceed the "
+            f"{len(buf)}-byte payload"
+        )
+    fids, used = split_varint(buf[pos:], b * p)
+    pos += used
+    vals = unpack_values(buf[pos:pos + 2 * b * p], (b, p))
+    pos += 2 * b * p
+    arrays = {
+        "fids": fids.reshape(b, p).astype(np.int32),
+        "vals": vals,
+        "mask": np.ones((b, p), np.float32),
+    }
+    if fl:
+        rep, used = split_varint(buf[pos:], b * fl)
+        pos += used
+        arrays["rep_fids"] = rep.reshape(b, fl).astype(np.int32)
+        arrays["rep_mask"] = unpack_values(buf[pos:pos + 2 * b * fl], (b, fl))
+        pos += 2 * b * fl
+    return arrays, pos

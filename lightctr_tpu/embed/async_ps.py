@@ -175,6 +175,12 @@ class AsyncParamServer:
         # threshold is kept so the budget snaps back when the grace ends
         self._base_staleness_threshold = staleness_threshold
         self.evicted_keys = 0
+        # monotonic WRITE version: bumped by every mutation of row values
+        # (push/preload/migrate/evict).  The serving plane's hot-embedding
+        # cache reads it over MSG_STATS and drops cached rows when it
+        # moves — versioned invalidation with bounded staleness
+        # (docs/SERVING.md), no per-row timestamps on the hot path
+        self.write_version = 0
 
     # -- storage -----------------------------------------------------------
 
@@ -387,14 +393,21 @@ class AsyncParamServer:
         keys: np.ndarray,
         worker_epoch: int,
         worker_id: Optional[int] = None,
+        create: bool = True,
     ) -> Optional[np.ndarray]:
         """Vectorized pull: ``[n, dim]`` rows in ``keys`` order (a fresh
-        copy), or None when withheld/unrouted.  The network PS hot path."""
+        copy), or None when withheld/unrouted.  The network PS hot path.
+
+        ``create=False`` is the READ-ONLY form (the serving plane's):
+        unknown keys yield zero rows and allocate NOTHING — query traffic
+        must not grow the training store (a stream of junk fids would
+        otherwise expand ``_W`` without bound and leak into snapshots,
+        checkpoints and elastic migration)."""
         if not obs_gate.enabled():
-            return self._pull_batch(keys, worker_epoch, worker_id)
+            return self._pull_batch(keys, worker_epoch, worker_id, create)
         t0 = time.perf_counter()
         with obs_trace.span("ps_store/pull", n_keys=int(len(keys))):
-            out = self._pull_batch(keys, worker_epoch, worker_id)
+            out = self._pull_batch(keys, worker_epoch, worker_id, create)
         reg = self.registry
         reg.observe("ps_store_pull_seconds", time.perf_counter() - t0)
         reg.inc("ps_store_pulls_total")
@@ -409,11 +422,19 @@ class AsyncParamServer:
         keys: np.ndarray,
         worker_epoch: int,
         worker_id: Optional[int] = None,
+        create: bool = True,
     ) -> Optional[np.ndarray]:
         with self._lock:
             if not self._pull_gate(worker_epoch, worker_id):
                 return None
             keys_arr = np.ascontiguousarray(keys, np.int64)
+            if not create:
+                slots = self._dict_slots(keys_arr)
+                known = slots >= 0
+                rows = np.zeros((len(keys_arr), self.dim), np.float32)
+                if known.any():
+                    rows[known] = self._W[slots[known]]
+                return rows
             slots = self._slots_create(keys_arr)
             return self._W[slots]
 
@@ -558,6 +579,7 @@ class AsyncParamServer:
             if keys_arr.size:
                 g = np.asarray(grads, np.float32).reshape(-1, self.dim)
                 self._apply(worker_id, self._slots_create(keys_arr), g)
+                self.write_version += 1
             return True
 
     # -- liveness routing (master.h:202-262 / network.h:148-151) ------------
@@ -631,6 +653,7 @@ class AsyncParamServer:
                 self._key_cache = None
                 self._pending = []
                 self.evicted_keys += n
+                self.write_version += 1
         if n and obs_gate.enabled():
             self.registry.inc("ps_store_evicted_keys_total", n)
         return n
@@ -688,6 +711,8 @@ class AsyncParamServer:
             self._acc[slots] = 0.0
             if self._needs_shadow:
                 self._shw[:, slots] = r
+            if keys_arr.size:
+                self.write_version += 1
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:
@@ -714,6 +739,7 @@ class AsyncParamServer:
                 "staleness": self.staleness,
                 "staleness_budget": self.staleness_threshold,
                 "evicted_keys": self.evicted_keys,
+                "write_version": self.write_version,
                 "n_keys": len(self._slot),
                 # sorted-lookup snapshot health (async_ps._alloc_slots):
                 "pending_depth": len(self._pending),

@@ -33,14 +33,32 @@ def save_fm_text(path: str, params: Dict[str, jnp.ndarray]) -> None:
 
 
 def load_fm_text(path: str) -> Dict[str, jnp.ndarray]:
+    """Inverse of :func:`save_fm_text`.  Hardened against the two legal
+    degenerate shapes the writer (and the reference's) can emit: an
+    all-zero ``w`` leaves the first line EMPTY (``save_fm_text`` writes
+    non-zero pairs only), and trailing blank lines are padding, not rows —
+    neither may produce a malformed zero-row ``v`` or misparse a factor
+    line as the weight line."""
     with open(path) as f:
         lines = f.read().splitlines()
+    if not lines:
+        raise ValueError(f"{path}: empty FM text file (no weight line)")
     v_rows = []
-    for line in lines[1:]:
+    for lineno, line in enumerate(lines[1:], start=2):
         if not line.strip():
             continue
-        _, vec = line.split(":", 1)
-        v_rows.append([float(x) for x in vec.split()])
+        fid_part, vec = line.split(":", 1)
+        vec_vals = [float(x) for x in vec.split()]
+        if int(fid_part) != len(v_rows):
+            raise ValueError(
+                f"{path}:{lineno}: factor line for fid {fid_part!r} out of "
+                f"order (expected {len(v_rows)})"
+            )
+        v_rows.append(vec_vals)
+    if not v_rows:
+        raise ValueError(f"{path}: no factor lines (zero-row v)")
+    if len({len(r) for r in v_rows}) != 1:
+        raise ValueError(f"{path}: ragged factor lines")
     v = np.asarray(v_rows, np.float32)
     w = np.zeros((v.shape[0],), np.float32)
     for tok in lines[0].split():
@@ -68,6 +86,177 @@ def load_embeddings_text(path: str) -> Tuple[List[str], np.ndarray]:
             words.append(parts[0])
             rows.append([float(x) for x in parts[1:]])
     return words, np.asarray(rows, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# compressed model export (serving artifacts, lightctr_tpu/serve)
+#
+# The text formats above are interchange; these are the SERVING artifacts:
+# one npz holding every parameter leaf coded with the repo's own codecs —
+# int8/int16 quantile codes (ops/quantize.py, the reference's
+# quantile_compress.h weight codec) or product-quantizer codes
+# (ops/pq.py, product_quantizer.h) for 2-D embedding-like tables — plus a
+# JSON meta record naming the model kind and per-leaf codec, so
+# ``serve.load_model`` can decode ON DEVICE at load (decode is a gather:
+# quantize.extract / pq.decode are jitted ops).  fp32 is the per-leaf
+# escape hatch for anything small or codec-hostile (biases, norm scales).
+
+COMPRESSED_FORMAT = "lightctr-compressed"
+COMPRESSED_VERSION = 1
+
+
+def _flatten_params(tree, prefix="") -> Dict[str, np.ndarray]:
+    """Nested dict-of-arrays -> {"a/b": array} (the npz key space)."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        name = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_params(v, prefix=name + "/"))
+        else:
+            out[name] = np.asarray(v)
+    return out
+
+
+def _unflatten_params(flat: Dict) -> Dict:
+    out: Dict = {}
+    for name, v in flat.items():
+        parts = name.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def save_compressed_npz(
+    path: str,
+    params: Dict,
+    model: str,
+    codec: str = "int8",
+    bits: int = 8,
+    mode: str = "uniform",
+    pq_leaves: Tuple[str, ...] = (),
+    pq_parts: int = 4,
+    pq_clusters: int = 256,
+    pq_iters: int = 10,
+    fp32_leaves: Tuple[str, ...] = (),
+    seed: int = 0,
+) -> Dict:
+    """Write a compressed serving artifact; returns the meta dict.
+
+    ``codec``: the default leaf codec — ``"int8"`` (quantile codes through
+    a per-leaf symmetric uniform/log table), ``"fp32"`` (raw).  Leaves
+    named in ``pq_leaves`` (flattened ``a/b`` names) are PQ-coded instead
+    (must be 2-D with dim divisible by ``pq_parts``); leaves in
+    ``fp32_leaves`` stay exact regardless of the default.  Scalar/empty
+    leaves always fall back to fp32 (a quantile table over one value is
+    noise for no byte win)."""
+    import json as _json
+
+    import jax as _jax
+
+    from lightctr_tpu.ops import pq as pq_mod
+    from lightctr_tpu.ops import quantize
+
+    if codec not in ("int8", "fp32"):
+        raise ValueError(f"unknown default codec {codec!r}")
+    flat = _flatten_params(params)
+    for name in tuple(pq_leaves) + tuple(fp32_leaves):
+        if name not in flat:
+            raise ValueError(f"codec override names unknown leaf {name!r}")
+    meta: Dict = {
+        "format": COMPRESSED_FORMAT, "version": COMPRESSED_VERSION,
+        "model": str(model), "leaves": {},
+    }
+    payload: Dict[str, np.ndarray] = {}
+    for name, arr in flat.items():
+        arr = np.asarray(arr, np.float32)
+        leaf_meta: Dict = {"shape": list(arr.shape)}
+        if name in pq_leaves:
+            if arr.ndim != 2 or arr.shape[1] % pq_parts:
+                raise ValueError(
+                    f"PQ leaf {name!r} must be [N, D] with D % "
+                    f"{pq_parts} == 0, got {arr.shape}"
+                )
+            book = pq_mod.train(
+                _jax.random.PRNGKey(seed), arr, part_cnt=pq_parts,
+                cluster_cnt=pq_clusters, iters=pq_iters,
+            )
+            payload[name + "__codes"] = np.asarray(
+                pq_mod.encode(book, arr)
+            )
+            payload[name + "__centroids"] = np.asarray(
+                book.centroids, np.float32
+            )
+            leaf_meta.update(codec="pq", parts=pq_parts,
+                             clusters=pq_clusters)
+        elif (codec == "int8" and name not in fp32_leaves
+                and arr.size > 1):
+            rng = float(np.max(np.abs(arr)))
+            rng = max(rng, 1e-12)
+            table = quantize.build_table(-rng, rng, bits=bits, mode=mode)
+            payload[name + "__codes"] = np.asarray(
+                quantize.compress(table, arr)
+            )
+            payload[name + "__values"] = np.asarray(
+                table.values, np.float32
+            )
+            leaf_meta.update(codec="int8", bits=bits, mode=mode,
+                             range=rng)
+        else:
+            payload[name + "__raw"] = arr
+            leaf_meta["codec"] = "fp32"
+        meta["leaves"][name] = leaf_meta
+    payload["__meta__"] = np.frombuffer(
+        _json.dumps(meta).encode(), np.uint8
+    )
+    np.savez(path, **payload)
+    return meta
+
+
+def load_compressed_npz(path: str):
+    """Read a :func:`save_compressed_npz` artifact -> ``(params, meta)``
+    with every leaf DECODED on the default device (jnp arrays): int8
+    leaves through ``quantize.extract`` (one gather), PQ leaves through
+    ``pq.decode`` (per-part gathers).  The decoded tree has the exact
+    structure the model kind's ``logits`` expects."""
+    import json as _json
+
+    from lightctr_tpu.ops import pq as pq_mod
+
+    with np.load(path) as z:
+        raw = {k: z[k] for k in z.files}
+    if "__meta__" not in raw:
+        raise ValueError(f"{path}: not a {COMPRESSED_FORMAT} artifact "
+                         "(missing __meta__)")
+    meta = _json.loads(bytes(raw["__meta__"].tobytes()).decode())
+    if meta.get("format") != COMPRESSED_FORMAT:
+        raise ValueError(f"{path}: format {meta.get('format')!r} is not "
+                         f"{COMPRESSED_FORMAT!r}")
+    flat: Dict = {}
+    for name, leaf in meta["leaves"].items():
+        shape = tuple(leaf["shape"])
+        if leaf["codec"] == "fp32":
+            flat[name] = jnp.asarray(raw[name + "__raw"])
+        elif leaf["codec"] == "int8":
+            codes = jnp.asarray(raw[name + "__codes"])
+            values = jnp.asarray(raw[name + "__values"])
+            flat[name] = jnp.take(
+                values, codes.astype(jnp.int32)
+            ).reshape(shape)
+        elif leaf["codec"] == "pq":
+            book = pq_mod.PQCodebook(
+                centroids=jnp.asarray(raw[name + "__centroids"])
+            )
+            flat[name] = pq_mod.decode(
+                book, jnp.asarray(raw[name + "__codes"])
+            ).reshape(shape)
+        else:
+            raise ValueError(
+                f"{path}: leaf {name!r} has unknown codec "
+                f"{leaf['codec']!r}"
+            )
+    return _unflatten_params(flat), meta
 
 
 def save_gmm_text(path: str, params) -> None:

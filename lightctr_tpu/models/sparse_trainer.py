@@ -214,11 +214,30 @@ class SparseTableCTRTrainer(CTRTrainer):
         leaves have their own sparse exchange (Parallax's split)."""
         return {k: v for k, v in params.items() if k not in self._spec}
 
+    def _use_sparse_ef(self) -> bool:
+        """Fixed-range clipped sparse payloads get the per-table EF carry
+        (the PR 5 follow-up): hybrid exchange + compress_bits + error
+        feedback + a FIXED float compress_range (dynamic never clips, so
+        a carry would compensate nothing)."""
+        return (
+            self._hybrid_dp
+            and self.compress_bits is not None
+            and self.error_feedback
+            and isinstance(self.compress_range, (int, float))
+        )
+
     def _init_opt_state(self, params):
         """Dense leaves get optax state; table leaves get per-row Adagrad
         accumulators only (never the transient full-size optax state).
         With ``compress_bits`` the dense-ring EF residual carry rides along
-        (CTRTrainer's CompressedRingState, flattened into this dict)."""
+        (CTRTrainer's CompressedRingState, flattened into this dict); with
+        a FIXED float ``compress_range`` each table additionally carries a
+        per-member ``[n, vocab, ...]`` sparse EF residual
+        (``dist.collectives.sparse_ef_residual_init`` layout) so clipped
+        sparse payload mass is delivered late instead of lost.  NOTE the
+        memory cost: n x table size per table — fixed-range clipping plus
+        EF is a deliberate bandwidth/memory trade (the default dynamic
+        range needs neither)."""
         dense = {k: v for k, v in params.items() if k not in self._spec}
         state = {
             "dense": self.tx.init(dense),
@@ -237,6 +256,18 @@ class SparseTableCTRTrainer(CTRTrainer):
             state["residual"] = jax.device_put(
                 residual, NamedSharding(self.mesh, P("data"))
             )
+        if self._use_sparse_ef():
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from lightctr_tpu.dist.collectives import sparse_ef_residual_init
+
+            state["sres"] = {
+                k: jax.device_put(
+                    sparse_ef_residual_init(self.mesh, params[k].shape),
+                    NamedSharding(self.mesh, P("data")),
+                )
+                for k in self._spec
+            }
         return state
 
     # -- step --------------------------------------------------------------
@@ -386,6 +417,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         bits = self.compress_bits
         crange, cmode = self.compress_range, self.compress_mode
         use_ef = self.error_feedback
+        sparse_ef = self._use_sparse_ef()
         ring_pad = self._ring_pad if bits is not None else 0
         margin = self._dense_margin
         force_ag = self._force_ag
@@ -474,6 +506,13 @@ class SparseTableCTRTrainer(CTRTrainer):
             # -- table leaves: three-way pick per table, id streams shared
             # within each (field-tuple, algo) group ------------------------
             new_accum = {}
+            # per-table sparse EF carries (fixed-range clipped payloads):
+            # allgather-exchanged tables update theirs through
+            # _ag_merge_rows; dense/rs tables pass theirs through
+            # untouched (the dense ring never clips its own mass away
+            # here without EF only because it is the escape hatch, and
+            # the rs path's residual support is an open follow-up)
+            new_sres = {}
             # in-jit rs overflow tally: the host-side rs_fits check should
             # make this identically zero, but if the two ever disagree the
             # count rides the health vector (third slot) instead of being
@@ -557,7 +596,13 @@ class SparseTableCTRTrainer(CTRTrainer):
                                     compress_range=(crange if bits is not None
                                                     else 1.0),
                                     compress_mode=cmode,
+                                    uids=u if sparse_ef else None,
+                                    residual=(opt_state["sres"][k][0]
+                                              if sparse_ef else None),
                                 )
+                                if sparse_ef:
+                                    merged, nres = merged
+                                    new_sres[k] = nres[None]
                             gn2 = gn2 + jnp.sum(merged * merged)
                             apply_sparse(k, uniq, merged)
                     else:  # sparse_rs
@@ -599,6 +644,13 @@ class SparseTableCTRTrainer(CTRTrainer):
             new_state = {"dense": new_dense_state, "accum": new_accum}
             if bits is not None:
                 new_state["residual"] = new_res[None]
+            if sparse_ef:
+                for k in spec:
+                    if k not in new_sres:
+                        # dense-ring / reduce-scatter tables: the carry
+                        # passes through untouched this step
+                        new_sres[k] = opt_state["sres"][k]
+                new_state["sres"] = new_sres
             # health vector gains a third slot: the cross-member rs
             # overflow count (psum -> replica-identical, like the rest).
             # Scan paths DCE it with the vector; the train_step feed
@@ -612,6 +664,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         state_spec = {"dense": P(), "accum": {k: P() for k in spec}}
         if bits is not None:
             state_spec["residual"] = P("data")
+        if sparse_ef:
+            state_spec["sres"] = {k: P("data") for k in spec}
         return shard_map(
             local_step,
             mesh=mesh,

@@ -326,6 +326,51 @@ class HeartbeatGapDetector(Detector):
         return OK, detail
 
 
+class LatencySLODetector(Detector):
+    """Serve-side latency SLO: the prediction server feeds the p50/p99 of
+    its request-latency histogram over the WINDOW since the last feed
+    (``lightctr_tpu.serve.server.PredictionServer._feed_slo`` computes the
+    bucket delta — a regression must not hide under a long healthy
+    history).  p99 past the SLO degrades the verdict, past
+    ``hard_factor`` x the SLO it is unhealthy; an optional p50 SLO
+    catches a median-wide slowdown the tail SLO would lag on.  Windows
+    with fewer than ``min_count`` requests are skipped (the quantile of
+    five samples is noise, and an idle server is not a slow one)."""
+
+    name = "latency_slo"
+    signals = ("latency_quantiles",)
+
+    def __init__(self, p99_slo_s: float = 0.05,
+                 p50_slo_s: Optional[float] = None,
+                 hard_factor: float = 2.0, min_count: int = 16):
+        self.p99_slo_s = float(p99_slo_s)
+        self.p50_slo_s = p50_slo_s
+        self.hard_factor = float(hard_factor)
+        self.min_count = int(min_count)
+
+    def check(self, signals):
+        q = signals["latency_quantiles"]
+        n = int(q.get("count", 0))
+        if n < self.min_count:
+            return OK, {"skipped": f"window count {n} < {self.min_count}"}
+        p50 = float(q.get("p50", 0.0))
+        p99 = float(q.get("p99", 0.0))
+        detail = {"p50_s": round(p50, 6), "p99_s": round(p99, 6),
+                  "count": n, "p99_slo_s": self.p99_slo_s}
+        status = OK
+        if p99 > self.p99_slo_s * self.hard_factor:
+            status = UNHEALTHY
+        elif p99 > self.p99_slo_s:
+            status = DEGRADED
+        if self.p50_slo_s is not None:
+            detail["p50_slo_s"] = self.p50_slo_s
+            if p50 > self.p50_slo_s * self.hard_factor:
+                status = UNHEALTHY
+            elif p50 > self.p50_slo_s:
+                status = worst((status, DEGRADED))
+        return status, detail
+
+
 #: detector name -> class; the registry the lint in tests/test_obs.py
 #: checks every Detector subclass into (no silent dark detectors)
 KNOWN_DETECTORS = {
@@ -333,6 +378,7 @@ KNOWN_DETECTORS = {
     for cls in (
         NaNLossDetector, LossSpikeDetector, GradNormDetector,
         TableSkewDetector, StalenessDetector, HeartbeatGapDetector,
+        LatencySLODetector,
     )
 }
 

@@ -1,0 +1,41 @@
+"""Serving plane: batched low-latency inference over the PS wire.
+
+The training half of the repo answers "how do the tables get better"; this
+package answers a user query — the reference's predictor family
+(``FM_Predict`` / ``GBM_Predict``, PAPER.md) re-designed for the repo's
+socket PS topology (docs/SERVING.md):
+
+  - :class:`~lightctr_tpu.serve.model.ServingModel` /
+    :func:`~lightctr_tpu.serve.model.load_model` — compressed-artifact
+    loading (int8 quantile / PQ codes decoded on device) and the jitted
+    batched score path, with optional PS-row-backed sparse leaves;
+  - :class:`~lightctr_tpu.serve.cache.HotEmbeddingCache` — LFU-admission
+    row cache in front of PS pulls, invalidated on PS write versions;
+  - :class:`~lightctr_tpu.serve.server.PredictionServer` — the
+    ``MSG_PREDICT``/``MSG_PREDICT_BATCH`` socket service with
+    micro-batching and admission control / load shedding;
+  - :class:`~lightctr_tpu.serve.client.PredictClient` — the caller stub.
+"""
+
+from lightctr_tpu.serve.cache import HotEmbeddingCache
+from lightctr_tpu.serve.client import PredictClient, ServerOverloaded
+from lightctr_tpu.serve.model import (
+    MODEL_KINDS,
+    ServingModel,
+    fm_ps_row_leaves,
+    fused_fm_rows,
+    load_model,
+)
+from lightctr_tpu.serve.server import PredictionServer
+
+__all__ = [
+    "HotEmbeddingCache",
+    "MODEL_KINDS",
+    "PredictClient",
+    "PredictionServer",
+    "ServerOverloaded",
+    "ServingModel",
+    "fm_ps_row_leaves",
+    "fused_fm_rows",
+    "load_model",
+]

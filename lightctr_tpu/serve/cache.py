@@ -1,0 +1,221 @@
+"""Hot-embedding row cache: LFU admission in front of PS pulls.
+
+CTR id streams are power-law skewed — the health plane's hot/dead-key
+detector (obs/health.py TableSkewDetector) watches exactly that skew, and
+the cache rides the SAME touched-uid streams: every request batch's deduped
+ids bump a frequency ledger, and that ledger drives **admission** (a missed
+row enters a full cache only when its touch count beats the coldest
+resident's — TinyLFU's insight: admission, not eviction policy, is what
+keeps one-hit wonders from flushing the hot set) and **eviction** (the
+minimum-frequency resident leaves).
+
+Invalidation is versioned: the PS store counts writes
+(``AsyncParamServer.write_version``, riding ``MSG_STATS``), and
+:meth:`HotEmbeddingCache.set_version` drops the whole cache when the
+observed version tuple moves — serving reads are then bounded-stale by the
+server's version poll interval, never unbounded (docs/SERVING.md).
+
+Metrics land in the registry the server owns (``serve_cache_*`` series),
+so hit rate is a first-class scrape, not a log line.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs.registry import MetricsRegistry, default_registry
+
+
+class HotEmbeddingCache:
+    """Frequency-admission row cache (uid -> [dim] fp32 row).
+
+    ``capacity``: max resident rows.  ``admit_min_freq``: a missed row is
+    admitted to a FULL cache only when its touch count is at least this
+    AND strictly beats the current minimum resident frequency (below
+    capacity everything is admitted — an empty cache should warm, not
+    gatekeep).  ``decay_every``/``decay_factor``: every N touch batches
+    the ledger halves (by default), so frequencies track the recent
+    stream, not all of history — yesterday's hot keys age out.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        capacity: int = 65536,
+        admit_min_freq: int = 2,
+        decay_every: int = 1000,
+        decay_factor: float = 0.5,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.dim = int(dim)
+        self.capacity = int(capacity)
+        self.admit_min_freq = int(admit_min_freq)
+        self.decay_every = int(decay_every)
+        self.decay_factor = float(decay_factor)
+        self.registry = registry if registry is not None else default_registry()
+        self._lock = threading.Lock()
+        self._rows: Dict[int, np.ndarray] = {}
+        self._freq: Dict[int, float] = {}
+        self._version: Optional[tuple] = None
+        self._touch_batches = 0
+        # min resident frequency, recomputed lazily (None = stale): an
+        # O(size) scan per insert would dominate the miss path; instead
+        # the floor is cached and only re-scanned after it is consumed
+        # by an eviction or invalidated by a decay
+        self._min_freq: Optional[Tuple[int, float]] = None  # (uid, freq)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rejected = 0
+        self.invalidations = 0
+        self.invalidated_rows = 0
+
+    # -- the touched-uid ledger ---------------------------------------------
+
+    def note_touched(self, uids: np.ndarray) -> None:
+        """Bump the frequency ledger for one request batch's DEDUPED ids
+        (the same per-batch unique stream the skew detector consumes)."""
+        with self._lock:
+            freq = self._freq
+            for u in np.asarray(uids, np.int64).tolist():
+                freq[u] = freq.get(u, 0.0) + 1.0
+            self._touch_batches += 1
+            if self.decay_every and \
+                    self._touch_batches % self.decay_every == 0:
+                self._freq = {
+                    u: f * self.decay_factor
+                    for u, f in freq.items()
+                    if f * self.decay_factor >= 0.5 or u in self._rows
+                }
+                self._min_freq = None
+
+    # -- lookup / insert -----------------------------------------------------
+
+    def lookup(self, uids: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized read -> ``(rows [n, dim] fp32, present bool [n])``;
+        missing slots are zero (the caller overwrites them from the PS
+        pull).  Counts hits/misses."""
+        uids = np.asarray(uids, np.int64)
+        rows = np.zeros((len(uids), self.dim), np.float32)
+        present = np.zeros(len(uids), bool)
+        with self._lock:
+            store = self._rows
+            for i, u in enumerate(uids.tolist()):
+                r = store.get(u)
+                if r is not None:
+                    rows[i] = r
+                    present[i] = True
+            n_hit = int(present.sum())
+            self.hits += n_hit
+            self.misses += len(uids) - n_hit
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("serve_cache_hits_total", n_hit)
+            reg.inc("serve_cache_misses_total", len(uids) - n_hit)
+        return rows, present
+
+    def _find_min_locked(self) -> Optional[Tuple[int, float]]:
+        if not self._rows:
+            return None
+        freq = self._freq
+        uid = min(self._rows, key=lambda u: freq.get(u, 0.0))
+        return uid, freq.get(uid, 0.0)
+
+    def insert(self, uids: np.ndarray, rows: np.ndarray) -> int:
+        """Offer pulled rows; returns how many were admitted.  Below
+        capacity every offer lands; at capacity the frequency-admission
+        gate decides (see class docstring)."""
+        uids = np.asarray(uids, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        admitted = 0
+        with self._lock:
+            for i, u in enumerate(uids.tolist()):
+                if u in self._rows:
+                    self._rows[u] = r[i].copy()
+                    continue
+                if len(self._rows) < self.capacity:
+                    self._rows[u] = r[i].copy()
+                    admitted += 1
+                    continue
+                f = self._freq.get(u, 0.0)
+                if f < self.admit_min_freq:
+                    self.rejected += 1
+                    continue
+                if self._min_freq is None:
+                    self._min_freq = self._find_min_locked()
+                if self._min_freq is None or f <= self._min_freq[1]:
+                    self.rejected += 1
+                    continue
+                del self._rows[self._min_freq[0]]
+                self.evictions += 1
+                self._min_freq = None
+                self._rows[u] = r[i].copy()
+                admitted += 1
+            n_entries = len(self._rows)
+            evicted, rejected = self.evictions, self.rejected
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("serve_cache_admissions_total", admitted)
+            reg.gauge_set("serve_cache_entries", n_entries)
+            reg.gauge_set("serve_cache_bytes", n_entries * self.dim * 4)
+            reg.gauge_set("serve_cache_evictions", evicted)
+            reg.gauge_set("serve_cache_rejected", rejected)
+        return admitted
+
+    # -- versioned invalidation ---------------------------------------------
+
+    def set_version(self, version) -> bool:
+        """Adopt the PS write-version observation (any hashable — the
+        server passes the tuple of per-shard ``write_version``s).  A MOVED
+        version drops every resident row (the rows may have trained past
+        what we serve); the first observation only arms the baseline.
+        Returns True when an invalidation happened."""
+        version = tuple(version) if isinstance(version, (list, tuple)) \
+            else (version,)
+        with self._lock:
+            if self._version == version:
+                return False
+            first = self._version is None
+            self._version = version
+            if first:
+                return False
+            dropped = len(self._rows)
+            self._rows.clear()
+            self._min_freq = None
+            self.invalidations += 1
+            self.invalidated_rows += dropped
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("serve_cache_invalidations_total")
+            reg.inc("serve_cache_invalidated_rows_total", dropped)
+            reg.gauge_set("serve_cache_entries", 0)
+            reg.gauge_set("serve_cache_bytes", 0)
+        return True
+
+    # -- reads ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def stats(self) -> Dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "entries": len(self._rows),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 5) if total else 0.0,
+                "evictions": self.evictions,
+                "rejected": self.rejected,
+                "invalidations": self.invalidations,
+                "invalidated_rows": self.invalidated_rows,
+                "tracked_uids": len(self._freq),
+            }

@@ -1,0 +1,288 @@
+"""Serving-side model: compressed artifact in, jitted batched scores out.
+
+The reference's predictor classes re-read the trained text model and score
+request-by-request (``FM_Predict``); here the artifact is the compressed
+npz of :func:`lightctr_tpu.models.export.save_compressed_npz` (int8
+quantile codes / PQ codes, decoded ON DEVICE at load — decode is a gather)
+and scoring is one jitted call over a micro-batch, Parallax's split carried
+into serving: the dense MLP math is the batched device path, while the
+per-fid table leaves can be **PS-row-backed** — assembled per batch from
+rows the :class:`~lightctr_tpu.serve.server.PredictionServer` pulls through
+its :class:`~lightctr_tpu.serve.cache.HotEmbeddingCache`.
+
+PS-backed scoring mirrors the sparse trainer's O(touched) recipe
+(models/sparse_trainer.py) in reverse: dedup the batch's ids, fetch ONLY
+the touched rows, rewrite the id fields to positions, and let the
+unchanged model compute on the gathered rows.  Shapes are padded (batch to
+a power of two, touched rows to a power of two) so the jit cache stays a
+handful of programs under production traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu.ops.activations import sigmoid
+
+
+def _kind_fm():
+    from lightctr_tpu.models import fm
+    return fm.logits
+
+
+def _kind_widedeep():
+    from lightctr_tpu.models import widedeep
+    return widedeep.logits
+
+
+def _kind_deepfm():
+    from lightctr_tpu.models import deepfm
+    return deepfm.logits
+
+
+def _kind_dcn():
+    from lightctr_tpu.models import deepfm
+    return deepfm.dcn_logits
+
+
+#: model kind -> zero-arg resolver of its ``logits(params, batch)`` fn
+MODEL_KINDS = {
+    "fm": _kind_fm,
+    "widedeep": _kind_widedeep,
+    "deepfm": _kind_deepfm,
+    "dcn": _kind_dcn,
+}
+
+#: model kind -> the batch fields that index the per-fid table leaves
+#: (the id streams a PS-backed deployment dedups and rewrites)
+_ID_FIELDS = {
+    "fm": ("fids",),
+    "widedeep": ("fids", "rep_fids"),
+    "deepfm": ("fids", "rep_fids"),
+    "dcn": ("rep_fids",),
+}
+
+#: kinds whose batch layout carries the field-representative pair
+_REP_KINDS = ("widedeep", "deepfm", "dcn")
+
+
+def _next_pow2(n: int, floor: int = 8) -> int:
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+def fm_ps_row_leaves(factor_dim: int, w_leaf: str = "w",
+                     table_leaf: str = "v") -> Dict[str, Tuple[int, int, bool]]:
+    """The fused ``[w | v]`` PS row layout the training soaks use
+    (tools/criteo_ps_soak ROW_DIM = 1 + dim): leaf -> (lo, hi, squeeze)
+    column slices of a pulled ``[K, 1 + factor_dim]`` row block.  Works
+    for FM (``w``/``v``) and, with ``table_leaf="embed"``, for the
+    Wide&Deep/DeepFM family."""
+    return {w_leaf: (0, 1, True),
+            table_leaf: (1, 1 + int(factor_dim), False)}
+
+
+def fused_fm_rows(params: Dict, w_leaf: str = "w",
+                  table_leaf: str = "v") -> Tuple[np.ndarray, np.ndarray]:
+    """(keys, rows) preloading a PS with the fused layout above: key = fid,
+    row = ``[w[fid], table[fid, :]]``."""
+    w = np.asarray(params[w_leaf], np.float32)
+    t = np.asarray(params[table_leaf], np.float32)
+    keys = np.arange(t.shape[0], dtype=np.int64)
+    return keys, np.concatenate([w[:, None], t], axis=1)
+
+
+class ServingModel:
+    """One loaded model: local (device) leaves + the jitted score path.
+
+    ``row_leaves``: {leaf: (lo, hi, squeeze)} column slices of PS rows —
+    when set, those leaves are NOT read from ``params`` at score time but
+    assembled from the ``rows`` block :meth:`score_rows` receives (and
+    ``row_dim`` names the PS row width).  Empty = fully local model.
+    """
+
+    def __init__(
+        self,
+        kind: str,
+        params: Dict,
+        row_leaves: Optional[Dict[str, Tuple[int, int, bool]]] = None,
+        row_dim: Optional[int] = None,
+        id_fields: Optional[Tuple[str, ...]] = None,
+    ):
+        if kind not in MODEL_KINDS:
+            raise ValueError(
+                f"unknown model kind {kind!r} (have {sorted(MODEL_KINDS)})"
+            )
+        self.kind = kind
+        self.params = {k: jnp.asarray(v) if not isinstance(v, dict) else
+                       jax.tree_util.tree_map(jnp.asarray, v)
+                       for k, v in params.items()}
+        self.logits_fn = MODEL_KINDS[kind]()
+        self.row_leaves = dict(row_leaves or {})
+        if self.row_leaves:
+            need = max(hi for _, hi, _ in self.row_leaves.values())
+            if row_dim is None:
+                row_dim = need
+            elif row_dim < need:
+                raise ValueError(
+                    f"row_dim {row_dim} cannot hold slices up to {need}"
+                )
+        self.row_dim = row_dim
+        self.id_fields = tuple(id_fields or _ID_FIELDS[kind])
+
+        def _score_local(params, batch):
+            return sigmoid(self.logits_fn(params, batch))
+
+        def _score_rows(params, rows, batch):
+            full = dict(params)
+            for leaf, (lo, hi, squeeze) in self.row_leaves.items():
+                sub = rows[:, lo:hi]
+                full[leaf] = sub[:, 0] if squeeze else sub
+            return sigmoid(self.logits_fn(full, batch))
+
+        self._jit_local = jax.jit(_score_local)
+        self._jit_rows = jax.jit(_score_rows)
+
+    # -- shape plumbing ------------------------------------------------------
+
+    @staticmethod
+    def _pad_batch(arrays: Dict, b_pad: int) -> Dict:
+        out = {}
+        b = None
+        for k, v in arrays.items():
+            v = np.asarray(v)
+            b = v.shape[0]
+            if b_pad != b:
+                pad = np.zeros((b_pad - b,) + v.shape[1:], v.dtype)
+                v = np.concatenate([v, pad], axis=0)
+            out[k] = jnp.asarray(v)
+        return out
+
+    # -- request validation --------------------------------------------------
+
+    def required_fields(self) -> Tuple[str, ...]:
+        base = ("fids", "vals")
+        if self.kind in _REP_KINDS:
+            return base + ("rep_fids", "rep_mask")
+        return base
+
+    def canonicalize_request(self, arrays: Dict) -> Dict:
+        """Validate one decoded predict frame against THIS model's layout
+        and strip it to the canonical field set — done at admission so a
+        malformed-but-decodable frame is rejected alone (protocol error on
+        ITS connection) instead of poisoning the whole micro-batch it
+        would be coalesced into: ``_concat`` and the jitted score can then
+        assume every queued request carries the identical fields."""
+        missing = [f for f in self.required_fields() if f not in arrays]
+        if missing:
+            raise ValueError(
+                f"predict frame for a {self.kind!r} model is missing "
+                f"{missing} (send the rep_fids/rep_mask pair for the "
+                "field-representative family, omit it for fm)"
+            )
+        out = {f: arrays[f] for f in self.required_fields()}
+        b = int(np.asarray(out["fids"]).shape[0])
+        if b < 1:
+            raise ValueError("empty predict frame (B == 0)")
+        out["mask"] = (np.asarray(arrays["mask"], np.float32)
+                       if "mask" in arrays
+                       else np.ones_like(np.asarray(out["vals"],
+                                                    np.float32)))
+        return out
+
+    # -- score paths ---------------------------------------------------------
+
+    def score(self, arrays: Dict) -> np.ndarray:
+        """Fully-local scoring: ``arrays`` is the model's batch layout
+        (``labels`` optional/ignored); returns [B] fp32 probabilities.
+        The batch is padded to a power of two so repeated odd-sized
+        micro-batches reuse one compiled program."""
+        arrays = self._with_mask(arrays)
+        b = int(np.asarray(arrays["fids"]).shape[0]) if "fids" in arrays \
+            else int(np.asarray(arrays["rep_fids"]).shape[0])
+        batch = self._pad_batch(arrays, _next_pow2(b))
+        return np.asarray(self._jit_local(self.params, batch))[:b]
+
+    @staticmethod
+    def _with_mask(arrays: Dict) -> Dict:
+        """Drop labels, default ``mask`` to ones — the wire sends vals
+        pre-masked (dist/wire.py predict frames), so a missing mask means
+        'everything you got is live'."""
+        arrays = {k: v for k, v in arrays.items() if k != "labels"}
+        if "mask" not in arrays and "vals" in arrays:
+            arrays["mask"] = np.ones_like(
+                np.asarray(arrays["vals"], np.float32))
+        return arrays
+
+    def touched_uids(self, arrays: Dict) -> np.ndarray:
+        """Sorted unique ids this batch touches across the model's id
+        fields — the stream the cache ledger and the PS pull consume."""
+        streams = [np.asarray(arrays[f]).reshape(-1)
+                   for f in self.id_fields if f in arrays]
+        if not streams:
+            raise ValueError(
+                f"batch carries none of the id fields {self.id_fields}"
+            )
+        return np.unique(np.concatenate(streams).astype(np.int64))
+
+    def score_rows(self, arrays: Dict, uids: np.ndarray,
+                   rows: np.ndarray) -> np.ndarray:
+        """PS-backed scoring: ``uids`` is the SORTED unique id cover of
+        the batch's id fields (``touched_uids``), ``rows`` the matching
+        ``[K, row_dim]`` fp32 PS rows.  Id fields are rewritten to row
+        positions host-side, rows are padded to a power of two (zero rows
+        — positions never point past K), and the jitted program computes
+        on the gathered block exactly like the sparse trainer's step."""
+        if not self.row_leaves:
+            raise ValueError("score_rows needs row_leaves (PS-backed mode)")
+        uids = np.asarray(uids, np.int64)
+        rows = np.asarray(rows, np.float32).reshape(len(uids), self.row_dim)
+        arrays = self._with_mask(arrays)
+        b = int(np.asarray(arrays[self.id_fields[0]]).shape[0])
+        batch = dict(arrays)
+        for f in self.id_fields:
+            if f not in batch:
+                continue
+            ids = np.asarray(batch[f], np.int64)
+            pos = np.searchsorted(uids, ids.reshape(-1))
+            if pos.max(initial=0) >= len(uids) or \
+                    np.any(uids[np.minimum(pos, len(uids) - 1)]
+                           != ids.reshape(-1)):
+                raise ValueError(
+                    f"id field {f!r} carries ids outside the uid cover"
+                )
+            batch[f] = pos.reshape(ids.shape).astype(np.int32)
+        k_pad = _next_pow2(len(uids))
+        if k_pad != len(uids):
+            rows = np.concatenate(
+                [rows, np.zeros((k_pad - len(uids), self.row_dim),
+                                np.float32)], axis=0)
+        dev_batch = self._pad_batch(batch, _next_pow2(b))
+        return np.asarray(
+            self._jit_rows(self.params, jnp.asarray(rows), dev_batch)
+        )[:b]
+
+
+def load_model(
+    path: str,
+    row_leaves: Optional[Dict[str, Tuple[int, int, bool]]] = None,
+    row_dim: Optional[int] = None,
+    id_fields: Optional[Tuple[str, ...]] = None,
+) -> ServingModel:
+    """Compressed artifact (models/export.py ``save_compressed_npz``) ->
+    :class:`ServingModel`, every leaf decoded on device.  ``row_leaves``
+    switches the named table leaves to PS-row-backed serving (the decoded
+    local copies, if present, are kept for parity checks/preloads)."""
+    from lightctr_tpu.models.export import load_compressed_npz
+
+    params, meta = load_compressed_npz(path)
+    return ServingModel(
+        meta["model"], params, row_leaves=row_leaves, row_dim=row_dim,
+        id_fields=id_fields,
+    )

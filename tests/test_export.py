@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from lightctr_tpu.models import export, fm, gmm
 
@@ -28,6 +29,77 @@ def test_embeddings_text_roundtrip(tmp_path, rng):
     w2, e2 = export.load_embeddings_text(path)
     assert w2 == words
     np.testing.assert_allclose(e2, emb, rtol=1e-4, atol=1e-6)
+
+
+def test_fm_text_roundtrip_all_zero_w(tmp_path):
+    """ISSUE 7 satellite: an all-zero ``w`` writes an EMPTY first line
+    (save_fm_text emits non-zero pairs only) — the loader must round-trip
+    it instead of misparsing, and trailing blank lines are padding."""
+    params = fm.init(jax.random.PRNGKey(1), 6, 3)  # w starts all-zero
+    path = str(tmp_path / "zero_w.txt")
+    export.save_fm_text(path, params)
+    assert open(path).readline() == "\n"   # the empty weight line
+    with open(path, "a") as f:
+        f.write("\n\n")                     # trailing blank padding
+    out = export.load_fm_text(path)
+    assert out["w"].shape == (6,) and out["v"].shape == (6, 3)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.0)
+    np.testing.assert_allclose(np.asarray(out["v"]),
+                               np.asarray(params["v"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fm_text_degenerate_files_fail_loud(tmp_path):
+    """A zero-row v (weight line but no factor lines) or an out-of-order
+    factor line must raise, never produce a malformed model."""
+    p1 = str(tmp_path / "no_rows.txt")
+    with open(p1, "w") as f:
+        f.write("\n\n")
+    with pytest.raises(ValueError, match="zero-row"):
+        export.load_fm_text(p1)
+    p2 = str(tmp_path / "empty.txt")
+    open(p2, "w").close()
+    with pytest.raises(ValueError, match="empty"):
+        export.load_fm_text(p2)
+    p3 = str(tmp_path / "out_of_order.txt")
+    with open(p3, "w") as f:
+        f.write("0:1.5\n1:0.1 0.2\n0:0.3 0.4\n")
+    with pytest.raises(ValueError, match="out of order"):
+        export.load_fm_text(p3)
+
+
+def test_compressed_npz_roundtrip_structure(tmp_path, rng):
+    """Nested params (dense sub-dicts) survive the flatten/unflatten and
+    every codec decodes back to the declared shape."""
+    params = {
+        "w": np.asarray(rng.normal(size=24), np.float32),
+        "v": np.asarray(rng.normal(size=(24, 8)), np.float32),
+        "fc1": {"w": np.asarray(rng.normal(size=(8, 4)), np.float32),
+                "b": np.zeros((4,), np.float32)},
+    }
+    path = str(tmp_path / "model.npz")
+    meta = export.save_compressed_npz(
+        path, params, model="deepfm", pq_leaves=("v",), pq_parts=4,
+        pq_clusters=8, fp32_leaves=("fc1/b",))
+    assert meta["leaves"]["v"]["codec"] == "pq"
+    assert meta["leaves"]["fc1/b"]["codec"] == "fp32"
+    assert meta["leaves"]["fc1/w"]["codec"] == "int8"
+    out, meta2 = export.load_compressed_npz(path)
+    assert meta2["model"] == "deepfm"
+    assert np.asarray(out["fc1"]["w"]).shape == (8, 4)
+    np.testing.assert_array_equal(np.asarray(out["fc1"]["b"]),
+                                  params["fc1"]["b"])
+    # int8 decode error bounded by a bucket of the leaf's dynamic range
+    rng_w = float(np.abs(params["w"]).max())
+    np.testing.assert_allclose(np.asarray(out["w"]), params["w"],
+                               atol=2 * 2 * rng_w / 256)
+
+
+def test_compressed_npz_unknown_leaf_override_is_loud(tmp_path):
+    with pytest.raises(ValueError, match="unknown leaf"):
+        export.save_compressed_npz(
+            str(tmp_path / "x.npz"), {"w": np.zeros(4, np.float32)},
+            model="fm", pq_leaves=("nope",))
 
 
 def test_gmm_text_roundtrip(tmp_path, rng):

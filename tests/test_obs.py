@@ -560,6 +560,32 @@ def test_every_ps_wire_op_has_a_latency_series_name():
     from lightctr_tpu.dist import wire
     assert all(v < wire.TRACE_FLAG for v in ops.values())
 
+    # the serving plane (serve/) rides the same framing and telemetry
+    # block: any MSG_* constant DEFINED there (rather than imported from
+    # ps_server, the canonical op registry) would dodge the vars() scan
+    # above — lint the ASTs so a serve-side op can't ship dark either
+    serve_root = LIB_ROOT / "serve"
+    rogue = []
+    for path in sorted(serve_root.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.startswith("MSG_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                continue
+            if node.value.value not in ps_server._OP_NAMES:
+                rogue.append(
+                    f"{path.name}:{node.lineno} {node.targets[0].id}"
+                )
+    assert not rogue, (
+        "serve/ defines MSG_* ops missing from ps_server._OP_NAMES "
+        "(latency series would record as op=\"unknown\"): "
+        + ", ".join(rogue)
+    )
+
 
 def test_every_health_detector_is_registered_and_series_declared():
     """No silent dark detectors: every ``*Detector`` class in obs/health.py

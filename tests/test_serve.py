@@ -1,0 +1,663 @@
+"""Serving plane (ISSUE 7): predict wire frames, the hot-embedding cache,
+micro-batched scoring, admission control, compressed-export parity, the
+latency SLO detector, and the 2-process socket acceptance smoke."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig, obs, serve
+from lightctr_tpu.dist import wire
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.models import export, fm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import trace
+from lightctr_tpu.ops.activations import sigmoid
+from lightctr_tpu.ops.metrics import auc_exact
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F, K = 256, 8
+ROW_DIM = 1 + K
+
+
+def _batch(rng, n=8, nnz=4, f=F):
+    return {
+        "fids": rng.integers(1, f, size=(n, nnz)).astype(np.int32),
+        "vals": np.ones((n, nnz), np.float32),
+    }
+
+
+def _forward(params, batch):
+    b = {
+        "fids": jnp.asarray(batch["fids"]),
+        "vals": jnp.asarray(batch["vals"]),
+        "mask": jnp.ones_like(jnp.asarray(batch["vals"])),
+    }
+    return np.asarray(sigmoid(fm.logits(params, b)))
+
+
+# -- wire frames -------------------------------------------------------------
+
+
+def test_predict_frame_roundtrip(rng):
+    arrays = {
+        "fids": rng.integers(0, 1000, size=(5, 7)).astype(np.int32),
+        "vals": rng.random((5, 7)).astype(np.float32),
+    }
+    buf = wire.pack_predict_batch(arrays)
+    out, used = wire.unpack_predict_batch(buf)
+    assert used == len(buf)
+    np.testing.assert_array_equal(out["fids"], arrays["fids"])
+    np.testing.assert_allclose(out["vals"], arrays["vals"], atol=1e-3)
+    np.testing.assert_array_equal(out["mask"], np.ones((5, 7)))
+
+
+def test_predict_frame_rep_fields_roundtrip(rng):
+    arrays = {
+        "fids": rng.integers(0, 1000, size=(3, 5)).astype(np.int32),
+        "vals": rng.random((3, 5)).astype(np.float32),
+        "rep_fids": rng.integers(0, 1000, size=(3, 4)).astype(np.int32),
+        "rep_mask": (rng.random((3, 4)) > 0.3).astype(np.float32),
+    }
+    buf = wire.pack_predict_batch(arrays)
+    out, used = wire.unpack_predict_batch(buf)
+    assert used == len(buf)
+    np.testing.assert_array_equal(out["rep_fids"], arrays["rep_fids"])
+    np.testing.assert_allclose(out["rep_mask"], arrays["rep_mask"],
+                               atol=1e-3)
+
+
+def test_predict_frame_shape_mismatch_is_loud(rng):
+    with pytest.raises(ValueError, match="matching"):
+        wire.pack_predict_batch({
+            "fids": np.ones((2, 3), np.int32),
+            "vals": np.ones((2, 4), np.float32),
+        })
+
+
+def test_predict_frame_claimed_dims_bounded_by_payload():
+    """A tiny frame claiming astronomic dims must fail BEFORE any decode
+    buffer is allocated (a 20-byte payload cannot hold 2^40 fids)."""
+    evil = wire.pack_varint(np.array([1 << 20, 1 << 20, 0], np.int64))
+    with pytest.raises(ValueError, match="exceed"):
+        wire.unpack_predict_batch(evil + b"\x00" * 16)
+
+
+# -- hot-embedding cache -----------------------------------------------------
+
+
+def test_cache_warms_below_capacity_and_counts(rng):
+    c = serve.HotEmbeddingCache(dim=4, capacity=8,
+                                registry=obs.MetricsRegistry())
+    uids = np.array([3, 5, 9], np.int64)
+    rows, present = c.lookup(uids)
+    assert not present.any()
+    c.note_touched(uids)
+    c.insert(uids, rng.random((3, 4)).astype(np.float32))
+    rows, present = c.lookup(uids)
+    assert present.all()
+    st = c.stats()
+    assert st["hits"] == 3 and st["misses"] == 3 and st["entries"] == 3
+
+
+def test_cache_lfu_admission_and_eviction(rng):
+    c = serve.HotEmbeddingCache(dim=2, capacity=2, admit_min_freq=2,
+                                registry=obs.MetricsRegistry())
+    # residents 1, 2 touched once each
+    c.note_touched(np.array([1, 2]))
+    c.insert(np.array([1, 2]), np.ones((2, 2), np.float32))
+    # a one-hit wonder must NOT evict a resident
+    c.note_touched(np.array([7]))
+    c.insert(np.array([7]), np.ones((1, 2), np.float32))
+    assert c.stats()["rejected"] == 1
+    _, present = c.lookup(np.array([1, 2]))
+    assert present.all()
+    # a genuinely hot key (touched 3x vs residents' 1-2x) evicts the
+    # coldest resident
+    for _ in range(3):
+        c.note_touched(np.array([9]))
+    c.insert(np.array([9]), 2 * np.ones((1, 2), np.float32))
+    st = c.stats()
+    assert st["evictions"] == 1
+    _, present = c.lookup(np.array([9]))
+    assert present.all()
+
+
+def test_cache_versioned_invalidation(rng):
+    c = serve.HotEmbeddingCache(dim=2, capacity=8,
+                                registry=obs.MetricsRegistry())
+    c.insert(np.array([1]), np.ones((1, 2), np.float32))
+    assert not c.set_version((5,))          # first observation = baseline
+    assert len(c) == 1
+    assert not c.set_version((5,))          # unchanged
+    assert c.set_version((6,))              # moved: drop everything
+    assert len(c) == 0
+    assert c.stats()["invalidations"] == 1
+
+
+# -- serving model + compressed exports --------------------------------------
+
+
+def _train_small_fm(rng, epochs=40):
+    n, nnz = 512, 4
+    fids = rng.integers(1, F, size=(n, nnz)).astype(np.int32)
+    w_true = rng.normal(size=F).astype(np.float32)
+    z = w_true[fids].sum(1)
+    labels = (1 / (1 + np.exp(-z)) > rng.random(n)).astype(np.float32)
+    batch = {
+        "fids": fids, "fields": np.zeros_like(fids),
+        "vals": np.ones((n, nnz), np.float32),
+        "mask": np.ones((n, nnz), np.float32), "labels": labels,
+    }
+    params = fm.init(jax.random.PRNGKey(0), F, K)
+    tr = CTRTrainer(params, fm.logits, TrainConfig(learning_rate=0.3),
+                    fused_fn=fm.logits_with_l2)
+    tr.health = None
+    tr.fit_fullbatch_scan(batch, epochs)
+    return {k: np.asarray(v) for k, v in tr.params.items()}, batch
+
+
+def test_compressed_export_int8_and_pq_auc_parity(tmp_path, rng):
+    """ISSUE 7 satellite: the compressed serving path is measured, not
+    assumed — int8 quantile codes and PQ codes of a TRAINED FM score
+    within AUC tolerance of the fp32 original."""
+    params, batch = _train_small_fm(rng)
+    scores_fp32 = _forward(params, batch)
+    auc_fp32 = auc_exact(scores_fp32, batch["labels"])
+    assert auc_fp32 > 0.8  # the model really learned something
+
+    p_int8 = str(tmp_path / "int8.npz")
+    export.save_compressed_npz(p_int8, params, model="fm", codec="int8")
+    m_int8 = serve.load_model(p_int8)
+    auc_int8 = auc_exact(m_int8.score(batch), batch["labels"])
+
+    p_pq = str(tmp_path / "pq.npz")
+    export.save_compressed_npz(p_pq, params, model="fm", pq_leaves=("v",),
+                               pq_parts=4, pq_clusters=64)
+    m_pq = serve.load_model(p_pq)
+    auc_pq = auc_exact(m_pq.score(batch), batch["labels"])
+
+    assert auc_int8 >= auc_fp32 - 0.01, (auc_int8, auc_fp32)
+    assert auc_pq >= auc_fp32 - 0.03, (auc_pq, auc_fp32)
+    # and the compression is real: int8 codes are 1 byte/element (vs 4),
+    # PQ codes are parts bytes/row (vs 4*K)
+    with np.load(p_int8) as z:
+        assert z["v__codes"].dtype == np.uint8
+        assert z["v__codes"].size == params["v"].size
+    with np.load(p_pq) as z:
+        assert z["v__codes"].shape == (F, 4)
+        assert z["v__codes"].dtype == np.uint8
+
+
+def test_load_model_rejects_unknown_artifacts(tmp_path):
+    path = str(tmp_path / "bogus.npz")
+    np.savez(path, x=np.zeros(3))
+    with pytest.raises(ValueError, match="__meta__"):
+        export.load_compressed_npz(path)
+
+
+def test_score_rows_matches_local(rng):
+    params = fm.init(jax.random.PRNGKey(1), F, K)
+    local = serve.ServingModel("fm", params)
+    ps_mode = serve.ServingModel(
+        "fm", {}, row_leaves=serve.fm_ps_row_leaves(K), row_dim=ROW_DIM)
+    _, rows = serve.fused_fm_rows(params)
+    batch = _batch(rng, n=6)
+    uids = ps_mode.touched_uids(batch)
+    got = ps_mode.score_rows(batch, uids, rows[uids])
+    np.testing.assert_allclose(got, local.score(batch), atol=1e-5)
+
+
+def test_score_rows_rejects_uncovered_ids(rng):
+    ps_mode = serve.ServingModel(
+        "fm", {}, row_leaves=serve.fm_ps_row_leaves(K), row_dim=ROW_DIM)
+    batch = _batch(rng, n=2)
+    uids = ps_mode.touched_uids(batch)[:-1]   # drop one covered id
+    with pytest.raises(ValueError, match="outside the uid cover"):
+        ps_mode.score_rows(batch, uids,
+                           np.zeros((len(uids), ROW_DIM), np.float32))
+
+
+# -- server: micro-batching, correctness, shedding ---------------------------
+
+
+def test_server_scores_match_forward_and_microbatches(rng):
+    params = fm.init(jax.random.PRNGKey(2), F, K)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", params), max_batch=32,
+        max_wait_us=50_000, queue_cap=256, deadline_ms=5000,
+    )
+    try:
+        warm = serve.PredictClient(srv.address)
+        wb = _batch(rng, n=2)
+        np.testing.assert_allclose(warm.predict(wb), _forward(params, wb),
+                                   atol=2e-3)
+        warm.close()
+        batches_before = srv._batches_scored
+        # 4 concurrent single-row requests inside one max_wait window:
+        # the scorer coalesces them into one (maybe two) jitted calls
+        results = {}
+
+        def one(i):
+            cli = serve.PredictClient(srv.address)
+            b = _batch(np.random.default_rng(i), n=1)
+            try:
+                results[i] = (b, cli.predict(b))
+            finally:
+                cli.close()
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(results) == 4
+        for b, scores in results.values():
+            np.testing.assert_allclose(scores, _forward(params, b),
+                                       atol=2e-3)
+        assert srv._batches_scored - batches_before <= 2
+        snap = srv.registry.snapshot()
+        assert snap["histograms"]["serve_batch_rows"]["count"] >= 1
+    finally:
+        srv.close()
+
+
+def test_server_sheds_on_overload_and_stays_up(rng):
+    params = fm.init(jax.random.PRNGKey(3), F, K)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", params), max_batch=4, max_wait_us=100,
+        queue_cap=8, deadline_ms=2000, score_delay_s=0.15,
+    )
+    try:
+        warm = serve.PredictClient(srv.address)
+        warm.predict(_batch(rng, n=1))
+        warm.close()
+        ok, shed = [], []
+
+        def one(i):
+            cli = serve.PredictClient(srv.address)
+            try:
+                cli.predict(_batch(np.random.default_rng(i), n=2))
+                ok.append(i)
+            except serve.ServerOverloaded:
+                shed.append(i)
+            finally:
+                cli.close()
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert shed, "burst past the bounded queue must shed"
+        assert ok, "admitted requests must still be answered"
+        counters = srv.registry.snapshot()["counters"]
+        assert counters.get(
+            obs.labeled("serve_shed_total", reason="queue_full"), 0
+        ) == len(shed)
+        # the server is still healthy for new traffic after the burst
+        srv.score_delay_s = 0.0
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=1)
+        np.testing.assert_allclose(cli.predict(b), _forward(params, b),
+                                   atol=2e-3)
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_server_expired_deadline_is_dropped_not_scored(rng):
+    params = fm.init(jax.random.PRNGKey(4), F, K)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", params), max_batch=2, max_wait_us=100,
+        queue_cap=64, deadline_ms=60, score_delay_s=0.25,
+    )
+    try:
+        warm = serve.PredictClient(srv.address)
+        warm.predict(_batch(rng, n=1))   # compile outside the race
+        warm.close()
+
+        # request A occupies the scorer for 250ms; request B (sent while
+        # A scores) expires its 60ms deadline in the queue and must be
+        # DROPPED at pop, not scored late
+        def slow_a():
+            c = serve.PredictClient(srv.address)
+            try:
+                c.predict(_batch(np.random.default_rng(1), n=1))
+            finally:
+                c.close()
+
+        t = threading.Thread(target=slow_a)
+        t.start()
+        time.sleep(0.05)   # A is in the scorer's sleep by now
+        c = serve.PredictClient(srv.address)
+        with pytest.raises(serve.ServerOverloaded):
+            c.predict(_batch(rng, n=1))
+        c.close()
+        t.join()
+        counters = srv.registry.snapshot()["counters"]
+        assert counters.get(
+            obs.labeled("serve_shed_total", reason="deadline"), 0) >= 1
+    finally:
+        srv.close()
+
+
+def test_server_rejects_mismatched_layout_without_poisoning_batch(rng):
+    """A decodable frame whose layout does not match the model (fm frame
+    at a widedeep server, or B == 0) is refused on ITS connection at
+    admission — co-batched requests from other clients still score."""
+    from lightctr_tpu.models import widedeep
+
+    params = widedeep.init(jax.random.PRNGKey(7), F, field_cnt=3,
+                           factor_dim=4)
+    srv = serve.PredictionServer(
+        serve.ServingModel("widedeep", params), max_batch=8,
+        max_wait_us=50_000, queue_cap=64, deadline_ms=5000,
+    )
+    try:
+        good_req = {
+            "fids": rng.integers(1, F, size=(2, 3)).astype(np.int32),
+            "vals": np.ones((2, 3), np.float32),
+            "rep_fids": rng.integers(1, F, size=(2, 3)).astype(np.int32),
+            "rep_mask": np.ones((2, 3), np.float32),
+        }
+        out = {}
+
+        def good():
+            c = serve.PredictClient(srv.address)
+            try:
+                out["scores"] = c.predict(good_req)
+            finally:
+                c.close()
+
+        def bad():
+            c = serve.PredictClient(srv.address)
+            try:
+                with pytest.raises(RuntimeError, match="rejected"):
+                    c.predict({"fids": np.ones((1, 3), np.int32),
+                               "vals": np.ones((1, 3), np.float32)})
+                out["bad_rejected"] = True
+            finally:
+                c.close()
+
+        tb = threading.Thread(target=bad)
+        tg = threading.Thread(target=good)
+        tb.start()
+        tg.start()
+        tb.join()
+        tg.join()
+        assert out.get("bad_rejected")
+        assert out["scores"].shape == (2,)
+        assert np.isfinite(out["scores"]).all()
+        assert srv.registry.snapshot()["counters"][
+            "serve_protocol_errors_total"] == 1
+    finally:
+        srv.close()
+
+
+# -- PS-backed serving: cache + invalidation over real sockets ---------------
+
+
+def test_ps_backed_server_cache_and_write_invalidation(rng):
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", {},
+                           row_leaves=serve.fm_ps_row_leaves(K),
+                           row_dim=ROW_DIM),
+        ps=PSClient(svc.address, ROW_DIM), max_batch=16, max_wait_us=100,
+        queue_cap=64, deadline_ms=5000, cache_capacity=F,
+    )
+    cli = None
+    try:
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=4)
+        np.testing.assert_allclose(cli.predict(b), _forward(params, b),
+                                   atol=2e-3)
+        st0 = srv.cache.stats()
+        assert st0["misses"] > 0 and st0["hits"] == 0
+        # the same uids again: all rows served from the cache
+        np.testing.assert_allclose(cli.predict(b), _forward(params, b),
+                                   atol=2e-3)
+        st1 = srv.cache.stats()
+        assert st1["hits"] == st0["misses"]
+        assert st1["misses"] == st0["misses"]
+
+        # a PS write moves write_version; refresh drops the cache and the
+        # NEXT predict serves the updated rows
+        new_rows = rows.copy()
+        new_rows[:, 0] += 1.0   # shift every w: scores must move
+        admin.preload_arrays(keys, new_rows)
+        assert srv.refresh_version()
+        assert srv.cache.stats()["invalidations"] == 1
+        new_params = {"w": params["w"] + 1.0, "v": params["v"]}
+        np.testing.assert_allclose(cli.predict(b),
+                                   _forward(new_params, b), atol=2e-3)
+
+        # query traffic must NOT grow the training store: fids the
+        # trainer never touched come back as zero rows (zero
+        # contribution) via the read-only pull instead of allocating
+        n_keys_before = store.stats()["n_keys"]
+        junk = {"fids": np.full((1, 3), F + 1000, np.int32),
+                "vals": np.ones((1, 3), np.float32)}
+        s = cli.predict(junk)
+        np.testing.assert_allclose(s, [0.5], atol=1e-3)  # sigmoid(0)
+        assert store.stats()["n_keys"] == n_keys_before
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.close()
+        admin.close()
+        svc.close()
+
+
+# -- latency SLO detector ----------------------------------------------------
+
+
+def test_latency_slo_detector_degrades_and_recovers():
+    det = health_mod.LatencySLODetector(p99_slo_s=0.05, min_count=10)
+    ok, _ = det.check({"latency_quantiles":
+                       {"p50": 0.01, "p99": 0.03, "count": 100}})
+    assert ok == health_mod.OK
+    st, detail = det.check({"latency_quantiles":
+                            {"p50": 0.02, "p99": 0.08, "count": 100}})
+    assert st == health_mod.DEGRADED and detail["p99_s"] == 0.08
+    st, _ = det.check({"latency_quantiles":
+                       {"p50": 0.05, "p99": 0.2, "count": 100}})
+    assert st == health_mod.UNHEALTHY
+    # a thin window is noise, not a verdict
+    st, detail = det.check({"latency_quantiles":
+                            {"p50": 1.0, "p99": 1.0, "count": 3}})
+    assert st == health_mod.OK and "skipped" in detail
+
+
+def test_latency_slo_registered_and_fed_by_server(rng):
+    assert "latency_slo" in health_mod.KNOWN_DETECTORS
+    params = fm.init(jax.random.PRNGKey(6), F, K)
+    reg = obs.MetricsRegistry()
+    hm = health_mod.HealthMonitor(component="serve_test", registry=reg)
+    # min_count=1 so the per-batch feed windows (1-2 requests each in a
+    # sequential test) are judged rather than skipped as thin
+    hm.add_detector(health_mod.LatencySLODetector(p99_slo_s=1e-5,
+                                                  min_count=1))
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", params), max_batch=8, max_wait_us=100,
+        queue_cap=64, deadline_ms=5000, slo_feed_every=1, health=hm,
+    )
+    try:
+        cli = serve.PredictClient(srv.address)
+        for _ in range(40):   # every real request blows a 10us SLO
+            cli.predict(_batch(rng, n=1))
+        cli.close()
+        verdict = srv.health.verdict()
+        det = verdict["detectors"]["latency_slo"]
+        assert det["checks"] > 0
+        assert det["status"] in (health_mod.DEGRADED, health_mod.UNHEALTHY)
+        assert verdict["status"] != health_mod.OK
+        # and the verdict is on the ops plane: the monitor registered as
+        # a flight health provider, so /healthz carries the serve
+        # component with the latency_slo detail
+        from lightctr_tpu.obs import exporter
+
+        code, body = exporter.health_payload()
+        comp = body["components"].get("serve_test")
+        assert comp is not None
+        assert comp["detectors"]["latency_slo"]["status"] == det["status"]
+    finally:
+        srv.close()
+        hm.close()
+
+
+# -- acceptance: 2-process serving over real sockets -------------------------
+
+
+def test_two_process_serving_acceptance(tmp_path, rng):
+    """ISSUE 7 tier-1 smoke: a server PROCESS (PS shard + prediction
+    server + a deliberately slow overload server) and a replay client in
+    this process.  Asserts correct scores vs the in-process forward, a
+    cache hit on a repeated uid, an overload burst shed with the queue
+    bounded, and a ``serve/predict`` span stitched to the client trace."""
+    trace_dir = str(tmp_path / "traces")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LIGHTCTR_TRACE="1", LIGHTCTR_TRACE_DIR=trace_dir)
+    server_script = textwrap.dedent(
+        """
+        import sys
+        sys.path.insert(0, %r)
+        import numpy as np, jax
+        from lightctr_tpu import serve
+        from lightctr_tpu.dist.ps_server import (
+            ParamServerService, PSClient)
+        from lightctr_tpu.embed.async_ps import AsyncParamServer
+        from lightctr_tpu.models import fm
+        params = fm.init(jax.random.PRNGKey(5), %d, %d)
+        keys, rows = serve.fused_fm_rows(params)
+        store = AsyncParamServer(dim=%d, n_workers=1, seed=0)
+        svc = ParamServerService(store)
+        admin = PSClient(svc.address, %d)
+        admin.preload_arrays(keys, rows)
+        srv = serve.PredictionServer(
+            serve.ServingModel("fm", {},
+                               row_leaves=serve.fm_ps_row_leaves(%d),
+                               row_dim=%d),
+            ps=PSClient(svc.address, %d), max_batch=16, max_wait_us=100,
+            queue_cap=64, deadline_ms=5000, cache_capacity=4096)
+        slow = serve.PredictionServer(
+            serve.ServingModel("fm", params), max_batch=2,
+            max_wait_us=100, queue_cap=4, deadline_ms=2000,
+            score_delay_s=0.15)
+        print("ADDR", srv.address[1], slow.address[1], flush=True)
+        sys.stdin.read()   # serve until the parent closes stdin
+        """
+    ) % (REPO_ROOT, F, K, ROW_DIM, ROW_DIM, K, ROW_DIM, ROW_DIM)
+    proc = subprocess.Popen([sys.executable, "-c", server_script],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True, env=env)
+    try:
+        line = proc.stdout.readline().split()
+        assert line[0] == "ADDR", line
+        addr = ("127.0.0.1", int(line[1]))
+        slow_addr = ("127.0.0.1", int(line[2]))
+
+        params = fm.init(jax.random.PRNGKey(5), F, K)
+        trace.reset()
+        trace.configure(path=os.path.join(trace_dir, "trace-client.jsonl"),
+                        flush_every=1)
+        try:
+            with obs.override(True), trace.override_rate(1.0):
+                cli = serve.PredictClient(addr)
+                b = _batch(rng, n=4)
+                with trace.span("request/root"):
+                    scores = cli.predict(b)
+                # 1) correct scores vs the in-process forward
+                np.testing.assert_allclose(scores, _forward(params, b),
+                                           atol=2e-3)
+                # 2) a repeated uid batch hits the cache
+                with trace.span("request/root"):
+                    cli.predict(b)
+                st = cli.stats()
+                assert st["cache"]["hits"] > 0
+                assert st["cache"]["hit_rate"] > 0
+                cli.close()
+        finally:
+            trace.configure()
+            trace.reset()
+
+        # 3) overload burst against the slow server: bounded queue sheds,
+        # overload replies are counted server-side
+        shed, ok = [], []
+
+        def one(i):
+            c = serve.PredictClient(slow_addr)
+            try:
+                c.predict(_batch(np.random.default_rng(i), n=2))
+                ok.append(i)
+            except serve.ServerOverloaded:
+                shed.append(i)
+            finally:
+                c.close()
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in range(10)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert shed, "overload burst must shed"
+        slow_cli = serve.PredictClient(slow_addr)
+        stats = slow_cli.stats()
+        slow_cli.close()
+        counters = stats["telemetry"]["counters"]
+        assert counters.get(
+            obs.labeled("serve_shed_total", reason="queue_full"), 0
+        ) == len(shed)
+        assert stats["queue_rows"] <= stats["queue_cap"]
+
+        # 4) the server's serve/predict span stitches into the client
+        # trace (terminate first so the server process flushes its spans)
+        proc.stdin.close()
+        proc.wait(timeout=30)
+        spans = {}
+        for fpath in glob.glob(os.path.join(trace_dir, "trace-*.jsonl")):
+            for r in obs.read_jsonl(fpath):
+                if r.get("kind") == "span":
+                    spans[r["span"]] = r
+        roots = {s["span"] for s in spans.values()
+                 if s["name"] == "request/root"}
+        client_pids = {s["pid"] for s in spans.values()
+                       if s["name"] == "request/root"}
+        assert roots
+        stitched = 0
+        for s in spans.values():
+            if s["name"] != "serve/predict_batch" \
+                    or s["pid"] in client_pids:
+                continue
+            cur, hops = s, 0
+            while cur is not None and hops < 10:
+                if cur["span"] in roots:
+                    stitched += 1
+                    break
+                cur = spans.get(cur.get("parent"))
+                hops += 1
+        assert stitched >= 1, \
+            "no server serve/predict_batch span reached the client trace"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)

@@ -446,3 +446,118 @@ def test_hybrid_rs_overflow_falls_back_to_allgather(rng):
             np.asarray(sparse_tr.params[key]),
             np.asarray(dense_tr.params[key]), rtol=1e-4, atol=1e-5,
         )
+
+
+# -- hybrid trainer: sparse EF on fixed-range configs (ISSUE 7 satellite) --
+
+
+def _ef_fm_batch(seed, vals_scale=1.0, f=1 << 15, n_rows=128, nnz=4,
+                 labels=None):
+    r = np.random.default_rng(seed)
+    fids = r.integers(1, f, size=(n_rows, nnz)).astype(np.int32)
+    return {
+        "fids": fids,
+        "fields": np.zeros_like(fids),
+        "vals": vals_scale * np.ones((n_rows, nnz), np.float32),
+        "mask": np.ones((n_rows, nnz), np.float32),
+        "labels": (labels if labels is not None
+                   else (r.random(n_rows) > 0.5).astype(np.float32)),
+    }
+
+
+def _ef_trainer(params, mesh, crange, ef):
+    tr = SparseTableCTRTrainer(
+        params, fm.logits, TrainConfig(learning_rate=0.05),
+        sparse_tables={"w": ["fids"], "v": ["fids"]}, mesh=mesh,
+        compress_bits=8, compress_range=crange, compress_mode="uniform",
+        error_feedback=ef,
+    )
+    tr.health = None
+    return tr
+
+
+def test_hybrid_fixed_range_allocates_sparse_residual_state():
+    """Fixed float compress_range + error_feedback => per-table [n, vocab,
+    ...] EF carries in the opt state; dynamic range (never clips) and
+    EF-off configs allocate none."""
+    f = 1 << 15
+    params = fm.init(jax.random.PRNGKey(0), f, 8)
+    mesh = make_mesh(MeshSpec(data=2))
+    tr = _ef_trainer(params, mesh, 0.05, True)
+    assert tr._use_sparse_ef()
+    assert set(tr.opt_state["sres"]) == {"w", "v"}
+    assert tr.opt_state["sres"]["v"].shape == (2, f, 8)
+    assert tr.opt_state["sres"]["w"].shape == (2, f)
+    assert "sres" not in _ef_trainer(params, mesh, 0.05, False).opt_state
+    tr_dyn = SparseTableCTRTrainer(
+        params, fm.logits, TrainConfig(learning_rate=0.05),
+        sparse_tables={"w": ["fids"], "v": ["fids"]}, mesh=mesh,
+        compress_bits=8, compress_range="dynamic", error_feedback=True,
+    )
+    assert "sres" not in tr_dyn.opt_state
+
+
+def test_hybrid_fixed_range_ef_captures_clip_and_drains(rng):
+    """The trainer-level mirror of the collectives EF drain test: a batch
+    whose gradients blow past the fixed range leaves the clipped mass in
+    the residual; streaming the same ids afterwards delivers it (the
+    carry drains to sub-bucket noise) and the table ends up having moved
+    FURTHER than the no-EF run, whose clipped mass is simply lost."""
+    f = 1 << 15
+    spike = _ef_fm_batch(0, vals_scale=20.0,
+                         labels=np.ones(128, np.float32))
+    normal = _ef_fm_batch(0, vals_scale=1.0,
+                          labels=np.ones(128, np.float32))
+    params = fm.init(jax.random.PRNGKey(0), f, 8)
+    mesh = make_mesh(MeshSpec(data=2))
+    tr, tr_no = (_ef_trainer(params, mesh, 0.05, True),
+                 _ef_trainer(params, mesh, 0.05, False))
+    assert tr.exchange_policy == {}   # nothing traced yet
+    tr.train_step(spike)
+    tr_no.train_step(spike)
+    assert tr.exchange_policy == {"w": "sparse", "v": "sparse"}
+    res_after_spike = float(
+        np.abs(np.asarray(tr.opt_state["sres"]["w"])).max())
+    assert res_after_spike > 0.05, "clip mass must land in the carry"
+    for _ in range(11):
+        tr.train_step(normal)
+        tr_no.train_step(normal)
+    bucket_w = 2 * 0.05 / 256
+    res_final = float(np.abs(np.asarray(tr.opt_state["sres"]["w"])).max())
+    assert res_final <= 5 * bucket_w, (res_after_spike, res_final)
+    touched = np.unique(spike["fids"])
+    w0 = np.asarray(params["w"])
+    dw_ef = (np.asarray(tr.params["w"]) - w0)[touched]
+    dw_no = (np.asarray(tr_no.params["w"]) - w0)[touched]
+    # labels=1 spike pushes w UP; EF delivers the clipped remainder late,
+    # no-EF loses it — EF must have moved the touched rows further
+    assert dw_ef.mean() > dw_no.mean() * 1.2, (dw_ef.mean(), dw_no.mean())
+
+
+def test_hybrid_fixed_range_ef_tracks_exact_under_coarse_codec(rng):
+    """Parity under clipping/rounding: a coarse fixed-range codec (range
+    1.0 over ~1e-3 gradients, so every payload rounds to a ~0.004-wide
+    bucket) drifts far from the dense-psum trajectory WITHOUT EF; with
+    the carry the trainer tracks the exact trajectory several times
+    closer — the dense ring's clip-free bound, now on the sparse path."""
+    f = 1 << 15
+    batch = _ef_fm_batch(3)
+    params = fm.init(jax.random.PRNGKey(0), f, 8)
+    mesh = make_mesh(MeshSpec(data=2))
+    exact = CTRTrainer(params, fm.logits,
+                       TrainConfig(learning_rate=0.05), mesh=mesh)
+    exact.health = None
+    tr, tr_no = (_ef_trainer(params, mesh, 1.0, True),
+                 _ef_trainer(params, mesh, 1.0, False))
+    for _ in range(30):
+        exact.train_step(batch)
+        tr.train_step(batch)
+        tr_no.train_step(batch)
+    assert tr.exchange_policy == {"w": "sparse", "v": "sparse"}
+    touched = np.unique(batch["fids"])
+    for key in ("w", "v"):
+        err_ef = np.abs(np.asarray(tr.params[key])
+                        - np.asarray(exact.params[key]))[touched].mean()
+        err_no = np.abs(np.asarray(tr_no.params[key])
+                        - np.asarray(exact.params[key]))[touched].mean()
+        assert err_ef < 0.5 * err_no, (key, err_ef, err_no)

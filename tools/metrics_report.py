@@ -17,6 +17,11 @@ turns either into something readable:
       # -> health-plane report: transition timeline across every *.jsonl
       #    in a directory (one per process), final verdict per
       #    component/detector, anomaly-triggered flight bundles
+  python -m tools.metrics_report --serve STATS_OR_SNAPSHOT_JSON
+      # -> serving-plane report from a PredictionServer stats() dump (or
+      #    a bare registry snapshot): request/latency percentiles from
+      #    the serve histograms, shed totals by reason, micro-batch fill,
+      #    cache hit rate
 """
 
 from __future__ import annotations
@@ -33,6 +38,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
 from lightctr_tpu.obs import read_jsonl, render_prometheus  # noqa: E402
+from lightctr_tpu.obs.registry import histogram_quantile  # noqa: E402
 
 
 def _percentiles(values):
@@ -159,6 +165,85 @@ def summarize_health(records) -> dict:
     return report
 
 
+def _hist_summary(hist, unit_ms: bool = True) -> dict:
+    """Registry histogram dict -> {count, p50, p99} via the standard
+    bucket-interpolation estimator (obs.registry.histogram_quantile)."""
+    scale = 1e3 if unit_ms else 1.0
+    suffix = "_ms" if unit_ms else ""
+    out = {"count": hist.get("count", 0)}
+    if out["count"]:
+        out[f"p50{suffix}"] = round(histogram_quantile(hist, 0.5) * scale, 3)
+        out[f"p99{suffix}"] = round(histogram_quantile(hist, 0.99) * scale, 3)
+        out[f"mean{suffix}"] = round(
+            hist.get("sum", 0.0) / out["count"] * scale, 3)
+    return out
+
+
+def summarize_serve(doc: dict) -> dict:
+    """A PredictionServer ``stats()`` dump (or a bare registry snapshot)
+    -> serving report: latency/batch-fill percentiles from the serve
+    histograms, shed totals by reason, cache counters."""
+    snap = doc.get("telemetry", doc)
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    report: dict = {}
+    requests = {
+        k.split('op="', 1)[1].rstrip('"}'): v
+        for k, v in counters.items()
+        if k.startswith("serve_requests_total{")
+    }
+    if requests:
+        report["requests"] = requests
+    for name, key in (("predict_latency", "serve_predict_seconds"),
+                      ("score_time", "serve_score_seconds")):
+        if key in hists:
+            report[name] = _hist_summary(hists[key])
+    if "serve_batch_rows" in hists:
+        h = hists["serve_batch_rows"]
+        fill = {"count": h["count"]}
+        if h["count"]:
+            fill["mean_rows"] = round(h["sum"] / h["count"], 2)
+            fill["p50_rows"] = round(histogram_quantile(h, 0.5), 1)
+        report["batch_fill"] = fill
+    shed = {
+        k.split('reason="', 1)[1].rstrip('"}'): v
+        for k, v in counters.items()
+        if k.startswith("serve_shed_total{")
+    }
+    rows_total = counters.get("serve_rows_total", 0)
+    shed_rows = counters.get("serve_shed_rows_total", 0)
+    if shed or rows_total:
+        report["shed"] = {
+            "by_reason": shed,
+            "rows": shed_rows,
+            "rows_total": rows_total,
+            "shed_frac": round(shed_rows / rows_total, 4)
+            if rows_total else 0.0,
+        }
+    cache = doc.get("cache")
+    if cache is None:
+        # bare snapshot: rebuild the cache section from its counters
+        hits = counters.get("serve_cache_hits_total", 0)
+        misses = counters.get("serve_cache_misses_total", 0)
+        if hits or misses:
+            cache = {
+                "hits": hits, "misses": misses,
+                "hit_rate": round(hits / (hits + misses), 5)
+                if hits + misses else 0.0,
+                "invalidations": counters.get(
+                    "serve_cache_invalidations_total", 0),
+            }
+    if cache:
+        report["cache"] = cache
+    if "health" in doc:
+        report["health"] = {
+            "status": doc["health"].get("status"),
+            "latency_slo": (doc["health"].get("detectors") or {})
+            .get("latency_slo", {}).get("status"),
+        }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -170,6 +255,10 @@ def main(argv=None):
                     help="summarize health events (verdict timeline + "
                          "final states) from a JSONL file or a directory "
                          "of per-process JSONL logs")
+    ap.add_argument("--serve", metavar="STATS_JSON",
+                    help="summarize serve-side histograms and cache "
+                         "counters from a PredictionServer stats() dump "
+                         "or a bare registry snapshot")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -187,9 +276,18 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.serve:
+        with open(args.serve) as f:
+            doc = json.load(f)
+        report = summarize_serve(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
-        ap.error("give an event-log path, --prom SNAPSHOT_JSON, or "
-                 "--health PATH")
+        ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
+                 "--health PATH, or --serve STATS_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

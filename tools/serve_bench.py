@@ -1,0 +1,340 @@
+"""Serving-plane bench: traffic replay against a live PredictionServer.
+
+Measures the three numbers the serving plane promises (ISSUE 7 /
+docs/SERVING.md):
+
+  1. **QPS at a p99 latency budget** — closed-loop concurrency sweep
+     (each worker thread sends back-to-back over its own connection; the
+     server micro-batches across them), reporting the best sustained
+     row-QPS whose client-observed p99 stays within ``--budget-ms``.
+  2. **Cache hit rate** — a PS-row-backed cell replays a Zipf-skewed
+     request stream (the CTR head/tail shape) through the
+     HotEmbeddingCache in front of a real socket PS shard.
+  3. **Shed fraction vs offered load** — open-loop points at a fraction
+     and a MULTIPLE of the measured capacity: past saturation the
+     bounded queue + deadline drop turn excess load into overload
+     replies while the p99 of the ANSWERED requests stays bounded —
+     the knee the admission control exists to create.
+
+Emits ``SERVE_BENCH.json`` (stdout + file).  Synthetic model/traffic:
+no dataset needed, runs in any checkout.
+
+Run:  python -m tools.serve_bench [--budget-ms 50] [--duration 2.0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightctr_tpu.utils.devicecheck import pin_cpu_platform  # noqa: E402
+
+pin_cpu_platform(1)
+
+import jax  # noqa: E402
+
+from lightctr_tpu import serve  # noqa: E402
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient  # noqa: E402
+from lightctr_tpu.embed.async_ps import AsyncParamServer  # noqa: E402
+from lightctr_tpu.models import export, fm  # noqa: E402
+
+VOCAB = 1 << 14
+FACTOR = 8
+NNZ = 8
+ROW_DIM = 1 + FACTOR
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _pctl(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
+
+
+def _make_requests(n_requests: int, rows_per_req: int, seed: int = 0):
+    """Zipf-skewed id traffic (the CTR head/tail shape): a hot head that
+    should live in the cache, a long tail that should not evict it."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n_requests):
+        u = rng.random(size=(rows_per_req, NNZ))
+        fids = np.minimum((u ** 4 * VOCAB).astype(np.int64), VOCAB - 1)
+        reqs.append({
+            "fids": np.maximum(fids, 1).astype(np.int32),
+            "vals": np.ones((rows_per_req, NNZ), np.float32),
+        })
+    return reqs
+
+
+def _closed_loop(address, reqs, n_threads: int, duration_s: float):
+    """Back-to-back senders -> (achieved row QPS, latency list seconds,
+    ok count, shed count)."""
+    stop = time.monotonic() + duration_s
+    lats, counts = [], {"ok": 0, "shed": 0, "rows": 0}
+    lock = threading.Lock()
+
+    def worker(tid):
+        cli = serve.PredictClient(address)
+        rng = np.random.default_rng(tid)
+        my_lats, ok, shed, rows = [], 0, 0, 0
+        try:
+            while time.monotonic() < stop:
+                req = reqs[int(rng.integers(len(reqs)))]
+                t0 = time.perf_counter()
+                try:
+                    cli.predict(req)
+                    my_lats.append(time.perf_counter() - t0)
+                    ok += 1
+                    rows += req["fids"].shape[0]
+                except serve.ServerOverloaded:
+                    shed += 1
+        finally:
+            cli.close()
+        with lock:
+            lats.extend(my_lats)
+            counts["ok"] += ok
+            counts["shed"] += shed
+            counts["rows"] += rows
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+    return counts["rows"] / wall, lats, counts["ok"], counts["shed"]
+
+
+def _open_loop(address, reqs, offered_rps: float, duration_s: float,
+               n_threads: int = 16):
+    """Fixed-rate offered load (requests/s): a timed dispenser feeds a
+    worker pool; returns the point report."""
+    schedule_done = time.monotonic() + duration_s
+    interval = 1.0 / offered_rps
+    lats, counts = [], {"ok": 0, "shed": 0, "offered": 0}
+    lock = threading.Lock()
+    sem = threading.Semaphore(0)
+    stop = threading.Event()
+
+    def worker(tid):
+        cli = serve.PredictClient(address)
+        rng = np.random.default_rng(100 + tid)
+        my_lats, ok, shed = [], 0, 0
+        try:
+            while True:
+                sem.acquire()
+                if stop.is_set():
+                    break
+                req = reqs[int(rng.integers(len(reqs)))]
+                t0 = time.perf_counter()
+                try:
+                    cli.predict(req)
+                    my_lats.append(time.perf_counter() - t0)
+                    ok += 1
+                except serve.ServerOverloaded:
+                    shed += 1
+        finally:
+            cli.close()
+        with lock:
+            lats.extend(my_lats)
+            counts["ok"] += ok
+            counts["shed"] += shed
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    next_t = time.monotonic()
+    while time.monotonic() < schedule_done:
+        now = time.monotonic()
+        if now < next_t:
+            time.sleep(min(next_t - now, 0.002))
+            continue
+        counts["offered"] += 1
+        sem.release()
+        next_t += interval
+    # drain: let in-flight requests finish, then stop the pool
+    time.sleep(0.5)
+    stop.set()
+    for _ in threads:
+        sem.release()
+    for t in threads:
+        t.join()
+    answered = counts["ok"] + counts["shed"]
+    return {
+        "offered_rps": round(offered_rps, 1),
+        "offered": counts["offered"],
+        "answered": answered,
+        "ok": counts["ok"],
+        "shed": counts["shed"],
+        "shed_frac": round(counts["shed"] / answered, 4) if answered else 0.0,
+        "p50_ms": round(_pctl(lats, 50) * 1e3, 3),
+        "p99_ms": round(_pctl(lats, 99) * 1e3, 3),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-ms", type=float, default=50.0,
+                    help="p99 latency budget the closed loop reports "
+                         "sustained QPS against")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per measurement cell")
+    ap.add_argument("--rows-per-req", type=int, default=8)
+    ap.add_argument("--out", default="SERVE_BENCH.json")
+    args = ap.parse_args(argv)
+
+    import tempfile
+
+    _log("building + exporting the model ...")
+    params = fm.init(jax.random.PRNGKey(0), VOCAB, FACTOR)
+    art = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"),
+                       "model.npz")
+    export.save_compressed_npz(art, params, model="fm", pq_leaves=("v",),
+                               pq_parts=4, pq_clusters=64)
+    reqs = _make_requests(512, args.rows_per_req)
+
+    report = {
+        "config": {
+            "vocab": VOCAB, "factor": FACTOR, "nnz": NNZ,
+            "rows_per_req": args.rows_per_req,
+            "budget_ms": args.budget_ms, "duration_s": args.duration,
+        },
+    }
+
+    # ---- cell 1: local-model closed loop (capacity + QPS at budget) -----
+    model = serve.load_model(art)
+    srv = serve.PredictionServer(model, max_batch=256, max_wait_us=1000,
+                                 queue_cap=2048, deadline_ms=args.budget_ms,
+                                 slo_p99_s=args.budget_ms / 1e3)
+    warm = serve.PredictClient(srv.address)
+    warm.predict(reqs[0])
+    warm.close()
+    sweep = []
+    for n_threads in (1, 2, 4, 8):
+        qps, lats, ok, shed = _closed_loop(
+            srv.address, reqs, n_threads, args.duration)
+        cell = {"threads": n_threads, "row_qps": round(qps, 1),
+                "req_ok": ok, "req_shed": shed,
+                "p50_ms": round(_pctl(lats, 50) * 1e3, 3),
+                "p99_ms": round(_pctl(lats, 99) * 1e3, 3)}
+        _log(f"closed loop x{n_threads}: {cell}")
+        sweep.append(cell)
+    within = [c for c in sweep if c["p99_ms"] <= args.budget_ms]
+    report["closed_loop"] = sweep
+    report["qps_at_p99_budget"] = {
+        "budget_ms": args.budget_ms,
+        "row_qps": max((c["row_qps"] for c in within), default=0.0),
+        "req_qps": round(
+            max((c["row_qps"] for c in within), default=0.0)
+            / args.rows_per_req, 1),
+    }
+
+    srv_stats = srv.stats()
+    report["server_counters"] = {
+        k: v for k, v in srv_stats["telemetry"]["counters"].items()
+        if k.startswith("serve_")
+    }
+    report["health"] = {
+        "status": srv_stats["health"]["status"],
+        "latency_slo": srv_stats["health"]["detectors"].get("latency_slo"),
+    }
+    srv.close()
+
+    # ---- cell 2: open-loop offered-load points (shed engages past
+    # saturation, p99 of answered stays bounded).  A dedicated server
+    # with a PINNED per-batch scoring cost (score_delay_s — the bench
+    # knob) gives a known capacity the client pool can actually exceed,
+    # so the admission-control knee is measured deterministically rather
+    # than depending on how fast this host's XLA happens to be ----------
+    delay_s, ov_batch, ov_queue = 0.004, 32, 96
+    ov_srv = serve.PredictionServer(
+        model, max_batch=ov_batch, max_wait_us=500, queue_cap=ov_queue,
+        deadline_ms=args.budget_ms, score_delay_s=delay_s,
+        slo_p99_s=args.budget_ms / 1e3)
+    warm = serve.PredictClient(ov_srv.address)
+    warm.predict(reqs[0])
+    warm.close()
+    probe_qps, probe_lats, _, _ = _closed_loop(
+        ov_srv.address, reqs, 8, args.duration / 2)
+    ov_capacity_rps = probe_qps / args.rows_per_req
+    _log(f"overload server capacity ~{ov_capacity_rps:.0f} req/s")
+    open_points = []
+    for frac in (0.5, 3.0):
+        rate = max(2.0, ov_capacity_rps * frac)
+        # pool sized for the offered rate at shed-reply latency, capped:
+        # client pool and server share this process (and its GIL), so an
+        # oversized pool would measure interpreter thrash, not the server
+        n_threads = int(min(40, max(16, rate * (args.budget_ms / 1e3))))
+        point = _open_loop(ov_srv.address, reqs, rate, args.duration,
+                           n_threads=n_threads)
+        point["offered_over_capacity"] = round(frac, 2)
+        point["unsent"] = point["offered"] - point["answered"]
+        _log(f"open loop {frac}x: {point}")
+        open_points.append(point)
+    report["open_loop"] = {
+        "server": {"score_delay_ms": delay_s * 1e3, "max_batch": ov_batch,
+                   "queue_cap_rows": ov_queue,
+                   "deadline_ms": args.budget_ms,
+                   "capacity_req_s": round(ov_capacity_rps, 1)},
+        "points": open_points,
+    }
+    ov_srv.close()
+
+    # ---- cell 3: PS-row-backed serving with the hot-embedding cache -----
+    _log("PS-backed cell: shard + cache ...")
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    keys, rows = serve.fused_fm_rows(params)
+    admin.preload_arrays(keys, rows)
+    ps_model = serve.ServingModel(
+        "fm", {}, row_leaves=serve.fm_ps_row_leaves(FACTOR),
+        row_dim=ROW_DIM)
+    cache_srv = serve.PredictionServer(
+        ps_model, ps=PSClient(svc.address, ROW_DIM), max_batch=256,
+        max_wait_us=1000, queue_cap=2048, deadline_ms=max(
+            250.0, 5 * args.budget_ms),
+        cache_capacity=VOCAB // 8)
+    warm = serve.PredictClient(cache_srv.address)
+    warm.predict(reqs[0])
+    warm.close()
+    qps, lats, ok, shed = _closed_loop(
+        cache_srv.address, reqs, 4, args.duration)
+    cst = cache_srv.stats()
+    report["ps_backed"] = {
+        "row_qps": round(qps, 1),
+        "p99_ms": round(_pctl(lats, 99) * 1e3, 3),
+        "cache": cst["cache"],
+    }
+    report["cache_hit_rate"] = cst["cache"]["hit_rate"]
+    cache_srv.close()
+    admin.close()
+    svc.close()
+
+    sat = open_points[-1]
+    report["ok"] = bool(
+        report["qps_at_p99_budget"]["row_qps"] > 0
+        and sat["shed_frac"] > 0.05
+        and sat["p99_ms"] <= 3 * args.budget_ms
+        and report["cache_hit_rate"] > 0.3
+    )
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report, indent=1))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
