@@ -182,14 +182,17 @@ def restore(directory: str, step: Optional[int] = None, like: Any = None) -> Any
 
 
 def save_arrays(
-    directory: str, step: int, keys: np.ndarray, rows: np.ndarray
+    directory: str, step: int, keys: np.ndarray, rows: np.ndarray,
+    accums: Optional[np.ndarray] = None,
 ) -> str:
     """Crash-safe (tmp + fsync + atomic rename) snapshot of a PS shard's
     (keys, rows) — written on the shard's checkpoint cadence so the master
     can migrate a DEAD shard's rows to its ring successors
     (paramserver.h:309's missing backup, now closed).  Plain npz, no
     Orbax: the writer may be SIGKILLed at any byte, and the reader is a
-    different process."""
+    different process.  ``accums`` adds the shard's optimizer
+    accumulators so an elastic rebalance can migrate optimizer STATE,
+    not just rows (old snapshots without it stay readable)."""
     os.makedirs(directory, exist_ok=True)
     final = os.path.join(directory, f"rows_{int(step)}.npz")
     tmp = os.path.join(directory, f".rows_{int(step)}.tmp-{os.getpid()}.npz")
@@ -197,8 +200,14 @@ def save_arrays(
     rows = np.ascontiguousarray(rows, np.float32)
     if rows.shape[0] != keys.shape[0]:
         raise ValueError("keys/rows length mismatch")
+    arrays = {"keys": keys, "rows": rows, "step": np.int64(step)}
+    if accums is not None:
+        accums = np.ascontiguousarray(accums, np.float32)
+        if accums.shape != rows.shape:
+            raise ValueError("accums/rows shape mismatch")
+        arrays["accums"] = accums
     with open(tmp, "wb") as f:
-        np.savez(f, keys=keys, rows=rows, step=np.int64(step))
+        np.savez(f, **arrays)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, final)
@@ -206,14 +215,12 @@ def save_arrays(
     return final
 
 
-def load_latest_arrays(
-    directory: str,
-) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
-    """Newest readable ``rows_N.npz`` -> (step, keys, rows); None when the
-    directory holds none.  A torn/unreadable snapshot (crash mid-write
-    under a non-atomic filesystem, or a stray file) is SKIPPED with a
-    warning — restore walks back to the newest intact one instead of
-    crashing the rebalance that needs it."""
+def _load_latest(directory: str, with_accums: bool = True):
+    """Newest intact ``rows_N.npz`` -> (step, keys, rows, accums-or-None);
+    torn/unreadable snapshots are skipped with a warning.
+    ``with_accums=False`` skips reading the accumulator member entirely
+    (it is as large as ``rows`` — row-only restores must not pay double
+    the I/O and peak memory for an array they discard)."""
     if not os.path.isdir(directory):
         return None
     cands = []
@@ -228,13 +235,42 @@ def load_latest_arrays(
             with np.load(path) as z:
                 keys = np.asarray(z["keys"], np.int64)
                 rows = np.asarray(z["rows"], np.float32)
+                accums = (np.asarray(z["accums"], np.float32)
+                          if with_accums and "accums" in z.files else None)
             if rows.shape[0] != keys.shape[0]:
                 raise ValueError("keys/rows length mismatch")
-            return step, keys, rows
+            if accums is not None and accums.shape != rows.shape:
+                raise ValueError("accums/rows shape mismatch")
+            return step, keys, rows, accums
         except (OSError, ValueError, KeyError, EOFError,
                 zipfile.BadZipFile) as e:
             _LOG.warning("skipping torn shard snapshot %s: %s", path, e)
     return None
+
+
+def load_latest_arrays(
+    directory: str,
+) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+    """Newest readable ``rows_N.npz`` -> (step, keys, rows); None when the
+    directory holds none.  A torn/unreadable snapshot (crash mid-write
+    under a non-atomic filesystem, or a stray file) is SKIPPED with a
+    warning — restore walks back to the newest intact one instead of
+    crashing the rebalance that needs it."""
+    out = _load_latest(directory, with_accums=False)
+    if out is None:
+        return None
+    step, keys, rows, _ = out
+    return step, keys, rows
+
+
+def load_latest_state(
+    directory: str,
+) -> Optional[Tuple[int, np.ndarray, np.ndarray, Optional[np.ndarray]]]:
+    """Like :func:`load_latest_arrays` but WITH the optimizer
+    accumulators: (step, keys, rows, accums) — ``accums`` is None for
+    snapshots written before the state-carrying format (the elastic
+    rebalance then falls back to row-only migration)."""
+    return _load_latest(directory)
 
 
 def gc_array_snapshots(directory: str, keep: int = 3) -> None:

@@ -34,7 +34,11 @@ from lightctr_tpu.dist.bootstrap import (
     HeartbeatMonitor,
 )
 from lightctr_tpu.dist.elastic import RoutingTable, plan_migration
-from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.dist.ps_server import (
+    ParamServerService,
+    ProtocolRejection,
+    PSClient,
+)
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import emit_event
 from lightctr_tpu.obs import flight as obs_flight
@@ -141,6 +145,11 @@ class MasterService:
         # acquires it itself, and _broadcast/_replay call it while already
         # holding it
         self._admin_lock = threading.RLock()
+        # shards that deterministically rejected a state-carrying op
+        # (old peer / store without the surface): later ranges and joins
+        # skip the doomed MIGRATE_STATE/SNAPSHOT_STATE attempt instead of
+        # re-shipping the full payload per range
+        self._no_state_ops: set = set()
         self.monitor = HeartbeatMonitor(
             stale_after_s=stale_after_s,
             dead_after_s=dead_after_s,
@@ -232,9 +241,24 @@ class MasterService:
                         except OSError:
                             pass
                         self._shards[i] = None
+                    if isinstance(e, ProtocolRejection):
+                        # deterministic rejection (old peer without the
+                        # op): retrying resends the identical doomed
+                        # frame — fail fast so the caller degrades
+                        break
         if telem:
             self.registry.inc("master_delivery_exhausted_total")
         return False, err
+
+    def _note_state_rejection(self, shard: int, err) -> bool:
+        """Memoize a DETERMINISTIC state-op rejection (old peer / store
+        without the surface) so later ranges and joins skip the doomed
+        MIGRATE_STATE/SNAPSHOT_STATE attempt; transient failures stay
+        retryable.  Returns True when the error WAS a rejection."""
+        if isinstance(err, ProtocolRejection):
+            self._no_state_ops.add(int(shard))
+            return True
+        return False
 
     def _deliver(self, i: int, op: str, wid: int, attempts: int = 3) -> bool:
         ok, _ = self._admin_rpc(
@@ -316,11 +340,16 @@ class MasterService:
         for i in members:
             self._admin_rpc(i, lambda c: c.grace(factor))
 
-    def _migrate_ranges(self, keys, rows, new_table, reason="shard_death"):
-        """Ship (keys, rows) to their owners under ``new_table`` with
-        per-range row-count + FNV read-back verification; appends one
+    def _migrate_ranges(self, keys, rows, new_table, reason="shard_death",
+                        accums=None):
+        """Ship (keys, rows[, accums]) to their owners under ``new_table``
+        with per-range row-count + FNV read-back verification; appends one
         record per range to ``self.migrations`` and returns
-        (all_verified, records)."""
+        (all_verified, records).  With ``accums`` the range rides
+        MSG_MIGRATE_STATE so the receiving shard lands optimizer STATE
+        next to its rows (the PR 6 follow-up: no more accumulator reset on
+        rebalance); an old shard that rejects the op degrades that range
+        to row-only MSG_MIGRATE, recorded as ``accums: False``."""
         records = []
         ok_all = True
         plan = plan_migration(keys, new_table)
@@ -329,13 +358,39 @@ class MasterService:
         for dst, dkeys in sorted(plan.items()):
             pos = np.searchsorted(sorted_keys, dkeys)
             drows = rows[order[pos]]
-            ok, rep = self._admin_rpc(
-                dst, lambda c: c.migrate_rows(dkeys, drows, new_table.epoch)
-            )
             rec = {
                 "dst": int(dst), "n": int(len(dkeys)), "reason": reason,
                 "epoch": int(new_table.epoch),
             }
+            ok, rep = False, None
+            state_failed_transient = False
+            if accums is not None and dst not in self._no_state_ops:
+                daccs = accums[order[pos]]
+                ok, rep = self._admin_rpc(
+                    dst, lambda c: c.migrate_state(
+                        dkeys, drows, daccs, new_table.epoch),
+                )
+                if not ok:
+                    if self._note_state_rejection(dst, rep):
+                        logging.getLogger(__name__).warning(
+                            "shard %d rejected MSG_MIGRATE_STATE (%s): "
+                            "degrading range to row-only migration "
+                            "(accumulators reset on the receiver)",
+                            dst, rep,
+                        )
+                    else:
+                        # transient failure against a (presumably)
+                        # state-capable shard: do NOT silently land the
+                        # range rows-only — record it failed, so the
+                        # episode retries with optimizer state intact
+                        state_failed_transient = True
+            if not ok and not state_failed_transient:
+                ok, rep = self._admin_rpc(
+                    dst,
+                    lambda c: c.migrate_rows(dkeys, drows, new_table.epoch),
+                )
+                if ok:
+                    rep.setdefault("accums", False)
             if ok:
                 rec.update(rep)
             else:
@@ -348,6 +403,10 @@ class MasterService:
                     "master_migrated_rows_total", verified=str(
                         bool(rec.get("verified"))).lower(),
                 ), len(dkeys))
+                if rec.get("accums"):
+                    self.registry.inc(
+                        "master_migrated_accum_rows_total", len(dkeys)
+                    )
         self.migrations.extend(records)
         return ok_all, records
 
@@ -411,19 +470,23 @@ class MasterService:
             return verified
 
     def _shard_ckpt_source(self, shard: int):
-        """(keys, rows) from the dead shard's newest intact snapshot under
-        ``ckpt_dir/shard_<i>`` — the migration source when the process is
-        gone.  Empty when no checkpoint exists (rows are then lazily
-        re-initialized by their new owners, counted as lost)."""
+        """(keys, rows, accums-or-None) from the dead shard's newest intact
+        snapshot under ``ckpt_dir/shard_<i>`` — the migration source when
+        the process is gone.  Empty when no checkpoint exists (rows are
+        then lazily re-initialized by their new owners, counted as lost);
+        ``accums`` is None for snapshots written before the state-carrying
+        format (the rebalance then degrades to row-only migration)."""
+        empty = (np.zeros(0, np.int64),
+                 np.zeros((0, self.dim), np.float32), None)
         if self.ckpt_dir is None:
-            return np.zeros(0, np.int64), np.zeros((0, self.dim), np.float32)
-        out = ckpt_mod.load_latest_arrays(
+            return empty
+        out = ckpt_mod.load_latest_state(
             os.path.join(self.ckpt_dir, f"shard_{int(shard)}")
         )
         if out is None:
-            return np.zeros(0, np.int64), np.zeros((0, self.dim), np.float32)
-        _, keys, rows = out
-        return keys, rows
+            return empty
+        _, keys, rows, accums = out
+        return keys, rows, accums
 
     def _rebalance_drop(self, shard: int) -> bool:
         """A member shard died: migrate its rows (from its checkpoint) to
@@ -442,12 +505,12 @@ class MasterService:
         new_table = self.routing.without_shard(shard)
 
         def work():
-            keys, rows = self._shard_ckpt_source(shard)
+            keys, rows, accums = self._shard_ckpt_source(shard)
             if not len(keys):
                 emit_event("failover", action="migration_source_empty",
                            shard=shard)
             return self._migrate_ranges(
-                keys, rows, new_table, reason="shard_death",
+                keys, rows, new_table, reason="shard_death", accums=accums,
             )
 
         return self._rebalance_episode(
@@ -480,21 +543,41 @@ class MasterService:
             for donor in self.routing.members:
                 if donor == shard:
                     continue
-                ok, snap = self._admin_rpc(
-                    donor, lambda c: c.snapshot_arrays()
-                )
+                # donors snapshot WITH optimizer state when they can; an
+                # old donor without the op degrades to rows-only (the
+                # joiner's accumulators for those arcs restart at zero)
+                ok, daccs_all = False, None
+                if donor not in self._no_state_ops:
+                    ok, snap = self._admin_rpc(
+                        donor, lambda c: c.snapshot_state_arrays()
+                    )
+                    if not ok:
+                        self._note_state_rejection(donor, snap)
+                if ok:
+                    dkeys, drows, daccs_all = snap
+                else:
+                    logging.getLogger(__name__).warning(
+                        "donor %d has no state snapshot: join ranges "
+                        "degrade to row-only (the joiner's accumulators "
+                        "for those arcs restart at zero)", donor,
+                    )
+                    ok, snap = self._admin_rpc(
+                        donor, lambda c: c.snapshot_arrays()
+                    )
+                    if ok:
+                        dkeys, drows = snap
                 if not ok:
                     verified = False
                     records.append({"dst": int(shard), "donor": int(donor),
                                     "verified": False, "error": str(snap)})
                     continue
-                dkeys, drows = snap
                 moving = plan_migration(dkeys, joined).get(int(shard))
                 if moving is None or not len(moving):
                     continue
                 pos = np.searchsorted(dkeys, moving)
                 v, recs = self._migrate_ranges(
                     moving, drows[pos], joined, reason="shard_join",
+                    accums=None if daccs_all is None else daccs_all[pos],
                 )
                 for r in recs:
                     r["donor"] = int(donor)
@@ -696,6 +779,11 @@ class MasterService:
         replay anything still queued for every shard."""
         if not (0 <= shard < len(self._shards)):
             return
+        # a returning shard may be an UPGRADED process: forget any cached
+        # state-op rejection so the next rebalance probes it afresh (one
+        # extra doomed RPC at worst, vs silently resetting accumulators
+        # on a now-capable shard forever)
+        self._no_state_ops.discard(int(shard))
         with obs_trace.span("master/resync_shard", shard=shard), \
                 self._admin_lock:
             for w in sorted(self.monitor.dead_workers()):

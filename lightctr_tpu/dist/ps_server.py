@@ -91,6 +91,22 @@ MSG_GRACE = 14
 #   PREDICT_BATCH -> same frame, any B (client-side batching)
 MSG_PREDICT = 15
 MSG_PREDICT_BATCH = 16
+# optimizer-state-carrying admin ops (docs/TIERED_STORE.md — the PR 6
+# follow-up: an elastic rebalance migrates accumulators, not just rows):
+#   MIGRATE_STATE  -> varint([epoch]) ++ pack_rows(keys, rows) ++ fp32
+#                     accums in the same sorted-key order (exact bytes:
+#                     adagrad accums are unbounded, the fp16 row codec
+#                     would overflow them); the shard lands
+#                     rows AND accums (migrate_in_state) and replies JSON
+#                     {"n", "fnv", "epoch"} where fnv checksums the frame
+#                     rebuilt from rows+accums RE-READ from its store.
+#                     An old shard replies the protocol-error byte and the
+#                     master degrades to row-only MSG_MIGRATE.
+#   SNAPSHOT_STATE -> empty; reply pack_keys(keys) ++ fp32 rows ++ fp32
+#                     accums (admin op, exact bytes) — the donor-side
+#                     source of a state-carrying join migration.
+MSG_MIGRATE_STATE = 17
+MSG_SNAPSHOT_STATE = 18
 
 # wire-op names for the telemetry series (obs registry)
 _OP_NAMES = {
@@ -100,6 +116,8 @@ _OP_NAMES = {
     MSG_READMIT: "readmit", MSG_ROUTE: "route", MSG_MIGRATE: "migrate",
     MSG_EVICT: "evict", MSG_GRACE: "grace", MSG_PREDICT: "predict",
     MSG_PREDICT_BATCH: "predict_batch",
+    MSG_MIGRATE_STATE: "migrate_state",
+    MSG_SNAPSHOT_STATE: "snapshot_state",
 }
 
 # One garbage length prefix must not make the server buffer gigabytes before
@@ -179,6 +197,39 @@ def _keys_and_rows(payload: bytes, dim: int, dtype) -> Tuple[np.ndarray, np.ndar
     return keys, rows.reshape(len(keys), dim).astype(np.float32)
 
 
+def _pack_state_frame(keys: np.ndarray, rows: np.ndarray,
+                      accums: np.ndarray) -> bytes:
+    """The MIGRATE_STATE body: ``pack_rows(keys, rows)`` ++ EXACT fp32
+    accums in the same sorted-key order.  Both sides of the migration
+    build this frame from THEIR copy (source from the checkpoint,
+    destination from a store re-read) and FNV it — matching checksums
+    certify rows AND optimizer state landed.  Accums are fp32, not the
+    fp16 row codec: Adagrad accumulators are unbounded sums of g^2 (a
+    hot key easily exceeds fp16's 65504), so the lossy codec would ship
+    inf/truncated state that the checksum could not catch — both sides
+    would hash the same post-quantization bytes."""
+    return wire.pack_rows(keys, rows) + np.ascontiguousarray(
+        accums, np.float32
+    ).tobytes()
+
+
+def _unpack_state_frame(
+    payload: bytes, dim: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Inverse of :func:`_pack_state_frame` -> (keys, rows, accums); the
+    trailing bytes after the rows frame must be EXACTLY the fp32 accum
+    block (a dim-skewed peer fails loud, never half-parses)."""
+    keys, rows, consumed = wire.unpack_rows(payload, dim)
+    rest = payload[consumed:]
+    if len(rest) != 4 * len(keys) * dim:
+        raise ValueError(
+            f"state frame accum block is {len(rest)} bytes, expected "
+            f"{4 * len(keys) * dim} (peer dim skew?)"
+        )
+    accums = np.frombuffer(rest, np.float32).reshape(len(keys), dim).copy()
+    return keys, rows, accums
+
+
 class ParamServerService:
     """Threaded socket front-end over an :class:`AsyncParamServer` store.
     Listens on localhost TCP (or a caller-supplied bound socket); one thread
@@ -228,6 +279,11 @@ class ParamServerService:
             health.ensure_detector(obs_health.StalenessDetector(
                 slo=getattr(ps, "staleness_threshold", 10),
             ))
+            if getattr(ps, "feeds_tier_flow", False):
+                # a tiered store feeds tier_flow deltas every N pushes;
+                # without the detector the feed is silently discarded and
+                # hot-tier thrash never degrades the shard's verdict
+                health.ensure_detector(obs_health.TierThrashDetector())
         self.health = health
         # the store feeds its SSP ledger drift on every push
         ps.health = health
@@ -393,6 +449,54 @@ class ParamServerService:
                             send(struct.pack("<IB", len(body), 0) + body)
                             if telem:
                                 reg.inc("ps_migrated_rows_total", len(keys))
+                        elif msg_type == MSG_MIGRATE_STATE:
+                            hdr, hdr_len = wire.split_varint(payload, 1)
+                            epoch = int(hdr[0])
+                            keys, rows, accums = _unpack_state_frame(
+                                payload[hdr_len:], dim
+                            )
+                            if len(keys) and not (np.diff(keys) > 0).all():
+                                raise ValueError(
+                                    "migrate keys must be sorted unique"
+                                )
+                            # rows AND accumulators land together; the
+                            # read-back covers both, so the checksum
+                            # certifies optimizer state survived the
+                            # membership change (docs/TIERED_STORE.md).
+                            # A store without the state surface gets the
+                            # protocol-error reply — the master then
+                            # degrades to row-only MSG_MIGRATE.
+                            mig = getattr(self.ps, "migrate_in_state", None)
+                            if mig is None:
+                                raise ValueError(
+                                    "store has no migrate_in_state"
+                                )
+                            b_rows, b_accs = mig(keys, rows, accums)
+                            fnv = frame_checksum(
+                                _pack_state_frame(keys, b_rows, b_accs)
+                            )
+                            body = json.dumps({
+                                "n": int(len(keys)), "fnv": fnv,
+                                "epoch": epoch, "accums": True,
+                            }).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
+                            if telem:
+                                reg.inc("ps_migrated_rows_total", len(keys))
+                                reg.inc("ps_migrated_accum_rows_total",
+                                        len(keys))
+                        elif msg_type == MSG_SNAPSHOT_STATE:
+                            snap = getattr(
+                                self.ps, "snapshot_state_arrays", None
+                            )
+                            if snap is None:
+                                raise ValueError(
+                                    "store has no snapshot_state_arrays"
+                                )
+                            keys, rows, accs = snap()
+                            body = (wire.pack_keys(keys)
+                                    + rows.astype(np.float32).tobytes()
+                                    + accs.astype(np.float32).tobytes())
+                            send(struct.pack("<IB", len(body), 0) + body)
                         elif msg_type == MSG_EVICT:
                             keys = wire.unpack_keys(payload)
                             n = self.ps.evict_batch(keys)
@@ -483,6 +587,15 @@ class ParamServerService:
         self._peers = [(t, c) for t, c in self._peers if t.is_alive()]
 
 
+class ProtocolRejection(RuntimeError):
+    """The server answered the protocol-error byte: a DETERMINISTIC
+    rejection (unknown/unsupported op, malformed frame) — resending the
+    identical frame can never succeed, unlike a transient socket error.
+    Subclasses RuntimeError so existing broad handlers keep working;
+    callers that must distinguish (the master's degrade-to-row-only
+    migration paths) match on this type instead of the message text."""
+
+
 class PSClient:
     """Worker-side stub with the ShmAsyncParamServer protocol surface
     (``pull(keys, worker_epoch, worker_id)`` / ``push(worker_id, grads,
@@ -568,7 +681,7 @@ class PSClient:
         del reply_type  # replies reuse the length framing; type byte unused
         self.bytes_received += 5 + len(reply)
         if reply == b"\xff":
-            raise RuntimeError(
+            raise ProtocolRejection(
                 f"PS server rejected message type "
                 f"{getattr(self, '_inflight_type', '?')} (protocol skew)"
             )
@@ -783,6 +896,55 @@ class PSClient:
             and int(reply.get("fnv", -1)) == src_fnv
         )
         return reply
+
+    def migrate_state(
+        self, keys: np.ndarray, rows: np.ndarray, accums: np.ndarray,
+        epoch: int,
+    ) -> Dict:
+        """State-carrying migration (MSG_MIGRATE_STATE): ship sorted-unique
+        (keys, rows, accums) and verify the destination's read-back
+        checksum over BOTH — rows and optimizer state landed, end to end.
+        Raises RuntimeError against an old shard without the op (callers
+        degrade to :meth:`migrate_rows`)."""
+        keys_arr = np.ascontiguousarray(keys, np.int64)
+        r = np.asarray(rows, np.float32).reshape(-1, self.dim)
+        a = np.asarray(accums, np.float32).reshape(-1, self.dim)
+        if len(keys_arr) > 1 and not (np.diff(keys_arr) > 0).all():
+            raise ValueError("migrate_state keys must be sorted unique")
+        frame = _pack_state_frame(keys_arr, r, a)
+        src_fnv = frame_checksum(frame)
+        hdr = wire.pack_varint(np.array([int(epoch)], np.int64))
+        with obs_trace.span("ps_client/migrate_state",
+                            n_keys=int(keys_arr.size)):
+            reply = json.loads(
+                self._rpc(MSG_MIGRATE_STATE, hdr + frame).decode()
+            )
+        reply["src_fnv"] = src_fnv
+        reply["verified"] = (
+            int(reply.get("n", -1)) == int(keys_arr.size)
+            and int(reply.get("fnv", -1)) == src_fnv
+        )
+        return reply
+
+    def snapshot_state_arrays(
+        self,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Vectorized state snapshot -> (sorted keys, fp32 rows, fp32
+        accums) — the donor-side source of a state-carrying join
+        migration.  Raises RuntimeError against an old shard."""
+        reply = self._rpc(MSG_SNAPSHOT_STATE, b"")
+        keys, consumed = wire.split_keys(reply)
+        block = len(keys) * self.dim * 4
+        if len(reply) - consumed != 2 * block:
+            raise ValueError(
+                f"state snapshot carries {len(reply) - consumed} value "
+                f"bytes, expected {2 * block} (peer dim skew?)"
+            )
+        rows = np.frombuffer(reply[consumed:consumed + block], np.float32)
+        accs = np.frombuffer(reply[consumed + block:], np.float32)
+        n = len(keys)
+        return keys, rows.reshape(n, self.dim).copy(), \
+            accs.reshape(n, self.dim).copy()
 
     def evict(self, keys: np.ndarray) -> int:
         """Drop keys from this shard's store (rows migrated away must not
@@ -1327,17 +1489,19 @@ class ShardedPSClient:
             addr = list(self.addresses[i])
             c = self._ensure(i)
             if c is None:
-                out.append({"addr": addr, "down": True,
+                out.append({"shard": int(i), "addr": addr, "down": True,
                             "error": "unreachable (reconnect failed)"})
                 continue
             try:
                 st = c.stats()
+                st["shard"] = int(i)
                 st["addr"] = addr
                 st["down"] = False
                 out.append(st)
             except (ConnectionError, OSError, RuntimeError) as e:
                 self._mark_down(i)
-                out.append({"addr": addr, "down": True, "error": str(e)})
+                out.append({"shard": int(i), "addr": addr, "down": True,
+                            "error": str(e)})
         return out
 
     def cluster_health(self) -> Dict:

@@ -9,10 +9,16 @@ from lightctr_tpu.embed.table import (
     sparse_dcasgd_update,
 )
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.embed.ledger import FrequencyLedger
+from lightctr_tpu.embed.mmap_store import MmapRowStore
 from lightctr_tpu.embed.shm_ps import ShmAsyncParamServer
+from lightctr_tpu.embed.tiered import TieredEmbeddingStore
 
 __all__ = [
+    "FrequencyLedger",
+    "MmapRowStore",
     "ShmAsyncParamServer",
+    "TieredEmbeddingStore",
     "init_table",
     "init_adagrad_state",
     "init_dcasgd_state",

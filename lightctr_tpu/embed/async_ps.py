@@ -44,6 +44,7 @@ import numpy as np
 from lightctr_tpu.native import bindings
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import trace as obs_trace
+from lightctr_tpu.embed.ssp import SSPGateMixin
 from lightctr_tpu.obs.registry import MetricsRegistry
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
@@ -100,7 +101,7 @@ class _RowView:
                 yield k, self._arr()[slot]
 
 
-class AsyncParamServer:
+class AsyncParamServer(SSPGateMixin):
     """Sparse KV store with bounded-staleness async updates."""
 
     def __init__(
@@ -352,19 +353,6 @@ class AsyncParamServer:
 
     # -- protocol ----------------------------------------------------------
 
-    def _pull_gate(self, worker_epoch: int, worker_id: Optional[int]) -> bool:
-        """True when the pull may proceed; bumps reject/withhold counters."""
-        if worker_id is not None and worker_id in self._unrouted:
-            self.rejected_pulls += 1
-            return False
-        if (
-            worker_epoch > self.last_epoch_version
-            and self.staleness > self.staleness_threshold
-        ):
-            self.withheld_pulls += 1
-            return False
-        return True
-
     def pull(
         self, keys, worker_epoch: int, worker_id: Optional[int] = None
     ) -> Optional[Dict[int, np.ndarray]]:
@@ -437,24 +425,6 @@ class AsyncParamServer:
                 return rows
             slots = self._slots_create(keys_arr)
             return self._W[slots]
-
-    def _push_gate(self, worker_id: int, worker_epoch: int) -> bool:
-        """Routing + staleness-ledger bookkeeping (paramserver.h:189-205);
-        True when the push should apply."""
-        if worker_id in self._unrouted:
-            self.rejected_pushes += 1
-            return False
-        behind = self.last_epoch_version - worker_epoch
-        if self.staleness > 0 and worker_id == self.staleness_worker:
-            self.staleness = max(0, behind)
-        if behind > self.staleness:
-            self.staleness = behind
-            self.staleness_worker = worker_id
-        if worker_epoch + self.staleness_threshold < self.last_epoch_version:
-            self.dropped_pushes += 1
-            return False
-        self.last_epoch_version = max(self.last_epoch_version, worker_epoch)
-        return True
 
     def _apply(
         self, worker_id: int, slots: np.ndarray, g: np.ndarray
@@ -582,57 +552,31 @@ class AsyncParamServer:
                 self.write_version += 1
             return True
 
-    # -- liveness routing (master.h:202-262 / network.h:148-151) ------------
-
-    def unroute_worker(self, worker_id: int) -> None:
-        """Heartbeat declared the worker dead: delete its route.  Its pushes
-        and pulls are rejected until :meth:`readmit_worker`."""
-        with self._lock:
-            self._unrouted.add(int(worker_id))
-
-    def readmit_worker(self, worker_id: int) -> None:
-        """Returning node re-registered (master.h:80-82): restore its route.
-        Per-worker DCASGD shadow state was kept, exactly as the PS keeps
-        shadow_copies across re-registration."""
-        with self._lock:
-            self._unrouted.discard(int(worker_id))
-
     # -- elastic membership (rebalance support) -----------------------------
-
-    def set_staleness_grace(self, factor: float) -> None:
-        """Widen (or restore) the SSP staleness budget for the duration of
-        a rebalance: ``factor`` scales the BASE threshold (1.0 restores
-        it).  The widened budget is fed to the health plane's existing
-        staleness detector too — its SLO tracks the effective threshold,
-        so an in-flight rebalance reads as a grace window, not a false
-        staleness alarm (docs/ELASTICITY.md)."""
-        if factor < 1.0:
-            raise ValueError("grace factor must be >= 1.0")
-        with self._lock:
-            self.staleness_threshold = int(
-                round(self._base_staleness_threshold * factor)
-            )
-            eff = self.staleness_threshold
-        hm = self.health
-        if hm is not None:
-            # retune the existing detector instead of stacking a new one
-            det = hm.detector("staleness")
-            if det is not None:
-                det.slo = float(eff)
-        if obs_gate.enabled():
-            self.registry.gauge_set("ps_store_staleness_budget", eff)
 
     def migrate_in(self, keys: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """Apply migrated rows (preload semantics: overwrite, reset
-        accum/shadow — optimizer state does not survive a membership
-        change, the row values do) and return the rows RE-READ from the
-        store.  The read-back is what the migration protocol checksums:
-        a matching FNV certifies the rows landed in this store, not
-        merely that the bytes arrived."""
+        accum/shadow — the row-only migration op, MSG_MIGRATE) and return
+        the rows RE-READ from the store.  The read-back is what the
+        migration protocol checksums: a matching FNV certifies the rows
+        landed in this store, not merely that the bytes arrived."""
         self.preload_batch(keys, rows)
         with self._lock:
             slots = self._dict_slots(np.ascontiguousarray(keys, np.int64))
             return self._W[slots].copy()
+
+    def migrate_in_state(
+        self, keys: np.ndarray, rows: np.ndarray, accums: np.ndarray
+    ):
+        """Optimizer-state-carrying migration (MSG_MIGRATE_STATE): rows
+        AND their Adagrad/DCASGDA accumulators land together, and both are
+        re-read for the checksum verification — an elastic rebalance no
+        longer resets the receiving shard's optimizer state
+        (docs/ELASTICITY.md follow-up closed in docs/TIERED_STORE.md)."""
+        self.preload_batch(keys, rows, accums=accums)
+        with self._lock:
+            slots = self._dict_slots(np.ascontiguousarray(keys, np.int64))
+            return self._W[slots].copy(), self._acc[slots].copy()
 
     def evict_batch(self, keys: np.ndarray) -> int:
         """Remove keys from the store (rows migrated AWAY during a
@@ -658,16 +602,6 @@ class AsyncParamServer:
             self.registry.inc("ps_store_evicted_keys_total", n)
         return n
 
-    def attach_heartbeat(self, monitor) -> None:
-        """Wire a :class:`~lightctr_tpu.dist.bootstrap.HeartbeatMonitor` so
-        its death/recovery events drive routing: dead -> unroute, returning
-        beat -> readmit (shared wiring — see ``dist.bootstrap.wire_heartbeat``).
-        No upper id bound: push/pull accept any worker id here (n_workers
-        only sizes the DCASGD shadow copies)."""
-        from lightctr_tpu.dist.bootstrap import wire_heartbeat
-
-        wire_heartbeat(monitor, self)
-
     def preload(self, values: Dict[int, np.ndarray]) -> None:
         """Coordinator-side deterministic row init BEFORE workers start —
         the master's syncInitializer broadcast (same contract as
@@ -685,12 +619,15 @@ class AsyncParamServer:
         )
         self.preload_batch(keys, rows)
 
-    def preload_batch(self, keys: np.ndarray, rows: np.ndarray) -> None:
+    def preload_batch(self, keys: np.ndarray, rows: np.ndarray,
+                      accums: Optional[np.ndarray] = None) -> None:
         """Vectorized preload: rows[i] becomes the value of keys[i].
         Overwrites accum/shadow, not setdefault: a lazily-created key must
         not keep its stale random shadow/accum after the coordinator
         re-initializes the row (DCASGD compensation would pull toward the
-        discarded random init)."""
+        discarded random init).  ``accums`` sets the optimizer
+        accumulators alongside (the state-carrying migration path) instead
+        of resetting them."""
         with self._lock:
             keys_arr = np.ascontiguousarray(keys, np.int64)
             r = np.asarray(rows, np.float32).reshape(-1, self.dim)
@@ -708,7 +645,10 @@ class AsyncParamServer:
                     np.int64, count=miss.size,
                 )
             self._W[slots] = r
-            self._acc[slots] = 0.0
+            self._acc[slots] = (
+                0.0 if accums is None
+                else np.asarray(accums, np.float32).reshape(-1, self.dim)
+            )
             if self._needs_shadow:
                 self._shw[:, slots] = r
             if keys_arr.size:
@@ -724,12 +664,32 @@ class AsyncParamServer:
         """Counter snapshot for admin/monitoring surfaces (one authoritative
         implementation; the network PS serves this over MSG_STATS).
         ``pending_depth``/``key_cache_drift`` surface the sorted-lookup
-        snapshot's allocation backlog (PR 1's merge rule bounds both)."""
+        snapshot's allocation backlog (PR 1's merge rule bounds both).
+        The ``store`` section (rows / capacity / load factor /
+        bytes-resident) is the occupancy surface ``tools/metrics_report.py
+        --store`` renders — the same shape the tiered store reports, so
+        flat and tiered deployments read off one dashboard."""
         with self._lock:
             cache_len = (
                 len(self._key_cache[0]) if self._key_cache is not None else 0
             )
-            return {
+            # resident bytes: W + acc (+ the lazily-allocated shadows)
+            blocks = 2 + (self.n_workers if self._needs_shadow else 0)
+            store = {
+                "kind": "flat",
+                "rows": len(self._slot),
+                "capacity": self._cap,
+                "load_factor": (
+                    round(self._n / self._cap, 5) if self._cap else 0.0
+                ),
+                "bytes_resident": self._cap * self.dim * 4 * blocks,
+                "dim": self.dim,
+            }
+            # ONE lock hold for the whole dict: the snapshot must be
+            # internally consistent (gauges ride after release — registry
+            # work stays off the store lock)
+            out = {
+                "store": store,
                 "withheld_pulls": self.withheld_pulls,
                 "dropped_pushes": self.dropped_pushes,
                 "rejected_pulls": self.rejected_pulls,
@@ -750,16 +710,39 @@ class AsyncParamServer:
                 "key_cache_builds": self.key_cache_builds,
                 "key_cache_merges": self.key_cache_merges,
             }
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.gauge_set("ps_store_rows", store["rows"])
+            reg.gauge_set("ps_store_capacity_rows", store["capacity"])
+            reg.gauge_set("ps_store_bytes_resident",
+                          store["bytes_resident"])
+        return out
+
+    def _snapshot_slots(self):
+        """(sorted keys, their slots) — the shared enumeration under the
+        lock.  Caller holds the lock."""
+        keys = np.fromiter(
+            self._slot.keys(), np.int64, count=len(self._slot)
+        )
+        order = np.argsort(keys, kind="stable")
+        slots = np.fromiter(
+            self._slot.values(), np.int64, count=len(self._slot)
+        )[order]
+        return keys[order], slots
 
     def snapshot_arrays(self):
-        """Vectorized snapshot -> (sorted int64 keys, [n, dim] rows)."""
+        """Vectorized snapshot -> (sorted int64 keys, [n, dim] rows).
+        Row-only on purpose: the worker-facing MSG_SNAPSHOT path must not
+        pay an n*dim accumulator copy it would throw away."""
         with self._lock:
-            keys = np.fromiter(
-                self._slot.keys(), np.int64, count=len(self._slot)
-            )
-            order = np.argsort(keys, kind="stable")
-            keys = keys[order]
-            slots = np.fromiter(
-                self._slot.values(), np.int64, count=len(self._slot)
-            )[order]
+            keys, slots = self._snapshot_slots()
             return keys, self._W[slots]
+
+    def snapshot_state_arrays(self):
+        """Snapshot WITH optimizer state -> (sorted keys, rows, accums) —
+        the MSG_SNAPSHOT_STATE payload and the state-carrying checkpoint
+        source (elastic rebalance migrates accumulators instead of
+        resetting them)."""
+        with self._lock:
+            keys, slots = self._snapshot_slots()
+            return keys, self._W[slots], self._acc[slots]

@@ -371,6 +371,47 @@ class LatencySLODetector(Detector):
         return status, detail
 
 
+class TierThrashDetector(Detector):
+    """Tiered-store thrash: the hot tier cycling rows in and out faster
+    than it serves them means the working set no longer fits the fast
+    tier (embed/tiered.py feeds ``tier_flow`` deltas — promotions and
+    demotions since the last feed, over ``batches`` push batches).
+
+    Demotion churn per batch relative to the hot budget is the signal:
+    past ``thrash_ratio`` of the budget turning over EVERY batch the
+    verdict degrades (each fault pays a warm/cold round trip), past
+    ``hard_factor`` x that it is unhealthy — raise ``hot_rows`` or shrink
+    the touched set.  Windows with fewer than ``min_batches`` batches are
+    skipped (a single preload burst is not thrash)."""
+
+    name = "tier_thrash"
+    signals = ("tier_flow",)
+
+    def __init__(self, thrash_ratio: float = 0.5, hard_factor: float = 2.0,
+                 min_batches: int = 4):
+        self.thrash_ratio = float(thrash_ratio)
+        self.hard_factor = float(hard_factor)
+        self.min_batches = int(min_batches)
+
+    def check(self, signals):
+        flow = signals["tier_flow"]
+        batches = int(flow.get("batches", 0))
+        if batches < self.min_batches:
+            return OK, {"skipped": f"window {batches} < {self.min_batches}"}
+        budget = max(1, int(flow.get("budget", 1)))
+        churn = (int(flow.get("demotions", 0))
+                 + int(flow.get("promotions", 0))) / 2.0
+        per_batch = churn / batches / budget
+        detail = {"churn_per_batch": round(per_batch, 4),
+                  "thrash_ratio": self.thrash_ratio,
+                  "hot_rows": flow.get("hot_rows"), "budget": budget}
+        if per_batch > self.thrash_ratio * self.hard_factor:
+            return UNHEALTHY, detail
+        if per_batch > self.thrash_ratio:
+            return DEGRADED, detail
+        return OK, detail
+
+
 #: detector name -> class; the registry the lint in tests/test_obs.py
 #: checks every Detector subclass into (no silent dark detectors)
 KNOWN_DETECTORS = {
@@ -378,7 +419,7 @@ KNOWN_DETECTORS = {
     for cls in (
         NaNLossDetector, LossSpikeDetector, GradNormDetector,
         TableSkewDetector, StalenessDetector, HeartbeatGapDetector,
-        LatencySLODetector,
+        LatencySLODetector, TierThrashDetector,
     )
 }
 

@@ -168,6 +168,37 @@ class HotEmbeddingCache:
             reg.gauge_set("serve_cache_rejected", rejected)
         return admitted
 
+    # -- serve-start warm-up (docs/TIERED_STORE.md follow-up) ----------------
+
+    def warm_from_ledger(self, ledger, pull_fn, k: Optional[int] = None
+                         ) -> int:
+        """Pre-pull the top-``k`` keys of a shared
+        :class:`~lightctr_tpu.embed.ledger.FrequencyLedger` (the one the
+        tiered store / health plane already feed from training traffic)
+        so the first seconds of serve traffic hit a warm cache instead of
+        paying the cold-miss cliff.  ``pull_fn(sorted_uids)`` returns the
+        ``[n, dim]`` rows for the SORTED uid array (the read-only PS pull
+        the server wires in).  The ledger's counts are merged into this
+        cache's admission frequencies, so the warmed set also defends its
+        residency.  Returns rows warmed."""
+        k = self.capacity if k is None else min(int(k), self.capacity)
+        hot = ledger.top_k(k)
+        if not len(hot):
+            return 0
+        uids = np.sort(np.asarray(hot, np.int64))
+        rows = np.asarray(pull_fn(uids), np.float32).reshape(-1, self.dim)
+        if len(rows) != len(uids):
+            raise ValueError("warm-up pull returned misaligned rows")
+        counts = ledger.get(uids)
+        with self._lock:
+            freq = self._freq
+            for u, c in zip(uids.tolist(), counts.tolist()):
+                freq[u] = max(freq.get(u, 0.0), float(c))
+        warmed = self.insert(uids, rows)
+        if obs_gate.enabled():
+            self.registry.inc("serve_cache_warmed_rows_total", warmed)
+        return warmed
+
     # -- versioned invalidation ---------------------------------------------
 
     def set_version(self, version) -> bool:

@@ -495,6 +495,34 @@ class PredictionServer:
             "count": n,
         })
 
+    # -- serve-start cache warm-up ------------------------------------------
+
+    def warm_from_ledger(self, ledger, k: Optional[int] = None) -> int:
+        """Pre-pull the shared frequency ledger's top-``k`` keys into the
+        hot-embedding cache at serve start (read-only PS pulls — unknown
+        keys come back zero and allocate nothing in the training store).
+        Returns rows warmed; 0 when the server has no PS-backed cache or
+        the pull is withheld (warm-up is best-effort — a cold start is a
+        latency cliff, not an error)."""
+        if self.ps is None or self.cache is None:
+            return 0
+
+        def pull(uids: np.ndarray) -> np.ndarray:
+            with obs_trace.span("serve/warmup_pull", n_keys=int(uids.size)):
+                out = self.ps.pull_arrays(uids, worker_epoch=0,
+                                          worker_id=None, create=False)
+            if out is None:
+                raise ConnectionError("warm-up pull withheld/failed")
+            return out[1]
+
+        try:
+            return self.cache.warm_from_ledger(ledger, pull, k)
+        except (ConnectionError, OSError, RuntimeError, ValueError):
+            logging.getLogger(__name__).warning(
+                "serve cache warm-up failed; starting cold", exc_info=True,
+            )
+            return 0
+
     # -- PS write-version invalidation --------------------------------------
 
     def refresh_version(self) -> bool:

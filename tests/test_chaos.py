@@ -67,6 +67,26 @@ def test_chaos_smoke_kill9_rebalance_and_parity(tmp_path):
             "shard_dead", "shard_dropped"} <= set(rep["flight_actions"])
 
 
+def test_chaos_tiered_kill9_accums_survive(tmp_path):
+    """A TIERED adagrad shard is the victim (docs/TIERED_STORE.md): zero
+    row loss across all three tiers vs its last checkpoint (the snapshot
+    walks hot+warm+cold), and the Adagrad accumulators ride the
+    state-carrying migration instead of resetting on the receivers."""
+    kw = dict(steps=20, vocab=1024, store="tiered", updater="adagrad")
+    baseline = run_scenario("none", workdir=str(tmp_path / "base"), **kw)
+    rep = run_scenario("kill9", workdir=str(tmp_path / "kill9"), **kw)
+    _assert_acted(rep, baseline)
+    # the victim's hot budget was a fraction of its keyspace: rows really
+    # lived across tiers, and every one of them landed on a survivor
+    assert rep["hot_rows"] < rep["vocab"] // 2
+    assert rep["zero_row_loss"], rep
+    assert rep["migrated_rows"] == rep["dead_shard_ckpt_rows"] > 0
+    # optimizer state survived: the checkpoint held real (nonzero)
+    # accumulators and every death range verified over rows AND accums
+    assert rep["dead_shard_ckpt_accums_nonzero"]
+    assert rep["accums_migrated"], rep["migrations"]
+
+
 # ---------------------------------------------------------------------------
 # epoch atomicity: no pull/push ever splits one batch across two epochs
 

@@ -657,6 +657,50 @@ def test_every_health_detector_is_registered_and_series_declared():
         hm.close()
 
 
+def test_every_tier_series_is_declared_and_emitted():
+    """No dark tier counters: every ``tiered_*`` metric the tiered store
+    EMITS (a literal first argument of a registry ``inc``/``gauge_set``/
+    ``observe`` call, directly or through ``labeled(...)``) must be
+    declared in ``embed.tiered.TIER_SERIES`` — and every declared series
+    must actually be emitted (a stale declaration would document a metric
+    that no longer exists).  A tier-transition counter can therefore
+    never ship unregistered/undocumented."""
+    from lightctr_tpu.embed import tiered
+
+    src = (LIB_ROOT / "embed" / "tiered.py").read_text()
+    tree = ast.parse(src, filename="embed/tiered.py")
+
+    emitted = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "gauge_set", "observe")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                and arg.func.id == "labeled" and arg.args):
+            arg = arg.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("tiered_"):
+            emitted.add(arg.value)
+
+    declared = set(tiered.TIER_SERIES)
+    assert emitted, "no tiered_* emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "tiered_* series emitted but missing from TIER_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "TIER_SERIES declares series the store never emits "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(tiered.TIER_SERIES) == len(declared), \
+        "duplicate names in TIER_SERIES"
+
+
 # -- tools/metrics_report ----------------------------------------------------
 
 

@@ -1,0 +1,535 @@
+"""Tiered embedding store: flat parity, tier movement, crash safety.
+
+The contracts under test (docs/TIERED_STORE.md):
+
+  - a tiered store trained on the SAME stream as a flat
+    ``AsyncParamServer`` follows the identical trajectory (same seeded
+    lazy init, same updater math) whether a row lands hot, warm, or cold;
+  - promotion/demotion is deterministic under a fixed ledger seed;
+  - a dirty hot row's pushes are NEVER lost on demotion (write-back
+    ordering: persist tier-down BEFORE the slot is reused);
+  - the mmap cold tier survives a kill mid-append: reopen drops only the
+    torn records and rebuilds the index over the intact prefix;
+  - snapshot/restore round-trips equivalently through flat and tiered
+    stores (rows AND optimizer accumulators);
+  - a vocabulary 64x the hot-tier budget trains end-to-end with
+    convergence parity, and peak hot occupancy never exceeds the budget
+    (the tier-1 guard behind the occupancy gauges).
+"""
+
+import os
+import signal
+import time
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from lightctr_tpu.ckpt import checkpoint as ckpt_mod
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.embed.ledger import FrequencyLedger
+from lightctr_tpu.embed.mmap_store import MmapRowStore, _rec_layout
+from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+
+def make_stream(vocab, batch, steps, skew=1.1, seed=0):
+    """Bounded-zipf id batches over a seeded rank permutation (the bench's
+    stream shape: hot ids scattered through the keyspace)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(vocab).astype(np.int64)
+    p = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** skew
+    p /= p.sum()
+    return [perm[rng.choice(vocab, size=batch, p=p)] for _ in range(steps)]
+
+
+def train_step(store, ids, step, target=None):
+    """One teaching-task pull/push cycle; returns the pulled rows.
+    Gradient = 0.1 * (row - target_row) per unique id — computed FROM the
+    pulled rows, so two stores serving identical rows stay identical."""
+    rows = store.pull_batch(ids, worker_epoch=step, worker_id=0)
+    uniq, first = np.unique(ids, return_index=True)
+    urows = rows[first]
+    t = 0.0 if target is None else target[uniq]
+    store.push_batch(0, uniq, (0.1 * (urows - t)).astype(np.float32),
+                     worker_epoch=step)
+    return rows
+
+
+def tiered(tmp_path, dim, hot_rows, name="s", **kw):
+    return TieredEmbeddingStore(
+        dim=dim, hot_rows=hot_rows,
+        path=str(tmp_path / name / "store"), updater="adagrad",
+        n_workers=1, seed=0, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# flat parity: identical trajectory whatever tier a row lives in
+
+
+def test_flat_tiered_trajectory_parity(tmp_path):
+    """Same stream, same seed: every pulled row block and the final
+    snapshot (rows AND accumulators) match the flat store exactly — lazy
+    init consumes the rng in the same first-occurrence order and the
+    updater math is expression-identical on the hot, bypass, and fault
+    paths."""
+    dim, vocab = 8, 512
+    flat = AsyncParamServer(dim=dim, updater="adagrad", n_workers=1, seed=0)
+    t = tiered(tmp_path, dim, hot_rows=32)  # 1/16 residency
+    stream = make_stream(vocab, batch=96, steps=40)
+    for i, ids in enumerate(stream):
+        rf = train_step(flat, ids, i)
+        rt = train_step(t, ids, i)
+        np.testing.assert_array_equal(rf, rt)
+    fk, fr, fa = flat.snapshot_state_arrays()
+    tk, tr, ta = t.snapshot_state_arrays()
+    np.testing.assert_array_equal(fk, tk)
+    np.testing.assert_array_equal(fr, tr)
+    np.testing.assert_array_equal(fa, ta)
+    # the tiered run really exercised the tiers: rows were demoted and
+    # faulted back, not just hot-resident the whole time
+    snap = t.registry.snapshot()["counters"]
+    touched = len(np.unique(np.concatenate(stream)))
+    assert snap.get("tiered_creates_total", 0) == touched
+    assert (snap.get("tiered_warm_faults_total", 0)
+            + snap.get("tiered_cold_faults_total", 0)) > 0
+    assert t.peak_hot_rows <= 32
+    t.close()
+
+
+def test_duplicate_ids_and_dedup_pull_cover(tmp_path):
+    """Duplicate ids in a pull gather the same row; a push with
+    duplicate keys fails loud BEFORE mutating state (the flat store's
+    server-side contract)."""
+    t = tiered(tmp_path, dim=4, hot_rows=8)
+    ids = np.array([7, 3, 7, 7, 3], np.int64)
+    rows = t.pull_batch(ids, worker_epoch=0, worker_id=0)
+    np.testing.assert_array_equal(rows[0], rows[2])
+    np.testing.assert_array_equal(rows[1], rows[4])
+    before = t.pull_batch(np.array([3, 7], np.int64), 0, 0).copy()
+    with pytest.raises(ValueError, match="duplicate"):
+        t.push_batch(0, np.array([3, 3], np.int64),
+                     np.ones((2, 4), np.float32), worker_epoch=0)
+    np.testing.assert_array_equal(
+        t.pull_batch(np.array([3, 7], np.int64), 0, 0), before)
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# determinism: identical runs make identical tier decisions
+
+
+def test_promote_demote_determinism_fixed_seed(tmp_path):
+    """Two stores fed the identical stream under the same seed make the
+    same admission/demotion decisions batch for batch: identical
+    hot-resident key sets, identical tier counters, identical state."""
+    dim, vocab = 4, 256
+    stream = make_stream(vocab, batch=64, steps=30, seed=3)
+    stores = [tiered(tmp_path, dim, hot_rows=16, name=f"d{i}",
+                     ledger=FrequencyLedger(decay_every=10, top_cap=0))
+              for i in range(2)]
+    for i, ids in enumerate(stream):
+        a = train_step(stores[0], ids, i)
+        b = train_step(stores[1], ids, i)
+        np.testing.assert_array_equal(a, b)
+        hot_a = np.sort(stores[0]._slot_keys[stores[0]._slot_keys >= 0])
+        hot_b = np.sort(stores[1]._slot_keys[stores[1]._slot_keys >= 0])
+        np.testing.assert_array_equal(hot_a, hot_b)
+    ca = stores[0].registry.snapshot()["counters"]
+    cb = stores[1].registry.snapshot()["counters"]
+    tiered_counters = {k: v for k, v in ca.items() if k.startswith("tiered_")}
+    assert tiered_counters == {
+        k: v for k, v in cb.items() if k.startswith("tiered_")}
+    assert tiered_counters.get("tiered_demotions_total{to=\"warm\"}", 0) + \
+        tiered_counters.get("tiered_demotions_total{to=\"cold\"}", 0) + \
+        tiered_counters.get("tiered_demotions_total{to=\"none\"}", 0) > 0
+    for s in stores:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# write-back ordering: no lost push on demotion
+
+
+def test_no_lost_push_on_demotion(tmp_path):
+    """A dirty hot row demoted to make room keeps its pushed updates:
+    the write-back lands tier-down BEFORE the slot is recycled.  The flat
+    store mirrors every operation, so 'kept' is exact equality."""
+    dim = 4
+    t = tiered(tmp_path, dim, hot_rows=4)
+    flat = AsyncParamServer(dim=dim, updater="adagrad", n_workers=1, seed=0)
+    first = np.arange(4, dtype=np.int64)  # fills the hot tier
+    for s in (t, flat):
+        s.pull_batch(first, worker_epoch=0, worker_id=0)
+        s.push_batch(0, first, np.full((4, dim), 0.5, np.float32),
+                     worker_epoch=0)
+    # hammer a disjoint key set until its frequency clears the admission
+    # margin and the dirty residents demote
+    others = np.arange(100, 104, dtype=np.int64)
+    for i in range(1, 12):
+        for s in (t, flat):
+            s.pull_batch(others, worker_epoch=i, worker_id=0)
+            s.push_batch(0, others, np.full((4, dim), 0.1, np.float32),
+                         worker_epoch=i)
+    c = t.registry.snapshot()["counters"]
+    demoted = sum(v for k, v in c.items()
+                  if k.startswith("tiered_demotions_total"))
+    assert demoted >= 4, c
+    assert c.get("tiered_writeback_rows_total", 0) >= 4
+    # the demoted rows (and their Adagrad accumulators) read back exactly
+    # what the flat store holds — nothing was lost in the move
+    tk, tr, ta = t.snapshot_state_arrays()
+    fk, fr, fa = flat.snapshot_state_arrays()
+    np.testing.assert_array_equal(tk, fk)
+    np.testing.assert_array_equal(tr, fr)
+    np.testing.assert_array_equal(ta, fa)
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# cold tier crash safety: kill mid-append, reopen, index rebuilds
+
+
+def test_mmap_torn_tail_recovery(tmp_path):
+    """Bytes torn off the tail (a writer killed mid-append) cost exactly
+    the torn records: reopen keeps every intact row, truncates the wreck,
+    and the store appends cleanly again."""
+    path = str(tmp_path / "cold.log")
+    st = MmapRowStore.create(path, width=4)
+    keys = np.arange(10, dtype=np.int64)
+    rows = np.arange(40, dtype=np.float32).reshape(10, 4)
+    st.set_batch(keys, rows)
+    rec_bytes, _ = _rec_layout(4)
+    st.close()
+    # simulate the torn append: one garbage full record slot then a
+    # half-written record at the tail (the interrupted batch's wreckage)
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        f.write(b"\x5a" * (rec_bytes + rec_bytes // 2))
+    st = MmapRowStore.open(path)
+    assert st.recovered_records == 10
+    assert st.dropped_records >= 1
+    # the wreck was truncated: the file ends on a record boundary again
+    assert (os.path.getsize(path) - 16) % rec_bytes == 0
+    got, found = st.get_batch(keys)
+    assert found.all()
+    np.testing.assert_array_equal(got, rows)
+    # still writable after recovery
+    st.set_batch(np.array([99], np.int64), np.ones((1, 4), np.float32))
+    st.close()
+    st = MmapRowStore.open(path)
+    assert st.n_rows == 11
+    st.close()
+
+
+def test_mmap_torn_interior_record_recovery(tmp_path):
+    """An in-place update torn mid-write (bytes flipped INSIDE one
+    record) loses that row alone — every other record survives the
+    reopen."""
+    path = str(tmp_path / "cold.log")
+    st = MmapRowStore.create(path, width=4)
+    keys = np.arange(8, dtype=np.int64)
+    rows = np.arange(32, dtype=np.float32).reshape(8, 4)
+    st.set_batch(keys, rows)
+    rec_bytes, _ = _rec_layout(4)
+    st.close()
+    with open(path, "r+b") as f:  # tear record 3's row bytes
+        f.seek(16 + 3 * rec_bytes + 20)
+        f.write(b"\xff" * 8)
+    st = MmapRowStore.open(path)
+    assert st.dropped_records == 1
+    got, found = st.get_batch(keys)
+    intact = np.ones(8, bool)
+    intact[3] = False
+    np.testing.assert_array_equal(found, intact)
+    np.testing.assert_array_equal(got[intact], rows[intact])
+    st.close()
+
+
+def _append_forever(path, width, ready):
+    st = MmapRowStore.open_or_create(path, width)
+    k = 100
+    while True:
+        ks = np.arange(k, k + 64, dtype=np.int64)
+        st.set_batch(ks, np.full((64, width), float(k), np.float32))
+        k += 64
+        ready.value = k
+
+
+def test_mmap_kill9_mid_append_recovers(tmp_path):
+    """The real drill: SIGKILL a process mid-append-loop, reopen the
+    store, and every record up to the torn tail is intact — the crash
+    loses at most the interrupted batch, never the store."""
+    path = str(tmp_path / "cold.log")
+    st = MmapRowStore.create(path, width=4)
+    base_keys = np.arange(16, dtype=np.int64)
+    st.set_batch(base_keys, np.ones((16, 4), np.float32))
+    st.sync()
+    st.close()
+    ctx = mp.get_context("spawn")
+    ready = ctx.Value("l", 0)
+    p = ctx.Process(target=_append_forever, args=(path, 4, ready),
+                    daemon=True)
+    p.start()
+    deadline = time.monotonic() + 30
+    while ready.value < 1000 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ready.value >= 1000, "writer never got going"
+    os.kill(p.pid, signal.SIGKILL)
+    p.join(10)
+    st = MmapRowStore.open(path)
+    # the pre-kill durable prefix survived in full
+    got, found = st.get_batch(base_keys)
+    assert found.all()
+    np.testing.assert_array_equal(got, np.ones((16, 4), np.float32))
+    # and the appended batches recovered as a coherent prefix: every
+    # indexed key reads back the value its batch wrote
+    assert st.n_rows >= 16
+    ks = st.keys()
+    appended = ks[ks >= 100]
+    if len(appended):
+        rows, found = st.get_batch(appended.astype(np.int64))
+        assert found.all()
+        a = appended.astype(np.int64)
+        expect = 100 + ((a - 100) // 64) * 64
+        np.testing.assert_array_equal(rows[:, 0].astype(np.int64), expect)
+    st.close()
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore equivalence across store kinds
+
+
+def test_snapshot_restore_equivalence_flat_vs_tiered(tmp_path):
+    """A trained tiered store's state-carrying checkpoint restores into a
+    FLAT store and a fresh TIERED store equivalently: both continue
+    training in lockstep (rows and accumulators landed identically,
+    whatever tier held them)."""
+    dim, vocab = 8, 256
+    t = tiered(tmp_path, dim, hot_rows=16, name="src")
+    stream = make_stream(vocab, batch=64, steps=25, seed=1)
+    for i, ids in enumerate(stream):
+        train_step(t, ids, i)
+    keys, rows, accs = t.snapshot_state_arrays()
+    assert len(keys) > vocab // 2  # the stream's touched vocabulary
+    assert float(np.abs(accs).sum()) > 0  # real optimizer state
+    ckpt_dir = str(tmp_path / "ckpt")
+    ckpt_mod.save_arrays(ckpt_dir, 1, keys, rows, accums=accs)
+    t.close()
+    step, k2, r2, a2 = ckpt_mod.load_latest_state(ckpt_dir)
+    assert step == 1 and a2 is not None
+    flat = AsyncParamServer(dim=dim, updater="adagrad", n_workers=1, seed=9)
+    t2 = tiered(tmp_path, dim, hot_rows=16, name="dst")
+    flat.preload_batch(k2, r2, accums=a2)
+    t2.preload_batch(k2, r2, accums=a2)
+    # restored stores hold the checkpointed state exactly
+    np.testing.assert_array_equal(t2.snapshot_arrays()[1], rows)
+    # ... and train in lockstep from it (ids stay inside the restored
+    # vocabulary: no lazy creates, so rng divergence cannot enter)
+    cont = [keys[ids % len(keys)]
+            for ids in make_stream(vocab, batch=64, steps=10, seed=2)]
+    for i, ids in enumerate(cont):
+        rf = train_step(flat, ids, 100 + i)
+        rt = train_step(t2, ids, 100 + i)
+        np.testing.assert_array_equal(rf, rt)
+    fk, fr, fa = flat.snapshot_state_arrays()
+    tk, tr, ta = t2.snapshot_state_arrays()
+    np.testing.assert_array_equal(fr, tr)
+    np.testing.assert_array_equal(fa, ta)
+    t2.close()
+
+
+# ---------------------------------------------------------------------------
+# the scale unlock: vocab >= 64x the hot budget, budget never exceeded
+
+
+def _train_64x(tmp_path, vocab, hot_rows, batch, steps):
+    dim = 8
+    rng = np.random.default_rng(11)
+    target = rng.normal(size=(vocab, dim)).astype(np.float32)
+    flat = AsyncParamServer(dim=dim, updater="adagrad", n_workers=1, seed=0)
+    t = tiered(tmp_path, dim, hot_rows=hot_rows)
+    stream = make_stream(vocab, batch, steps, seed=5)
+
+    def mse(store):
+        uniq = np.unique(np.concatenate(stream))
+        rows = store.pull_batch(uniq, worker_epoch=steps, worker_id=0)
+        return float(np.mean((rows - target[uniq]) ** 2))
+
+    for i, ids in enumerate(stream):
+        rf = train_step(flat, ids, i, target=target)
+        rt = train_step(t, ids, i, target=target)
+        np.testing.assert_array_equal(rf, rt)
+    return flat, t, mse
+
+
+def test_vocab_64x_budget_trains_with_parity(tmp_path):
+    """Tier-1 guard: a vocabulary 64x the hot-tier row budget trains end
+    to end with exact convergence parity vs the flat store, and peak hot
+    occupancy NEVER exceeds the configured budget — asserted from the
+    same occupancy gauges production monitors read."""
+    hot_rows, vocab = 32, 2048  # 64x
+    flat, t, mse = _train_64x(tmp_path, vocab, hot_rows, batch=128,
+                              steps=60)
+    m_flat, m_tiered = mse(flat), mse(t)
+    assert m_tiered == pytest.approx(m_flat, rel=1e-5)
+    st = t.stats()
+    tiers = st["store"]["tiers"]
+    n_rows = st["store"]["rows"]
+    assert n_rows == t.n_keys()  # cheap counter == enumerated truth
+    assert n_rows > 16 * hot_rows  # the stream's vocabulary dwarfs hot
+    assert tiers["hot"]["peak_rows"] <= hot_rows
+    assert tiers["hot"]["rows"] <= hot_rows
+    assert tiers["warm"]["rows"] + tiers["cold"]["rows"] >= n_rows - hot_rows
+    # the budget gauge pair the guard reads in production
+    g = t.registry.snapshot()["gauges"]
+    assert g["tiered_hot_row_budget"] == hot_rows
+    assert g["tiered_peak_hot_rows"] <= hot_rows
+    t.close()
+
+
+@pytest.mark.slow
+def test_criteo_scale_tiered_convergence(tmp_path):
+    """Criteo-scale cell: 2^15 vocab at 1/64 residency, longer stream —
+    same exact-parity and budget-held contracts as the tier-1 config."""
+    hot_rows, vocab = 512, 1 << 15
+    flat, t, mse = _train_64x(tmp_path, vocab, hot_rows, batch=1024,
+                              steps=200)
+    m_flat, m_tiered = mse(flat), mse(t)
+    assert m_tiered == pytest.approx(m_flat, rel=1e-5)
+    assert t.peak_hot_rows <= hot_rows
+    assert t.stats()["store"]["rows"] > 16 * hot_rows
+    t.close()
+
+
+# ---------------------------------------------------------------------------
+# serving-plane contracts: write_version, read-only pulls, eviction
+
+
+def test_write_version_bumps_on_tier_crossing_writes(tmp_path):
+    """Serving caches invalidate off ``write_version``: it must move on
+    EVERY write that can change a row a cache may hold — hot pushes,
+    bypass (in-place tier) pushes, preloads, and evictions."""
+    t = tiered(tmp_path, dim=4, hot_rows=2)
+    ids = np.arange(8, dtype=np.int64)  # 6 rows live below hot
+    t.pull_batch(ids, worker_epoch=0, worker_id=0)
+    v0 = t.write_version
+    t.push_batch(0, ids, np.ones((8, 4), np.float32), worker_epoch=0)
+    assert t.write_version > v0  # bypass pushes crossed tiers
+    v1 = t.write_version
+    t.preload_batch(np.array([3], np.int64), np.zeros((1, 4), np.float32))
+    assert t.write_version > v1
+    v2 = t.write_version
+    assert t.evict_batch(np.array([3], np.int64)) == 1
+    assert t.write_version > v2
+    t.close()
+
+
+def test_read_only_pull_never_creates_or_promotes(tmp_path):
+    """``create=False`` (serving traffic) reads rows from wherever they
+    reside: unknown keys return zero rows without growing the store, and
+    no admission/promotion happens — query traffic cannot thrash the
+    training residency."""
+    t = tiered(tmp_path, dim=4, hot_rows=2)
+    known = np.arange(4, dtype=np.int64)
+    t.pull_batch(known, worker_epoch=0, worker_id=0)
+    n0 = t.n_keys()
+    hot0 = np.sort(t._slot_keys[t._slot_keys >= 0]).copy()
+    mixed = np.array([0, 900, 2, 901], np.int64)
+    rows = t.pull_batch(mixed, worker_epoch=0, worker_id=0, create=False)
+    assert np.all(rows[[1, 3]] == 0.0)
+    assert np.any(rows[[0, 2]] != 0.0)
+    assert t.n_keys() == n0
+    np.testing.assert_array_equal(
+        np.sort(t._slot_keys[t._slot_keys >= 0]), hot0)
+    t.close()
+
+
+def test_evict_removes_from_every_tier(tmp_path):
+    """Eviction (the elastic handoff path) deletes a key wherever it
+    lives — hot slot, warm segment (dead-set masked), or cold log — and
+    a re-pull re-creates it fresh instead of resurrecting stale bytes."""
+    t = tiered(tmp_path, dim=4, hot_rows=2)
+    ids = np.arange(6, dtype=np.int64)
+    t.pull_batch(ids, worker_epoch=0, worker_id=0)
+    t.push_batch(0, ids, np.full((6, 4), 2.0, np.float32), worker_epoch=0)
+    assert t.n_keys() == 6
+    got = t.evict_batch(ids)
+    assert got == 6
+    assert t.n_keys() == 0
+    assert t.evicted_keys == 6
+    rows = t.pull_batch(ids, worker_epoch=1, worker_id=0, create=False)
+    assert np.all(rows == 0.0)
+    # the cheap arithmetic stats counter tracks the enumerated truth
+    # through the create -> evict cycle, and through preloads of BOTH
+    # unseen and already-known keys
+    assert t.stats()["store"]["rows"] == t.n_keys() == 0
+    t.preload_batch(np.array([1000, 1001], np.int64),
+                    np.ones((2, 4), np.float32))
+    t.preload_batch(np.array([1000], np.int64),
+                    np.zeros((1, 4), np.float32))  # known: no recount
+    t.pull_batch(np.array([7, 8], np.int64), worker_epoch=2, worker_id=0)
+    assert t.stats()["store"]["rows"] == t.n_keys() == 4
+    t.close()
+
+
+def test_service_installs_and_feeds_tier_thrash_detector(tmp_path):
+    """A ParamServerService hosting a tiered store must install the
+    TierThrashDetector on the monitor it owns AND the store's tier_flow
+    feed must reach it — otherwise the thrash verdict promised by
+    docs/TIERED_STORE.md is dead code in every deployment."""
+    from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+
+    t = tiered(tmp_path, dim=4, hot_rows=2, health_feed_every=4)
+    svc = ParamServerService(t, port=0)
+    cli = PSClient(svc.address, 4)
+    try:
+        assert svc.health.detector("tier_thrash") is not None
+        for i in range(12):
+            ids = np.arange(8, dtype=np.int64)
+            cli.pull_arrays(ids, worker_epoch=i, worker_id=0)
+            cli.push_arrays(0, ids, np.ones((8, 4), np.float32),
+                            worker_epoch=i)
+        det = svc.health.verdict()["detectors"]["tier_thrash"]
+        assert det["checks"] > 0, "tier_flow feed never reached the detector"
+    finally:
+        cli.close()
+        svc.close()
+        t.close()
+
+
+# ---------------------------------------------------------------------------
+# ledger determinism + the shared-admission contract
+
+
+def test_ledger_counts_and_decay():
+    led = FrequencyLedger(decay_every=0, top_cap=64)
+    ids = np.array([5, 9, 5], np.int64)  # callers dedup; raw here on purpose
+    led.touch(np.unique(ids))
+    led.touch(np.array([5], np.int64))
+    assert led.freq(5) >= 2.0  # sketch counts are upper bounds
+    assert led.freq(9) >= 1.0
+    assert led.freq(1234567) == 0.0
+    top = led.top_k(2)
+    assert top[0] == 5
+    led.decay_now()
+    assert led.freq(5) == pytest.approx(1.0, abs=0.5)
+
+
+def test_shared_ledger_feeds_admission(tmp_path):
+    """A ledger pre-warmed by ANOTHER consumer (the serving cache's
+    traffic, say) steers the store's first admissions: keys already hot
+    in the shared ledger win hot slots over cold strangers."""
+    led = FrequencyLedger(decay_every=0, top_cap=0)
+    hot_keys = np.arange(4, dtype=np.int64)
+    for _ in range(50):
+        led.touch(hot_keys)
+    t = tiered(tmp_path, dim=4, hot_rows=4, ledger=led)
+    # one batch holding both the pre-warmed keys and 12 strangers: the
+    # free slots go to the highest-frequency candidates
+    ids = np.arange(16, dtype=np.int64)
+    t.pull_batch(ids, worker_epoch=0, worker_id=0)
+    resident = set(t._slot_keys[t._slot_keys >= 0].tolist())
+    assert resident == set(hot_keys.tolist())
+    t.close()

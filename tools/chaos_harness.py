@@ -74,20 +74,36 @@ def target_rows(vocab: int, dim: int, seed: int = 7) -> np.ndarray:
 
 
 def _shard_main(conn, shard_id, dim, n_workers, staleness, seed, port,
-                ckpt_dir):
+                ckpt_dir, store_kind="flat", updater="sgd", hot_rows=0):
     """One PS shard process: serve + beat to the master + checkpoint rows
-    on a cadence (the migration source if we die without a farewell).
+    AND optimizer accumulators on a cadence (the migration source if we
+    die without a farewell).  ``store_kind="tiered"`` backs the shard with
+    a :class:`TieredEmbeddingStore` (hot budget ``hot_rows``) so the drill
+    proves zero row loss across ALL tiers; ``updater="adagrad"`` makes the
+    accumulators meaningful, so the state-carrying migration is asserted
+    on real optimizer state, not zeros.
     Control pipe: "partition" (drop the socket, stop beating, stay alive),
     "heal" (re-listen on the same port, resume beating), "stop"."""
     from lightctr_tpu.dist.ps_server import ParamServerService
     from lightctr_tpu.embed.async_ps import AsyncParamServer
+    from lightctr_tpu.embed.tiered import TieredEmbeddingStore
 
-    # sgd: the teaching task contracts (w - target) by (1 - lr) per pass —
-    # geometric convergence whose endpoint is insensitive to optimizer
-    # state, which row migration deliberately does not carry
-    ps = AsyncParamServer(dim=dim, updater="sgd", learning_rate=0.5,
-                          n_workers=n_workers, staleness_threshold=staleness,
-                          seed=seed)
+    # sgd contracts (w - target) by (1 - lr) per pass — geometric
+    # convergence; adagrad's decaying steps land within the same parity
+    # tolerance over the drill's schedule (both runs share the updater)
+    if store_kind == "tiered":
+        tier_dir = os.path.join(ckpt_dir, f"tier_{shard_id}")
+        os.makedirs(tier_dir, exist_ok=True)
+        ps = TieredEmbeddingStore(
+            dim=dim, hot_rows=max(1, int(hot_rows)),
+            path=os.path.join(tier_dir, "store"), updater=updater,
+            learning_rate=0.5, n_workers=n_workers,
+            staleness_threshold=staleness, seed=seed,
+        )
+    else:
+        ps = AsyncParamServer(dim=dim, updater=updater, learning_rate=0.5,
+                              n_workers=n_workers,
+                              staleness_threshold=staleness, seed=seed)
     svc = ParamServerService(ps, port=port)
     conn.send(svc.address)
     master_addr = conn.recv()
@@ -113,8 +129,10 @@ def _shard_main(conn, shard_id, dim, n_workers, staleness, seed, port,
             time.sleep(CKPT_PERIOD_S)
             step += 1
             try:
-                k, r = ps.snapshot_arrays()
-                ckpt_mod.save_arrays(d, step, k, r)
+                # state-carrying snapshots: the rebalance migrates the
+                # victim's Adagrad accumulators instead of resetting them
+                k, r, a = ps.snapshot_state_arrays()
+                ckpt_mod.save_arrays(d, step, k, r, accums=a)
                 ckpt_mod.gc_array_snapshots(d, keep=3)
             except OSError:
                 pass
@@ -216,12 +234,16 @@ class _Cluster:
     """Spawn/teardown of shards + master + workers for one scenario run."""
 
     def __init__(self, n_shards, n_workers, dim, vocab, staleness,
-                 workdir, worker_procs=False):
+                 workdir, worker_procs=False, store_kind="flat",
+                 updater="sgd", hot_rows=0):
         self.dim, self.vocab = dim, vocab
         self.n_workers = n_workers
         self.n_data_shards = 2 * n_workers
         self.staleness = staleness
         self.workdir = workdir
+        self.store_kind = store_kind
+        self.updater = updater
+        self.hot_rows = hot_rows
         self.ckpt_dir = os.path.join(workdir, "ckpt")
         self.flight_dir = os.path.join(workdir, "flight")
         self.worker_procs = worker_procs
@@ -254,7 +276,8 @@ class _Cluster:
         p = self.ctx.Process(
             target=_shard_main,
             args=(child, i, self.dim, self.n_workers, self.staleness,
-                  100 + i, port, self.ckpt_dir),
+                  100 + i, port, self.ckpt_dir, self.store_kind,
+                  self.updater, self.hot_rows),
             daemon=True,
         )
         p.start()
@@ -390,20 +413,33 @@ def run_scenario(
     staleness: int = 50,
     workdir=None,
     keep_cluster=None,
+    store: str = "flat",
+    updater: str = "sgd",
+    hot_rows: int = 0,
 ) -> dict:
     """Run one fault drill end to end; returns the assertion-ready report.
     ``keep_cluster``: optional list that receives the live _Cluster (tests
     poke at it mid-run via threads).  ``scenario == "none"`` is the
-    unperturbed baseline."""
+    unperturbed baseline.  ``store="tiered"`` backs every shard with a
+    :class:`TieredEmbeddingStore` (hot budget ``hot_rows``, default
+    vocab // 6 — small enough that the victim's rows really live across
+    tiers); ``updater="adagrad"`` arms the accumulator-survival
+    assertions."""
     workdir = workdir or tempfile.mkdtemp(prefix=f"chaos_{scenario}_")
     victim = n_shards - 1  # ring arcs exist for every shard; any works
     worker_procs = scenario == "kill_worker"
+    if store == "tiered" and hot_rows <= 0:
+        hot_rows = max(16, vocab // 6)
     cl = _Cluster(n_shards, n_workers, dim, vocab, staleness, workdir,
-                  worker_procs=worker_procs)
+                  worker_procs=worker_procs, store_kind=store,
+                  updater=updater, hot_rows=hot_rows)
     if keep_cluster is not None:
         keep_cluster.append(cl)
     report = {"scenario": scenario, "steps": steps, "n_shards": n_shards,
-              "n_workers": n_workers, "vocab": vocab, "dim": dim}
+              "n_workers": n_workers, "vocab": vocab, "dim": dim,
+              "store": store, "updater": updater}
+    if store == "tiered":
+        report["hot_rows"] = hot_rows
     try:
         cl.preload(target_rows(vocab, dim) * 0.0)  # start at zero rows
         t0 = time.monotonic()
@@ -484,15 +520,26 @@ def run_scenario(
             m.get("n", 0) for m in cl.master.migrations))
         if scenario == "kill9":
             # zero row loss: everything the dead shard's last checkpoint
-            # held was landed (count + checksum verified per range)
-            src = ckpt_mod.load_latest_arrays(
+            # held was landed (count + checksum verified per range) — for
+            # a tiered victim the snapshot walks ALL THREE tiers, so this
+            # asserts nothing fell between hot, warm, and cold
+            src = ckpt_mod.load_latest_state(
                 os.path.join(cl.ckpt_dir, f"shard_{victim}"))
             report["dead_shard_ckpt_rows"] = 0 if src is None else len(src[1])
-            drop_rows = sum(
-                m.get("n", 0) for m in cl.master.migrations
-                if m.get("reason") == "shard_death" and m.get("verified"))
+            drop_recs = [
+                m for m in cl.master.migrations
+                if m.get("reason") == "shard_death" and m.get("verified")]
+            drop_rows = sum(m.get("n", 0) for m in drop_recs)
             report["zero_row_loss"] = (
                 src is not None and drop_rows == len(src[1]))
+            # accumulator survival (PR 6 follow-up): every death range rode
+            # MSG_MIGRATE_STATE (read-back checksum over rows AND accums),
+            # and the checkpointed accumulators were real training state
+            report["accums_migrated"] = bool(drop_recs) and all(
+                m.get("accums") for m in drop_recs)
+            report["dead_shard_ckpt_accums_nonzero"] = bool(
+                src is not None and src[3] is not None
+                and float(np.abs(src[3]).sum()) > 0.0)
         mse = cl.eval_mse()
         report["all_ranges_served"] = mse is not None
         report["mse"] = mse
@@ -550,28 +597,54 @@ def main(argv=None):
     ap.add_argument("--dim", type=int, default=8)
     ap.add_argument("--out", default="CHAOS_HARNESS.json",
                     help="also write the artifact here ('-' = stdout only)")
+    ap.add_argument("--store", default="flat", choices=("flat", "tiered"),
+                    help="shard store backing every scenario run")
+    ap.add_argument("--updater", default="sgd", choices=("sgd", "adagrad"))
+    ap.add_argument("--skip-tiered-cell", action="store_true",
+                    help="skip the extra tiered-victim adagrad kill9 cell "
+                         "appended to the 'all' matrix")
     args = ap.parse_args(argv)
 
     names = SCENARIOS if args.scenario == "all" else (args.scenario,)
     kw = dict(steps=args.steps, n_shards=args.shards, n_workers=args.workers,
-              vocab=args.vocab, dim=args.dim)
+              vocab=args.vocab, dim=args.dim, store=args.store,
+              updater=args.updater)
     _log("running unperturbed baseline")
     baseline = run_scenario("none", **kw)
     results = {"baseline": baseline, "scenarios": {}}
     failed = False
-    for name in names:
-        _log(f"running scenario {name}")
-        rep = run_scenario(name, **kw)
-        rep["parity"] = parity(rep, baseline)
+
+    def run_cell(cell_name, scenario_name, cell_kw, cell_baseline,
+                 extra_ok=()):
+        nonlocal failed
+        _log(f"running scenario {cell_name}")
+        rep = run_scenario(scenario_name, **cell_kw)
+        rep["parity"] = parity(rep, cell_baseline)
         ok = (rep.get("workers_finished") and rep.get("all_ranges_served")
               and rep.get("migrations_verified")
-              and rep["parity"]["parity"])
+              and rep["parity"]["parity"]
+              and all(rep.get(k) for k in extra_ok))
         rep["ok"] = bool(ok)
         failed = failed or not ok
-        results["scenarios"][name] = rep
-        _log(f"{name}: ok={ok} mse={rep.get('mse')} "
+        results["scenarios"][cell_name] = rep
+        _log(f"{cell_name}: ok={ok} mse={rep.get('mse')} "
              f"epoch={rep.get('final_epoch')} "
              f"migrated={rep.get('migrated_rows')}")
+
+    for name in names:
+        run_cell(name, name, kw, baseline)
+    if args.scenario == "all" and args.store == "flat" \
+            and not args.skip_tiered_cell:
+        # the tiered-victim cell (docs/TIERED_STORE.md): a tiered adagrad
+        # shard is SIGKILLed — zero row loss across all three tiers vs its
+        # last checkpoint, and the accumulators ride the migration
+        tkw = dict(kw, store="tiered", updater="adagrad")
+        _log("running tiered-store baseline")
+        tbase = run_scenario("none", **tkw)
+        results["baseline_tiered"] = tbase
+        run_cell("kill9_tiered", "kill9", tkw, tbase,
+                 extra_ok=("zero_row_loss", "accums_migrated",
+                           "dead_shard_ckpt_accums_nonzero"))
     results["ok"] = not failed
     if args.out and args.out != "-":
         with open(args.out, "w") as f:

@@ -22,6 +22,12 @@ turns either into something readable:
       #    a bare registry snapshot): request/latency percentiles from
       #    the serve histograms, shed totals by reason, micro-batch fill,
       #    cache hit rate
+  python -m tools.metrics_report --store STATS_JSON
+      # -> store-occupancy report from a PS stats() dump (one shard's
+      #    dict or a ShardedPSClient list): rows / capacity / load
+      #    factor / bytes resident for FLAT stores, plus per-tier
+      #    occupancy, hit/fault/demotion counters, and fault-path
+      #    latency for TIERED stores
 """
 
 from __future__ import annotations
@@ -244,6 +250,59 @@ def summarize_serve(doc: dict) -> dict:
     return report
 
 
+def summarize_store(doc) -> dict:
+    """PS ``stats()`` dump(s) -> store-occupancy report.  Accepts ONE
+    shard's stats dict or the list :meth:`ShardedPSClient.stats` returns
+    (down shards stay visible).  Flat and tiered stores share the
+    ``store`` section shape, so one dashboard covers both; a tiered shard
+    additionally reports per-tier occupancy and — when its telemetry
+    snapshot rides along — the tier-transition counters and fault-path
+    latency percentiles declared in ``embed.tiered.TIER_SERIES``."""
+    shards = doc if isinstance(doc, list) else [doc]
+    out_shards = []
+    totals = {"rows": 0, "bytes_resident": 0}
+    for i, st in enumerate(shards):
+        # prefer the REAL member id the sharded client stamps: under
+        # elastic membership the list holds only live members, so the
+        # enumerate position diverges from shard ids once any shard dies
+        entry: dict = {"shard": int(st.get("shard", i))}
+        if st.get("addr"):
+            entry["addr"] = st["addr"]
+        if st.get("down"):
+            entry["down"] = True
+            entry["error"] = st.get("error")
+            out_shards.append(entry)
+            continue
+        store = st.get("store")
+        if store is None:
+            entry["error"] = "stats carry no store section (old server?)"
+            out_shards.append(entry)
+            continue
+        entry.update(store)
+        totals["rows"] += int(store.get("rows", 0))
+        totals["bytes_resident"] += int(store.get("bytes_resident", 0))
+        if "ledger" in st:
+            entry["ledger"] = st["ledger"]
+        snap = st.get("telemetry") or {}
+        counters = snap.get("counters", {})
+        tiered = {k: v for k, v in counters.items()
+                  if k.startswith("tiered_")}
+        if tiered:
+            entry["tier_counters"] = tiered
+            hits = tiered.get("tiered_hot_hits_total", 0)
+            faults = (tiered.get("tiered_warm_faults_total", 0)
+                      + tiered.get("tiered_cold_faults_total", 0)
+                      + tiered.get("tiered_creates_total", 0))
+            if hits + faults:
+                entry["hot_hit_rate"] = round(hits / (hits + faults), 5)
+        hists = snap.get("histograms", {})
+        if "tiered_fault_seconds" in hists:
+            entry["fault_latency"] = _hist_summary(
+                hists["tiered_fault_seconds"])
+        out_shards.append(entry)
+    return {"shards": out_shards, "totals": totals}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -259,6 +318,10 @@ def main(argv=None):
                     help="summarize serve-side histograms and cache "
                          "counters from a PredictionServer stats() dump "
                          "or a bare registry snapshot")
+    ap.add_argument("--store", metavar="STATS_JSON",
+                    help="summarize store occupancy (flat AND tiered) "
+                         "from a PS stats() dump — one shard's dict or a "
+                         "ShardedPSClient.stats() list")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -285,9 +348,18 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.store:
+        with open(args.store) as f:
+            doc = json.load(f)
+        report = summarize_store(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
-                 "--health PATH, or --serve STATS_JSON")
+                 "--health PATH, --serve STATS_JSON, or --store STATS_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))
