@@ -38,11 +38,29 @@ def pvary(x, axis_names):
     return x
 
 
+def pallas_modules():
+    """``(pl, pltpu)`` — or ``(None, None)`` when this jax pin lacks the
+    Pallas machinery entirely.  Every Pallas call site in the repo resolves
+    its implementation through the kernel registry
+    (:mod:`lightctr_tpu.ops.sparse_kernels`), and the registry gates on
+    THIS probe: a pin without pallas degrades to the pure-XLA reference
+    twin instead of raising ImportError at import or trace time."""
+    try:
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        return pl, pltpu
+    except Exception:
+        return None, None
+
+
 def tpu_compiler_params(**kwargs):
     """``pltpu.CompilerParams`` across the rename (pre-0.6 jax calls the
-    same dataclass ``TPUCompilerParams``)."""
-    from jax.experimental.pallas import tpu as pltpu
-
+    same dataclass ``TPUCompilerParams``).  Returns ``None`` — the
+    ``pallas_call`` default — when the pin has no pltpu at all, so callers
+    already gated by :func:`pallas_modules` need no second guard."""
+    _, pltpu = pallas_modules()
+    if pltpu is None:
+        return None
     cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
     return cls(**kwargs)
 

@@ -63,7 +63,7 @@ def _ring_all_reduce_local(
     segs = flat.reshape(n, -1)
 
     if compress_bits is not None:
-        from lightctr_tpu.ops import quantize
+        from lightctr_tpu.ops import quantize, sparse_kernels
 
         use_ef = residual is not None
         res = (residual.reshape(n, -1) if use_ef
@@ -125,7 +125,8 @@ def _ring_all_reduce_local(
             val = jnp.take(segs, send_idx, axis=0)
             if use_ef:
                 val = val + jnp.take(res, send_idx, axis=0)
-            codes = quantize.compress(table, val)
+            # the ring codec's pack step rides the kernel registry
+            codes = sparse_kernels.quantize_pack(table, val)
             if use_ef:
                 res = res.at[send_idx].set(
                     val - quantize.extract(table, codes)
@@ -150,7 +151,7 @@ def _ring_all_reduce_local(
         own_val = jnp.take(segs, own, axis=0)
         if use_ef:
             own_val = own_val + jnp.take(res, own, axis=0)
-        own_codes = quantize.compress(table, own_val)
+        own_codes = sparse_kernels.quantize_pack(table, own_val)
         if use_ef:
             res = res.at[own].set(
                 own_val - quantize.extract(table, own_codes)
@@ -596,8 +597,8 @@ def _coded_exchange(
     1e-12 floor), encode, run ``exchange`` on the narrow codes, decode on
     the receiver.  Every coded sparse payload (allgather rows, rs buckets,
     rs merged shards) goes through here so the codec policy lives in one
-    place."""
-    from lightctr_tpu.ops import quantize
+    place (pack rides the kernel registry's ``quantize_pack``)."""
+    from lightctr_tpu.ops import quantize, sparse_kernels
 
     if compress_range == "dynamic":
         rng = 1.05 * jax.lax.pmax(jnp.max(jnp.abs(payload)), axis_name)
@@ -607,7 +608,9 @@ def _coded_exchange(
     table = quantize.build_table(
         -rng, rng, bits=compress_bits, mode=compress_mode,
     )
-    return quantize.extract(table, exchange(quantize.compress(table, payload)))
+    return quantize.extract(
+        table, exchange(sparse_kernels.quantize_pack(table, payload))
+    )
 
 
 def _ag_gather_ids(uids: jax.Array, axis_name: str):
@@ -615,12 +618,87 @@ def _ag_gather_ids(uids: jax.Array, axis_name: str):
     [K] id stream + the union/inverse mapping every member computes
     identically.  Split out so tables sharing one id stream (identical
     batch-field tuples) gather and dedup the ids ONCE — the row half
-    (:func:`_ag_merge_rows`) reuses ``inv`` per table."""
+    (:func:`_ag_merge_rows`) reuses ``inv`` per table.  The dedup routes
+    through the kernel registry (``ops.sparse_kernels.dedup_ids``): the
+    fused sort-free kernel on TPU, the identical ``jnp.unique`` contract
+    everywhere else."""
+    from lightctr_tpu.ops import sparse_kernels
+
     all_ids = jax.lax.all_gather(uids, axis_name, tiled=True)
-    uniq, inv = jnp.unique(
-        all_ids, return_inverse=True, size=all_ids.shape[0], fill_value=0
+    uniq, inv, _ = sparse_kernels.dedup_ids(all_ids)
+    return all_ids, uniq, inv
+
+
+def _ef_valid_mask(uids: jax.Array, like: jax.Array) -> jax.Array:
+    """Broadcastable validity mask over an id stream: every slot except
+    the padded id-0 repeats beyond slot 0 (the dedup convention) — pads
+    must never touch row 0's EF carry."""
+    k = uids.shape[0]
+    valid = ~((uids == 0) & (jnp.arange(k) > 0))
+    return valid.astype(like.dtype).reshape((-1,) + (1,) * (like.ndim - 1))
+
+
+def _ag_exchange_rows(
+    rows: jax.Array,
+    axis_name: str,
+    compress_bits: int | None = None,
+    compress_range: float | str = "dynamic",
+    compress_mode: str = "uniform",
+    uids: jax.Array | None = None,
+    residual: jax.Array | None = None,
+):
+    """Gather/decode half of the allgather sparse exchange (no merge, no
+    averaging — the caller owns the /n): every member's [K, ...] payload,
+    optionally quantile-coded, lands as [n*K, ...] decoded rows —
+    ``(all_rows, new_residual | None)``.  The hybrid trainer consumes
+    this directly and folds the merge (and the mean) into the fused
+    merge-apply kernel; :func:`_ag_merge_rows` wraps it for callers that
+    want the merged rows materialized.
+
+    ``residual``: [vocab, ...] per-member error-feedback table for CLIPPED
+    payloads under a FIXED ``compress_range`` (requires ``uids``): the
+    carried remainder is compensated into this step's encode and the fresh
+    clip+quantization error is scattered back at the rows' slots — the
+    compensate/encode/decode/error chain runs as ONE fused
+    ``quantize_pack_ef`` pass through the kernel registry."""
+    use_ef = residual is not None
+    if compress_bits is None:
+        if use_ef:
+            raise ValueError("sparse error feedback needs compress_bits")
+        return jax.lax.all_gather(rows, axis_name, tiled=True), None
+    if not use_ef:
+        return _coded_exchange(
+            rows,
+            lambda c: jax.lax.all_gather(c, axis_name, tiled=True),
+            axis_name, compress_bits, compress_range, compress_mode,
+        ), None
+    from lightctr_tpu.ops import quantize, sparse_kernels
+
+    if not isinstance(compress_range, (int, float)):
+        raise ValueError(
+            "sparse error feedback compensates FIXED-range clipping; "
+            "compress_range='dynamic' never clips — pass a float range"
+        )
+    if uids is None:
+        raise ValueError("sparse error feedback needs uids")
+    table = quantize.build_table(
+        -compress_range, compress_range,
+        bits=compress_bits, mode=compress_mode,
     )
-    return all_ids, uniq, inv.reshape(-1)
+    # every VALID slot (non-pad) compensates — including ids whose
+    # gradient is zero this step, so a carried clip remainder drains on
+    # the id's next appearance rather than waiting for a nonzero gradient.
+    mask = _ef_valid_mask(uids, rows)
+    carried = jnp.take(residual, uids, axis=0)
+    codes, delta = sparse_kernels.quantize_pack_ef(table, rows, carried, mask)
+    # fresh error (clip + quantization) back at the row's slot: an .add
+    # of the masked DELTA, so padded id-0 repeats and zero-row entries
+    # are no-ops on the carry
+    new_residual = residual.at[uids].add(delta)
+    all_rows = quantize.extract(
+        table, jax.lax.all_gather(codes, axis_name, tiled=True)
+    )
+    return all_rows, new_residual
 
 
 def _ag_merge_rows(
@@ -637,8 +715,9 @@ def _ag_merge_rows(
     residual: jax.Array | None = None,
 ):
     """Row half of the allgather sparse exchange: gather every member's
-    [K, ...] value payload (optionally quantile-coded) and segment_sum the
-    duplicates through the shared ``inv``.
+    [K, ...] value payload (optionally quantile-coded) and segment-merge
+    the duplicates through the shared ``inv`` (the merge rides the kernel
+    registry's ``merge_rows``).
 
     ``residual``: optional [vocab, ...] per-member error-feedback table for
     CLIPPED payloads under a FIXED ``compress_range`` (requires ``uids``).
@@ -646,61 +725,18 @@ def _ag_merge_rows(
     out-of-range values into systematic clipping — with EF the clipped
     remainder is carried at the row's table slot and re-enters the next
     encode of that row, so the loss becomes a delayed contribution (the
-    same clip-free bound the dense ring's EF mode has).  Every valid
-    (non-padded) id slot compensates — including ids with a zero gradient
-    this step, so a carried remainder drains the next time the id appears
-    in the stream; padded id-0 repeats leave row 0's carry untouched.
-    Returns ``(merged, new_residual)`` when a residual is given, else
-    ``merged``."""
-    use_ef = residual is not None
-    if compress_bits is not None:
-        from lightctr_tpu.ops import quantize
+    same clip-free bound the dense ring's EF mode has; see
+    :func:`_ag_exchange_rows`).  Returns ``(merged, new_residual)`` when a
+    residual is given, else ``merged``."""
+    from lightctr_tpu.ops import sparse_kernels
 
-        if use_ef:
-            if not isinstance(compress_range, (int, float)):
-                raise ValueError(
-                    "sparse error feedback compensates FIXED-range "
-                    "clipping; compress_range='dynamic' never clips — "
-                    "pass a float range"
-                )
-            if uids is None:
-                raise ValueError("sparse error feedback needs uids")
-            table = quantize.build_table(
-                -compress_range, compress_range,
-                bits=compress_bits, mode=compress_mode,
-            )
-            # every VALID slot (non-pad) compensates — including ids whose
-            # gradient is zero this step, so a carried clip remainder
-            # drains on the id's next appearance rather than waiting for
-            # a nonzero gradient.  Pads (repeated id 0 beyond slot 0, the
-            # dedup convention) must not touch row 0's carry.
-            k = uids.shape[0]
-            valid = ~((uids == 0) & (jnp.arange(k) > 0))
-            mask = valid.astype(rows.dtype).reshape(
-                (-1,) + (1,) * (rows.ndim - 1)
-            )
-            carried = jnp.take(residual, uids, axis=0)
-            val = rows + carried * mask
-            codes = quantize.compress(table, val)
-            dec = quantize.extract(table, codes)
-            # fresh error (clip + quantization) back at the row's slot:
-            # an .add of the masked DELTA, so padded id-0 repeats and
-            # zero-row entries are no-ops on the carry
-            new_residual = residual.at[uids].add((val - dec - carried) * mask)
-            all_rows = quantize.extract(
-                table, jax.lax.all_gather(codes, axis_name, tiled=True)
-            )
-        else:
-            all_rows = _coded_exchange(
-                rows,
-                lambda c: jax.lax.all_gather(c, axis_name, tiled=True),
-                axis_name, compress_bits, compress_range, compress_mode,
-            )
-    else:
-        if use_ef:
-            raise ValueError("sparse error feedback needs compress_bits")
-        all_rows = jax.lax.all_gather(rows, axis_name, tiled=True)
-    merged = jax.ops.segment_sum(all_rows, inv, num_segments=num_segments)
+    use_ef = residual is not None
+    all_rows, new_residual = _ag_exchange_rows(
+        rows, axis_name, compress_bits=compress_bits,
+        compress_range=compress_range, compress_mode=compress_mode,
+        uids=uids, residual=residual,
+    )
+    merged = sparse_kernels.merge_rows(all_rows, inv, num_segments)
     if average:
         merged = merged / n
     if use_ef:
@@ -885,14 +921,14 @@ def _rs_ring_exchange(buckets: jax.Array, axis_name: str, n: int):
 def _rs_merge_ids(all_ids: jax.Array, shard_cap: int):
     """Owner-side id merge: the n received [bucket_cap] id buckets ->
     (uniq [shard_cap], inv [n*bucket_cap], overflow).  ``overflow`` counts
-    unique ids beyond the shard capacity (0 when :func:`rs_fits` held)."""
+    unique ids beyond the shard capacity (0 when :func:`rs_fits` held) —
+    read straight off the dedup kernel's distinct count (``jnp.unique``'s
+    inverse keeps full ranks under truncation, so no extra sort)."""
+    from lightctr_tpu.ops import sparse_kernels
+
     flat = all_ids.reshape(-1)
-    uniq, inv = jnp.unique(
-        flat, return_inverse=True, size=shard_cap, fill_value=0
-    )
-    s = jnp.sort(flat)
-    n_uniq = 1 + jnp.sum((s[1:] != s[:-1]).astype(jnp.int32))
-    return uniq, inv.reshape(-1), jnp.maximum(0, n_uniq - shard_cap)
+    uniq, inv, count = sparse_kernels.dedup_ids(flat, size=shard_cap)
+    return uniq, inv, jnp.maximum(0, count - shard_cap)
 
 
 def _rs_gather_rows(
@@ -908,35 +944,97 @@ def _rs_gather_rows(
     compress_bits: int | None = None,
     compress_range: float | str = "dynamic",
     compress_mode: str = "uniform",
-) -> jax.Array:
+    uids: jax.Array | None = None,
+    residual: jax.Array | None = None,
+):
     """Row half of the reduce-scatter exchange against a SHARED id plan
     (``dest``/``order`` from :func:`rs_owner_partition`, ``inv`` from
     :func:`_rs_merge_ids`): scatter this table's [K, ...] payload into
     destination buckets, route them over the ppermute ring, merge at the
-    owner, and all-gather the merged shards.  Tables sharing one id
-    stream call this once each while the id plumbing runs once — the id
-    bytes ride the wire a single time per group."""
-    bucket_rows = rs_scatter_rows(rows, dest, order, n, bucket_cap)
-    if compress_bits is not None:
-        all_rows = _coded_exchange(
-            bucket_rows, lambda c: _rs_ring_exchange(c, axis_name, n),
-            axis_name, compress_bits, compress_range, compress_mode,
+    owner (through the kernel registry's ``merge_rows``), and all-gather
+    the merged shards.  Tables sharing one id stream call this once each
+    while the id plumbing runs once — the id bytes ride the wire a single
+    time per group.
+
+    ``residual``: optional [vocab, ...] per-member EF carry for CLIPPED
+    payloads under a FIXED ``compress_range`` (requires ``uids``) — the
+    reduce-scatter counterpart of :func:`_ag_exchange_rows`'s carry.  The
+    member-side scatter-phase encode is compensated with last step's
+    remainder and the fresh clip+quantization error lands back at the
+    rows' slots, so clipped mass is delivered late instead of lost; an
+    entry dropped by bucket overflow carries its FULL value forward.  The
+    owner-side merged-shard encode is NOT compensated: in ``average``
+    mode the merged mean of decoded (range-bounded) values cannot clip,
+    so stage 2 adds only sub-bucket rounding noise (in sum mode it can
+    clip — EF here assumes the trainer's mean exchange).  Returns
+    ``(gathered, new_residual)`` when a residual is given, else
+    ``gathered``."""
+    from lightctr_tpu.ops import quantize, sparse_kernels
+
+    use_ef = residual is not None
+    new_residual = None
+    if use_ef:
+        if compress_bits is None:
+            raise ValueError("sparse error feedback needs compress_bits")
+        if not isinstance(compress_range, (int, float)):
+            raise ValueError(
+                "sparse error feedback compensates FIXED-range clipping; "
+                "compress_range='dynamic' never clips — pass a float range"
+            )
+        if uids is None:
+            raise ValueError("sparse error feedback needs uids")
+        table = quantize.build_table(
+            -compress_range, compress_range,
+            bits=compress_bits, mode=compress_mode,
+        )
+        mask = _ef_valid_mask(uids, rows)
+        carried = jnp.take(residual, uids, axis=0)
+        val = rows + carried * mask
+        bucket_rows = rs_scatter_rows(val, dest, order, n, bucket_cap)
+        codes = sparse_kernels.quantize_pack(table, bucket_rows)
+        # decoded view of each ORIGINAL slot: invert the partition plan
+        # (dest[j] is permuted entry j's flat bucket slot; n*bucket_cap =
+        # dropped — pads AND overflow victims decode to 0, so a dropped
+        # entry's full value rides the carry into the next step)
+        flat_dec = quantize.extract(table, codes).reshape(
+            (n * bucket_cap,) + rows.shape[1:]
+        )
+        padded_dec = jnp.concatenate(
+            [flat_dec, jnp.zeros((1,) + rows.shape[1:], rows.dtype)], axis=0
+        )
+        dec_rows = jnp.zeros_like(rows).at[order].set(
+            jnp.take(padded_dec, dest, axis=0)
+        )
+        new_residual = residual.at[uids].add((val - dec_rows - carried) * mask)
+        all_rows = quantize.extract(
+            table, _rs_ring_exchange(codes, axis_name, n)
         )
     else:
-        all_rows = _rs_ring_exchange(bucket_rows, axis_name, n)
-    merged = jax.ops.segment_sum(
+        bucket_rows = rs_scatter_rows(rows, dest, order, n, bucket_cap)
+        if compress_bits is not None:
+            all_rows = _coded_exchange(
+                bucket_rows, lambda c: _rs_ring_exchange(c, axis_name, n),
+                axis_name, compress_bits, compress_range, compress_mode,
+            )
+        else:
+            all_rows = _rs_ring_exchange(bucket_rows, axis_name, n)
+    merged = sparse_kernels.merge_rows(
         all_rows.reshape((n * bucket_cap,) + rows.shape[1:]),
-        inv, num_segments=shard_cap,
+        inv, shard_cap,
     )
     if average:
         merged = merged / n
     if compress_bits is not None:
-        return _coded_exchange(
+        gathered = _coded_exchange(
             merged,
             lambda c: jax.lax.all_gather(c, axis_name, tiled=True),
             axis_name, compress_bits, compress_range, compress_mode,
         )
-    return jax.lax.all_gather(merged, axis_name, tiled=True)
+    else:
+        gathered = jax.lax.all_gather(merged, axis_name, tiled=True)
+    if use_ef:
+        return gathered, new_residual
+    return gathered
 
 
 def _sparse_reduce_scatter_local(
@@ -950,6 +1048,7 @@ def _sparse_reduce_scatter_local(
     compress_bits: int | None = None,
     compress_range: float | str = "dynamic",
     compress_mode: str = "uniform",
+    residual: jax.Array | None = None,
 ):
     """Per-device body of :func:`sparse_reduce_scatter` (shard_map-inner,
     composable into larger programs — what the hybrid trainer embeds).
@@ -964,17 +1063,25 @@ def _sparse_reduce_scatter_local(
     ``compress_bits`` codes the row payload of BOTH phases (scatter
     buckets and merged shards) through axis-global tables — two encodes
     per value per step instead of the allgather variant's one, still far
-    from the dense ring's per-hop accumulation."""
+    from the dense ring's per-hop accumulation.
+
+    ``residual``: [vocab, ...] per-member EF carry for clipped
+    fixed-range payloads (see :func:`_rs_gather_rows`); appends
+    ``new_residual`` to the return tuple."""
     dest, order, bucket_ids, over_b = rs_owner_partition(uids, n, bucket_cap)
     all_ids = _rs_ring_exchange(bucket_ids, axis_name, n)
     uniq, inv, over_s = _rs_merge_ids(all_ids, shard_cap)
     out_ids = jax.lax.all_gather(uniq, axis_name, tiled=True)
-    out_rows = _rs_gather_rows(
+    out = _rs_gather_rows(
         rows, dest, order, inv, axis_name, n, bucket_cap, shard_cap,
         average=average, compress_bits=compress_bits,
         compress_range=compress_range, compress_mode=compress_mode,
+        uids=uids, residual=residual,
     )
-    return out_ids, out_rows, over_b + over_s
+    if residual is not None:
+        out_rows, new_residual = out
+        return out_ids, out_rows, over_b + over_s, new_residual
+    return out_ids, out, over_b + over_s
 
 
 def sparse_reduce_scatter(
@@ -989,6 +1096,7 @@ def sparse_reduce_scatter(
     compress_bits: int | None = None,
     compress_range: float | str = "dynamic",
     compress_mode: str = "uniform",
+    residual=None,
 ):
     """Owner-partitioned sparse all-reduce — generation 2 of
     :func:`sparse_all_reduce` (SparCML's split allreduce,
@@ -1010,8 +1118,15 @@ def sparse_reduce_scatter(
     :func:`rs_fits`); exact callers check host-side first and fall back to
     :func:`sparse_all_reduce`.  Returns ``(all_uids [n, n*shard_cap],
     merged [n, n*shard_cap, ...], overflow [n])``.
+
+    ``residual``: optional [n, vocab, ...] per-member error-feedback
+    carry for clipped payloads under a FIXED float ``compress_range``
+    (:func:`sparse_ef_residual_init` layout — the PR 7 allgather EF,
+    now on the reduce-scatter path; see :func:`_rs_gather_rows` for the
+    stage-1/stage-2 contract).  Appends ``new_residual`` to the return.
     """
     n = mesh.shape[axis]
+    use_ef = residual is not None
     if bucket_cap is None or shard_cap is None:
         if vocab is None:
             raise ValueError(
@@ -1022,17 +1137,27 @@ def sparse_reduce_scatter(
         bucket_cap = bucket_cap if bucket_cap is not None else db
         shard_cap = shard_cap if shard_cap is not None else ds
 
-    def local(u, r):
-        gu, m, over = _sparse_reduce_scatter_local(
+    def local(u, r, res):
+        out = _sparse_reduce_scatter_local(
             u[0], r[0], axis, n, bucket_cap, shard_cap, average=average,
             compress_bits=compress_bits, compress_range=compress_range,
             compress_mode=compress_mode,
+            residual=res[0] if use_ef else None,
         )
-        return gu[None], m[None], over[None]
+        if use_ef:
+            gu, m, over, new_res = out
+            return gu[None], m[None], over[None], new_res[None]
+        gu, m, over = out
+        return gu[None], m[None], over[None], res
 
-    fn = shard_map(local, mesh=mesh, in_specs=(P(axis), P(axis)),
-                   out_specs=(P(axis), P(axis), P(axis)))
-    return fn(uids, rows)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis)),
+                   out_specs=(P(axis), P(axis), P(axis), P(axis)))
+    res_in = residual if use_ef else jnp.zeros((n, 1), jnp.float32)
+    gu, m, over, new_res = fn(uids, rows, res_in)
+    if use_ef:
+        return gu, m, over, new_res
+    return gu, m, over
 
 
 def psum_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):

@@ -299,9 +299,6 @@ class CTRTrainer:
             from lightctr_tpu.optim.fused_adagrad import fused_adagrad_update
 
             lr, eps = self.cfg.learning_rate, 1e-7
-            # Mosaic lowering needs a real TPU; everywhere else the kernel
-            # runs in interpret mode (same numerics, test path)
-            interpret = jax.devices()[0].platform != "tpu"
 
             def step(params, opt_state, batch):
                 loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -309,8 +306,11 @@ class CTRTrainer:
                 leaves_w, treedef = jax.tree_util.tree_flatten(params)
                 leaves_a = treedef.flatten_up_to(opt_state.accum)
                 leaves_g = treedef.flatten_up_to(grads)
+                # the kernel registry picks the impl: compiled Mosaic on
+                # TPU, the jitted XLA twin elsewhere, the interpreter
+                # under LIGHTCTR_KERNELS=interpret
                 pairs = [
-                    fused_adagrad_update(w, a, g, lr, eps, interpret=interpret)
+                    fused_adagrad_update(w, a, g, lr, eps)
                     for w, a, g in zip(leaves_w, leaves_a, leaves_g)
                 ]
                 params = jax.tree_util.tree_unflatten(

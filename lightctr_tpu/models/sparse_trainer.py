@@ -85,6 +85,14 @@ the row scatters update the tables in place and the step is truly
 O(touched).  XLA's CPU backend does not honor donation — there each step
 still pays an O(vocab) table copy (measured: the step beats the dense
 trainer by the eliminated gradient+optimizer passes only).
+
+Kernel note (PR 9): the per-step sparse tax — id dedup, segment merge,
+row apply, payload pack — routes through the fused-kernel registry
+(:mod:`lightctr_tpu.ops.sparse_kernels`): Pallas kernels on TPU (the
+merge and the scaled Adagrad apply fuse into ONE pass over the gradient
+rows, so merged rows are never materialized), the identical pure-XLA
+reference twins everywhere else — the trajectory is the same on every
+path (see docs/KERNELS.md).
 """
 
 from __future__ import annotations
@@ -97,7 +105,6 @@ import numpy as np
 import optax
 
 from lightctr_tpu import obs
-from lightctr_tpu.embed.table import SparseAdagradState, sparse_adagrad_update
 from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
 from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.utils.profiling import annotate
@@ -216,7 +223,8 @@ class SparseTableCTRTrainer(CTRTrainer):
 
     def _use_sparse_ef(self) -> bool:
         """Fixed-range clipped sparse payloads get the per-table EF carry
-        (the PR 5 follow-up): hybrid exchange + compress_bits + error
+        on BOTH sparse exchange paths (allgather since PR 7, reduce-
+        scatter since PR 9): hybrid exchange + compress_bits + error
         feedback + a FIXED float compress_range (dynamic never clips, so
         a carry would compensate nothing)."""
         return (
@@ -297,11 +305,16 @@ class SparseTableCTRTrainer(CTRTrainer):
         single-program step and the per-replica hybrid step (where
         ``batch`` is the replica's local shard).
 
-        Tables listing the IDENTICAL field tuple run ``unique`` once and
+        Tables listing the IDENTICAL field tuple run the dedup once and
         share the resulting ``(uids, inv)`` — their position rewrites
         coincide by construction (the __init__ overlap check guarantees
         no other sharing shape exists), so dedup FLOPs are paid per
-        distinct id stream, not per table."""
+        distinct id stream, not per table.  The dedup itself rides the
+        kernel registry (``ops.sparse_kernels.dedup_ids``): the fused
+        sort-free Pallas kernel on TPU, the identical ``jnp.unique``
+        contract everywhere else."""
+        from lightctr_tpu.ops import sparse_kernels
+
         tables = {k: params[k] for k in spec}
         dense = {k: v for k, v in params.items() if k not in spec}
         batch2 = dict(batch)
@@ -313,9 +326,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                 ids = jnp.concatenate(
                     [batch[f].reshape(-1) for f in fields]
                 ).astype(jnp.int32)
-                u, inv = jnp.unique(
-                    ids, return_inverse=True, size=ids.shape[0], fill_value=0
-                )
+                u, inv, _ = sparse_kernels.dedup_ids(ids)
                 for k in keys:
                     uids[k] = u
                 ofs = 0
@@ -355,20 +366,23 @@ class SparseTableCTRTrainer(CTRTrainer):
 
             new_accum = {}
             with annotate("sparse_tables/apply"):
+                from lightctr_tpu.ops import sparse_kernels
+
                 for k in spec:
-                    # single source of truth for the PS Adagrad recipe; uids
-                    # are already unique (its internal dedup is an identity
-                    # pass, and the repeated padded id-0 slots carry zero
-                    # gradient)
-                    tables[k], st = sparse_adagrad_update(
+                    # fused touched-row apply through the kernel registry:
+                    # the XLA reference twin IS the sparse_adagrad_update
+                    # recipe (uids already unique; padded id-0 repeats
+                    # carry zero gradient), the Pallas variant applies it
+                    # in one pass per row
+                    tables[k], new_accum[k], _ = sparse_kernels.merge_apply(
                         tables[k],
-                        SparseAdagradState(accum=opt_state["accum"][k]),
+                        opt_state["accum"][k],
                         uids[k],
                         g_rows[k],
-                        lr,
+                        None,
+                        lr=lr,
                         eps=eps,
                     )
-                    new_accum[k] = st.accum
 
             params = {**dense, **tables}
             return (params, {"dense": new_dense_state, "accum": new_accum},
@@ -392,8 +406,8 @@ class SparseTableCTRTrainer(CTRTrainer):
 
         from lightctr_tpu.core.compat import shard_map
         from lightctr_tpu.dist.collectives import (
+            _ag_exchange_rows,
             _ag_gather_ids,
-            _ag_merge_rows,
             _ring_all_reduce_local,
             _rs_merge_ids,
             _rs_ring_exchange,
@@ -405,6 +419,7 @@ class SparseTableCTRTrainer(CTRTrainer):
             sparse_exchange_bytes,
             sparse_rs_bytes,
         )
+        from lightctr_tpu.ops import sparse_kernels
 
         loss_fn = self._make_loss_fn()
         tx = self.tx
@@ -507,11 +522,10 @@ class SparseTableCTRTrainer(CTRTrainer):
             # within each (field-tuple, algo) group ------------------------
             new_accum = {}
             # per-table sparse EF carries (fixed-range clipped payloads):
-            # allgather-exchanged tables update theirs through
-            # _ag_merge_rows; dense/rs tables pass theirs through
-            # untouched (the dense ring never clips its own mass away
-            # here without EF only because it is the escape hatch, and
-            # the rs path's residual support is an open follow-up)
+            # allgather tables compensate through _ag_exchange_rows,
+            # reduce-scatter tables through _rs_gather_rows' stage-1
+            # carry; dense-ring tables pass theirs through untouched
+            # (the dense ring is the worst-case escape hatch)
             new_sres = {}
             # in-jit rs overflow tally: the host-side rs_fits check should
             # make this identically zero, but if the two ever disagree the
@@ -519,20 +533,26 @@ class SparseTableCTRTrainer(CTRTrainer):
             # silent gradient loss — _observe_scalars surfaces it
             over_total = jnp.zeros((), jnp.int32)
 
-            def apply_sparse(k, gu, merged):
-                # identical (gu, merged) on every replica -> identical
-                # update; duplicate ids across replicas were merged by
-                # the exchange, padded slots carry zero rows (no-op)
+            def apply_sparse(k, gu, rows, inv=None, denom=1.0):
+                # identical (gu, rows) on every replica -> identical
+                # update; duplicate slots merge inside the fused
+                # merge-apply kernel (allgather path: inv maps the raw
+                # gathered rows; rs path: rows arrived merged owner-side,
+                # inv=None), padded slots carry zero rows (no-op).  The
+                # merged sum of squares feeds the health gradient norm
+                # from the same pass.
                 with annotate("sparse_tables/apply"):
-                    tables[k], st = sparse_adagrad_update(
+                    tables[k], new_accum[k], ssq = sparse_kernels.merge_apply(
                         tables[k],
-                        SparseAdagradState(accum=opt_state["accum"][k]),
+                        opt_state["accum"][k],
                         gu,
-                        merged,
-                        lr,
+                        rows,
+                        inv,
+                        lr=lr,
                         eps=eps,
+                        denom=denom,
                     )
-                new_accum[k] = st.accum
+                return ssq
 
             for fields, keys in groups.items():
                 u = uids[keys[0]]
@@ -589,9 +609,8 @@ class SparseTableCTRTrainer(CTRTrainer):
                             )
                             with annotate("sparse_tables/sparse_exchange",
                                           table=k):
-                                merged = _ag_merge_rows(
-                                    g_rows[k], inv, "data", n,
-                                    num_segments=uniq.shape[0], average=True,
+                                all_rows, nres = _ag_exchange_rows(
+                                    g_rows[k], "data",
                                     compress_bits=bits,
                                     compress_range=(crange if bits is not None
                                                     else 1.0),
@@ -601,10 +620,13 @@ class SparseTableCTRTrainer(CTRTrainer):
                                               if sparse_ef else None),
                                 )
                                 if sparse_ef:
-                                    merged, nres = merged
                                     new_sres[k] = nres[None]
-                            gn2 = gn2 + jnp.sum(merged * merged)
-                            apply_sparse(k, uniq, merged)
+                            # merge folded into the fused apply: the
+                            # gathered gradient rows are read once —
+                            # never materialized merged-then-applied
+                            gn2 = gn2 + apply_sparse(
+                                k, uniq, all_rows, inv=inv, denom=float(n)
+                            )
                     else:  # sparse_rs
                         bucket_cap, shard_cap = caps
                         with annotate("sparse_tables/rs_exchange",
@@ -636,9 +658,16 @@ class SparseTableCTRTrainer(CTRTrainer):
                                     compress_range=(crange if bits is not None
                                                     else 1.0),
                                     compress_mode=cmode,
+                                    uids=u if sparse_ef else None,
+                                    residual=(opt_state["sres"][k][0]
+                                              if sparse_ef else None),
                                 )
-                            gn2 = gn2 + jnp.sum(out_rows * out_rows)
-                            apply_sparse(k, out_ids, out_rows)
+                                if sparse_ef:
+                                    out_rows, nres = out_rows
+                                    new_sres[k] = nres[None]
+                            # rows arrived merged owner-side: apply-only
+                            # fused pass (inv=None)
+                            gn2 = gn2 + apply_sparse(k, out_ids, out_rows)
 
             params = {**dense, **tables}
             new_state = {"dense": new_dense_state, "accum": new_accum}
@@ -647,8 +676,8 @@ class SparseTableCTRTrainer(CTRTrainer):
             if sparse_ef:
                 for k in spec:
                     if k not in new_sres:
-                        # dense-ring / reduce-scatter tables: the carry
-                        # passes through untouched this step
+                        # dense-ring tables (the worst-case escape
+                        # hatch): the carry passes through untouched
                         new_sres[k] = opt_state["sres"][k]
                 new_state["sres"] = new_sres
             # health vector gains a third slot: the cross-member rs

@@ -27,10 +27,9 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from lightctr_tpu.core.compat import tpu_compiler_params
+from lightctr_tpu.core.compat import pallas_modules, tpu_compiler_params
+from lightctr_tpu.ops.sparse_kernels import register_kernel, resolve_impl
 
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 LANES = 128
@@ -51,6 +50,7 @@ def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int, nk: int
 ):
+    pl, _ = pallas_modules()
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -103,10 +103,17 @@ def _flash_kernel(
         o_ref[:] = acc_scr[:].astype(o_ref.dtype)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret"),
-)
+def _flash_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, block_q: int, block_k: int,
+) -> jax.Array:
+    """The pure-XLA twin: the ``full_attention`` oracle the kernel is
+    tested against (blocks are pallas tuning knobs — unused here)."""
+    from lightctr_tpu.nn.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal)
+
+
 def flash_attention(
     q: jax.Array,  # [B, T, H, D]
     k: jax.Array,
@@ -116,9 +123,27 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool = False,
 ) -> jax.Array:
-    b, t, h, d = q.shape
-    # shrink requested blocks to divisors of T (callers pick tuning caps,
-    # the kernel accepts any T with a power-of-two-divisible length)
+    """Registry-dispatched: compiled Mosaic on TPU, the exact
+    ``full_attention`` twin off-TPU (a flash call on CPU no longer
+    crashes), the interpreter under ``LIGHTCTR_KERNELS=interpret`` or an
+    explicit ``interpret=True``.  Block validation runs on every path so
+    caller bugs surface regardless of backend."""
+    from lightctr_tpu.ops import sparse_kernels
+
+    impl = "interpret" if interpret else resolve_impl("flash_attention")
+    block_q, block_k = _validate_blocks(q.shape[1], block_q, block_k)
+    sparse_kernels._record("attention", impl)
+    if impl == "xla":
+        return _flash_reference(q, k, v, causal, block_q, block_k)
+    return _flash_pallas(q, k, v, causal, block_q, block_k,
+                         interpret=(impl == "interpret"))
+
+
+def _validate_blocks(t: int, block_q: int, block_k: int):
+    """Shrink requested blocks to divisors of T (callers pick tuning
+    caps, the kernel accepts any T with a power-of-two-divisible length);
+    raise when none fits.  The single source for wrapper AND kernel, so
+    the validation always matches what the kernel runs."""
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     while block_q > 8 and t % block_q:
@@ -129,6 +154,25 @@ def flash_attention(
         raise ValueError(
             f"block sizes ({block_q}, {block_k}) must divide T={t}"
         )
+    return block_q, block_k
+
+
+@partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _flash_pallas(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    pl, pltpu = pallas_modules()
+    b, t, h, d = q.shape
+    block_q, block_k = _validate_blocks(t, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
     nk = t // block_k
 
@@ -166,3 +210,7 @@ def flash_attention(
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+register_kernel("flash_attention", phase="attention",
+                reference=_flash_reference, pallas=_flash_pallas)

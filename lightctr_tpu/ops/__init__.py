@@ -1,3 +1,3 @@
-from lightctr_tpu.ops import activations, losses, metrics
+from lightctr_tpu.ops import activations, losses, metrics, sparse_kernels
 
-__all__ = ["activations", "losses", "metrics"]
+__all__ = ["activations", "losses", "metrics", "sparse_kernels"]

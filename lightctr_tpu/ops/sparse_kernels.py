@@ -1,0 +1,678 @@
+"""Fused Pallas kernels for the sparse hot path — registry + dispatcher.
+
+The per-step sparse tax every table pays — dedup-gather, segment-merge,
+optimizer apply, payload quantize — lowers under plain XLA as SEPARATE HLOs
+with full-size intermediates: the merged gradient rows are materialized,
+then re-read by the optimizer; the quantile codec walks its payload once to
+encode and once more for the EF residual.  The reference LightCTR earns its
+throughput from a hand-tuned L0 SIMD layer (``common/avx.h``) doing each of
+these in one pass; ∇SD (PAPERS.md, 2303.07030) makes the same case for
+sparse formats as first-class compiled objects.  This module is that layer
+for the TPU port:
+
+  - :func:`dedup_ids` — unique+inverse over an id stream.  Pallas variant
+    is SORT-FREE: a blocked rank kernel (rank = #distinct values less than
+    x, via first-occurrence flags) that emits the exact ``jnp.unique(...,
+    size=K, fill_value=0)`` contract — sorted unique ids, full-rank
+    inverse (ranks may exceed ``size`` when truncated, exactly like
+    ``jnp.unique``), plus the distinct count.
+  - :func:`merge_rows` — duplicate-id segment merge (``segment_sum``).
+  - :func:`merge_apply` — one-pass segment-merge + scaled Adagrad apply
+    over touched rows: gradient rows are read once and the merged rows are
+    never materialized merged-then-applied (the fold of
+    ``optim/fused_adagrad``'s row update into the merge).  Emits the
+    merged sum-of-squares so the trainer's health gradient norm rides the
+    same pass.
+  - :func:`quantize_pack` / :func:`quantize_pack_ef` — quantile-codec
+    payload packing (the wire codes of ``ops.quantize``) with the error-
+    feedback residual folded into the same pass: compensate, encode,
+    decode, fresh-error — one payload traversal.
+
+Every kernel ships a pure-XLA **reference twin** (literally the code the
+call sites ran before this module existed) and dispatch is capability
+gated — see :func:`resolve_impl`:
+
+  - ``pallas``   — compiled Mosaic kernels; picked automatically on TPU.
+  - ``interpret``— the same kernels under ``pallas_call(interpret=True)``
+                   (CPU parity tests); forced by ``LIGHTCTR_KERNELS=interpret``.
+  - ``xla``      — the reference twin; the default off-TPU and the
+                   degrade path when the jax pin has no pallas at all
+                   (``core.compat.pallas_modules``).
+
+``LIGHTCTR_KERNELS`` = ``auto`` (default) | ``pallas`` | ``interpret`` |
+``xla``.  Every resolution is counted in
+``trainer_kernel_path_total{phase,impl}`` (once per trace, not per step —
+the pick is static inside jit), so ``tools/metrics_report.py --kernels``
+shows which implementation actually ran, measured rather than assumed.
+
+Modules register their kernels here (``optim/fused_adagrad``,
+``nn/flash_attention`` self-register on import); the AST lint in
+tests/test_obs.py pins every ``pallas_call`` site in the tree to a
+registered kernel with a declared reference twin — a direct call with no
+CPU-safe twin cannot land.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import obs
+from lightctr_tpu.core.compat import pallas_modules
+
+ENV_FLAG = "LIGHTCTR_KERNELS"
+
+#: the dispatch phases a kernel may declare (the ``phase`` label of
+#: ``trainer_kernel_path_total``); metrics_report --kernels groups by these
+KERNEL_PHASES = ("dedup", "merge", "apply", "pack", "adagrad", "attention")
+
+
+class KernelDef(NamedTuple):
+    name: str
+    phase: str            # one of KERNEL_PHASES
+    reference: Callable   # the pure-XLA twin (the pre-kernel call-site code)
+    pallas: Callable      # pallas impl; MUST accept interpret=bool kwarg
+
+
+#: name -> KernelDef.  The single source of truth the lint walks.
+KERNELS: Dict[str, KernelDef] = {}
+
+
+def register_kernel(
+    name: str, *, phase: str, reference: Callable, pallas: Callable
+) -> None:
+    """Register a fused kernel with its XLA reference twin.  Both are
+    mandatory — the dispatcher's CPU/old-jax degrade path IS the
+    reference, so a kernel without one could strand tier-1."""
+    if phase not in KERNEL_PHASES:
+        raise ValueError(f"unknown kernel phase {phase!r}")
+    if not callable(reference) or not callable(pallas):
+        raise ValueError(f"kernel {name!r} needs callable reference AND pallas")
+    KERNELS[name] = KernelDef(
+        name=name, phase=phase, reference=reference, pallas=pallas
+    )
+
+
+def resolve_impl(name: str) -> str:
+    """The capability gate: which implementation a dispatch call will run.
+
+    ``LIGHTCTR_KERNELS=xla`` forces the reference; ``interpret`` forces the
+    Pallas kernel under the interpreter (CPU parity testing); ``pallas``
+    forces compiled Mosaic; ``auto`` (default) compiles Pallas on TPU and
+    takes the reference everywhere else.  A jax pin without pallas modules
+    always resolves ``xla`` — degrade, never ImportError."""
+    if name not in KERNELS:
+        raise KeyError(f"unregistered kernel {name!r}")
+    mode = os.environ.get(ENV_FLAG, "auto").strip().lower() or "auto"
+    if mode in ("xla", "off", "reference", "0"):
+        return "xla"
+    pl_mod, _ = pallas_modules()
+    if pl_mod is None:
+        return "xla"
+    if mode == "interpret":
+        return "interpret"
+    if mode == "pallas":
+        return "pallas"
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def _record(phase: str, impl: str) -> None:
+    obs.default_registry().inc(
+        obs.labeled("trainer_kernel_path_total", phase=phase, impl=impl)
+    )
+
+
+def _resolve(name: str, impl: Optional[str] = None) -> Tuple[str, Callable]:
+    """(impl, fn) for one dispatch: the telemetry counter records the pick
+    that actually runs (callers pass ``impl`` when a per-call capability
+    check already downgraded it)."""
+    kd = KERNELS[name]
+    impl = impl or resolve_impl(name)
+    _record(kd.phase, impl)
+    if impl == "xla":
+        return impl, kd.reference
+    return impl, partial(kd.pallas, interpret=(impl == "interpret"))
+
+
+# =========================================================================
+# (a) dedup: unique + inverse over an id stream
+# =========================================================================
+
+
+def _dedup_reference(ids: jax.Array, size: int):
+    """The exact call every dedup site ran before: sorted unique padded
+    with id 0, full-rank inverse, plus the distinct count (``max(inv)+1``
+    — ``jnp.unique``'s inverse is the rank among ALL distinct values even
+    when ``size`` truncates the unique array, so the count needs no extra
+    sort)."""
+    u, inv = jnp.unique(ids, return_inverse=True, size=size, fill_value=0)
+    inv = inv.reshape(-1).astype(jnp.int32)
+    return u, inv, (jnp.max(inv) + 1).astype(jnp.int32)
+
+
+def _dedup_kernel(ids_ref, inv_ref, uids_ref, count_ref, first_ref,
+                  *, k, bk, nb, size):
+    """Sort-free blocked rank dedup.  Phase 0 marks first occurrences
+    (dup-count over earlier slots == 0), phase 1 ranks each id by the
+    number of distinct smaller values (a masked [bk, bk]-tiled compare
+    accumulation — O(K^2) compares on the VPU instead of a sort network)
+    and scatters first-rank ids into the output slots; slot ``size`` is
+    the dump slot for truncated/padded entries (sliced off outside)."""
+    pl, _ = pallas_modules()
+    phase, b = pl.program_id(0), pl.program_id(1)
+    start = b * bk
+    x = ids_ref[pl.ds(start, bk), :]                       # [bk, 1]
+    pos = start + jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0)
+
+    @pl.when(phase == 0)
+    def _firsts():
+        def body(c, dup):
+            y = ids_ref[pl.ds(c * bk, bk), :]              # [bk, 1]
+            q = c * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bk), 1)
+            eq = (x == y.reshape(1, bk)) & (q < pos)
+            return dup + jnp.sum(eq.astype(jnp.int32), axis=1, keepdims=True)
+
+        # only blocks <= b can hold earlier slots
+        dup = jax.lax.fori_loop(0, b + 1, body, jnp.zeros((bk, 1), jnp.int32))
+        first_ref[pl.ds(start, bk), :] = (dup == 0).astype(jnp.int32)
+
+    @pl.when(phase == 1)
+    def _ranks():
+        def body(c, rank):
+            y = ids_ref[pl.ds(c * bk, bk), :]
+            fy = first_ref[pl.ds(c * bk, bk), :]
+            lt = (y.reshape(1, bk) < x) & (fy.reshape(1, bk) > 0)
+            return rank + jnp.sum(lt.astype(jnp.int32), axis=1, keepdims=True)
+
+        rank = jax.lax.fori_loop(0, nb, body, jnp.zeros((bk, 1), jnp.int32))
+        inv_ref[pl.ds(start, bk), :] = rank
+
+        @pl.when(b == 0)
+        def _init():
+            uids_ref[:, :] = jnp.zeros((size + 1, 1), jnp.int32)
+            count_ref[0, 0] = 0
+
+        valid = pos < k
+        count_ref[0, 0] = jnp.maximum(
+            count_ref[0, 0], jnp.max(jnp.where(valid, rank, -1)) + 1
+        )
+
+        def scatter(j, _):
+            r = rank[j, 0]
+            ok = (start + j < k) & (r < size)
+            uids_ref[jnp.where(ok, r, size), 0] = x[j, 0]
+            return 0
+
+        jax.lax.fori_loop(0, bk, scatter, 0)
+
+
+def _dedup_pallas(ids: jax.Array, size: int, *, interpret: bool):
+    pl, _ = pallas_modules()
+    k = ids.shape[0]
+    ids32 = ids.astype(jnp.int32)
+    bk = min(256, max(8, 1 << (k - 1).bit_length()))
+    kp = -(-k // bk) * bk
+    if kp != k:
+        # sentinel pads rank ABOVE every real id, so real ranks are
+        # untouched and padded slots land in the dump slot
+        ids32 = jnp.pad(ids32, (0, kp - k),
+                        constant_values=np.iinfo(np.int32).max)
+    nb = kp // bk
+    inv, uids, count = pl.pallas_call(
+        partial(_dedup_kernel, k=k, bk=bk, nb=nb, size=size),
+        grid=(2, nb),
+        out_shape=(
+            jax.ShapeDtypeStruct((kp, 1), jnp.int32),      # inv (full ranks)
+            jax.ShapeDtypeStruct((size + 1, 1), jnp.int32),  # uids + dump slot
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),       # distinct count
+        ),
+        scratch_shapes=[_vmem_scratch((kp, 1), jnp.int32)],
+        interpret=interpret,
+    )(ids32.reshape(kp, 1))
+    return (uids[:size, 0].astype(ids.dtype), inv[:k, 0], count[0, 0])
+
+
+def _vmem_scratch(shape, dtype):
+    _, pltpu = pallas_modules()
+    return pltpu.VMEM(shape, dtype)
+
+
+def dedup_ids(ids: jax.Array, size: Optional[int] = None):
+    """Dispatch: unique+inverse over one id stream -> ``(uids, inv,
+    count)``, the exact ``jnp.unique(ids, return_inverse=True, size=size,
+    fill_value=0)`` contract plus the distinct count.  ``size`` defaults
+    to ``len(ids)`` (no truncation); with ``size < count`` the unique
+    array truncates while ``inv`` keeps full ranks — identical to
+    ``jnp.unique`` (callers like the rs shard merge read the count to
+    tally overflow)."""
+    ids = ids.reshape(-1)
+    k = ids.shape[0]
+    if size is None:
+        size = k
+    if k == 0:
+        return (jnp.zeros((size,), ids.dtype), jnp.zeros((0,), jnp.int32),
+                jnp.zeros((), jnp.int32))
+    impl = None
+    if jnp.dtype(ids.dtype).itemsize > 4 and resolve_impl("dedup_ids") != "xla":
+        # the rank kernel compares in int32 — ids that may not fit (int64
+        # streams in the billion-row-vocab regime) take the reference,
+        # where jnp.unique is exact at any width
+        impl = "xla"
+    _, fn = _resolve("dedup_ids", impl=impl)
+    return fn(ids, size)
+
+
+# =========================================================================
+# (b) segment merge + fused merge-apply
+# =========================================================================
+
+
+def _merge_reference(rows: jax.Array, inv: jax.Array, num_segments: int):
+    return jax.ops.segment_sum(rows, inv, num_segments=num_segments)
+
+
+def _merge_kernel(inv_ref, rows_ref, out_ref, *, m, bk, nseg):
+    """Sequential scatter-accumulate: segment slot += row, in increasing
+    slot order (the same accumulation order ``segment_sum`` applies, so
+    the merge is bit-identical to the reference twin).  Out-of-range
+    segments (truncated ranks) and padded slots add exact zeros to row 0,
+    matching ``segment_sum``'s drop semantics."""
+    pl, _ = pallas_modules()
+    b = pl.program_id(0)
+
+    @pl.when(b == 0)
+    def _zero():
+        out_ref[:, :] = jnp.zeros((nseg, out_ref.shape[1]), out_ref.dtype)
+
+    def body(j, _):
+        p = b * bk + j
+        seg = inv_ref[p, 0]
+        ok = (p < m) & (seg >= 0) & (seg < nseg)
+        segc = jnp.where(ok, seg, 0)
+        row = rows_ref[pl.ds(p, 1), :] * jnp.where(ok, 1.0, 0.0)
+        out_ref[pl.ds(segc, 1), :] += row
+        return 0
+
+    jax.lax.fori_loop(0, bk, body, 0)
+
+
+def _merge_pallas(rows: jax.Array, inv: jax.Array, num_segments: int,
+                  *, interpret: bool):
+    pl, _ = pallas_modules()
+    m = rows.shape[0]
+    d = int(np.prod(rows.shape[1:])) if rows.ndim > 1 else 1
+    flat = rows.reshape(m, d).astype(jnp.float32)
+    bk = min(256, max(8, m))
+    mp = -(-m // bk) * bk
+    inv2 = jnp.pad(inv.astype(jnp.int32), (0, mp - m)).reshape(mp, 1)
+    if mp != m:
+        flat = jnp.pad(flat, ((0, mp - m), (0, 0)))
+    out = pl.pallas_call(
+        partial(_merge_kernel, m=m, bk=bk, nseg=num_segments),
+        grid=(mp // bk,),
+        out_shape=jax.ShapeDtypeStruct((num_segments, d), jnp.float32),
+        interpret=interpret,
+    )(inv2, flat)
+    # the reference (segment_sum) preserves the payload dtype — match it
+    return out.reshape((num_segments,) + rows.shape[1:]).astype(rows.dtype)
+
+
+def merge_rows(rows: jax.Array, inv: jax.Array, num_segments: int):
+    """Dispatch: duplicate-slot segment merge — ``segment_sum(rows, inv,
+    num_segments)`` with the dedup convention's drop semantics for
+    out-of-range segments."""
+    if rows.shape[0] == 0:
+        return jnp.zeros((num_segments,) + rows.shape[1:], rows.dtype)
+    _, fn = _resolve("merge_rows")
+    return fn(rows, inv, num_segments)
+
+
+def _merge_apply_reference(
+    table: jax.Array,
+    accum: jax.Array,
+    uids: jax.Array,
+    rows: jax.Array,
+    inv: Optional[jax.Array],
+    lr: float,
+    eps: float,
+    denom: float,
+):
+    """Literally the pre-kernel trainer sequence: segment-merge (when
+    ``inv`` is given), scale, health sum-of-squares, then the
+    ``sparse_adagrad_update`` recipe — the separate-HLO chain the fused
+    kernel collapses."""
+    from lightctr_tpu.embed.table import SparseAdagradState, \
+        sparse_adagrad_update
+
+    if inv is not None:
+        merged = jax.ops.segment_sum(rows, inv, num_segments=uids.shape[0])
+    else:
+        merged = rows
+    if denom != 1.0:
+        merged = merged / denom
+    sumsq = jnp.sum(merged * merged)
+    new_table, st = sparse_adagrad_update(
+        table, SparseAdagradState(accum=accum), uids, merged, lr, eps=eps
+    )
+    return new_table, st.accum, sumsq
+
+
+def _apply_kernel(uids_ref, w_ref, a_ref, g_ref, w_out, a_out, ssq_ref,
+                  *, lr, eps, denom, s):
+    """Per-touched-row fused scaled-apply: the scalar-prefetched uid
+    steers the (1, dim) table/accum block windows (the canonical Pallas
+    gather pattern), so each gradient row is read once, scaled, squared
+    into the running health norm, and applied — no merged intermediate
+    ever lands in HBM.  Padded slots (uid 0 beyond slot 0, the dedup
+    convention) zero their gradient: the write-back is then an exact
+    no-op, the same arithmetic the reference's masked scatter-add does.
+
+    The caller rotates the slot order so ORIGINAL slot 0 runs LAST
+    (grid step i handles slot (i+1) % s): every other row is visited
+    exactly once, and the multiply-visited row 0 (pads + a possible real
+    id 0) sees all its no-op pad writes BEFORE the one real write — an
+    aliased block revisit must never read back its own earlier write."""
+    pl, _ = pallas_modules()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _zero():
+        ssq_ref[0, 0] = 0.0
+
+    g = g_ref[...]
+    if denom != 1.0:
+        g = g / denom
+    uid = uids_ref[i]
+    # original slot of this grid step is (i + 1) % s: slot 0 <=> i == s-1
+    g = g * jnp.where((uid == 0) & (i != s - 1), 0.0, 1.0)
+    ssq_ref[0, 0] += jnp.sum(g * g)
+    a_new = a_ref[...] + g * g
+    a_out[...] = a_new
+    w_out[...] = w_ref[...] - lr * g * jax.lax.rsqrt(a_new + eps)
+
+
+def _merge_apply_pallas(
+    table, accum, uids, rows, inv, lr, eps, denom, *, interpret: bool
+):
+    pl, pltpu = pallas_modules()
+    shape = table.shape
+    vocab = shape[0]
+    d = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+    s = uids.shape[0]
+    if inv is not None:
+        merged = _merge_pallas(
+            rows.reshape(rows.shape[0], d), inv, s, interpret=interpret
+        )
+    else:
+        merged = rows.reshape(s, d).astype(jnp.float32)
+    # rotate so original slot 0 is the LAST grid step (see _apply_kernel)
+    uids_r = jnp.roll(uids.astype(jnp.int32), -1)
+    merged_r = jnp.roll(merged, -1, axis=0)
+    spec_row = pl.BlockSpec((1, d), lambda i, u: (u[i], 0))
+    spec_seq = pl.BlockSpec((1, d), lambda i, u: (i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s,),
+        in_specs=[spec_row, spec_row, spec_seq],
+        out_specs=[
+            pl.BlockSpec((1, d), lambda i, u: (u[i], 0)),
+            pl.BlockSpec((1, d), lambda i, u: (u[i], 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+    )
+    w2, a2, ssq = pl.pallas_call(
+        partial(_apply_kernel, lr=lr, eps=eps, denom=denom, s=s),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((vocab, d), table.dtype),
+            jax.ShapeDtypeStruct((vocab, d), accum.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        input_output_aliases={1: 0, 2: 1},
+        interpret=interpret,
+    )(uids_r, table.reshape(vocab, d), accum.reshape(vocab, d), merged_r)
+    return w2.reshape(shape), a2.reshape(shape), ssq[0, 0]
+
+
+def merge_apply(
+    table: jax.Array,
+    accum: jax.Array,
+    uids: jax.Array,
+    rows: jax.Array,
+    inv: Optional[jax.Array] = None,
+    *,
+    lr: float,
+    eps: float = 1e-7,
+    denom: float = 1.0,
+):
+    """Dispatch: one-pass segment-merge + scaled Adagrad apply over the
+    touched rows of ``table``/``accum``.
+
+    ``uids`` [S] follow the dedup convention (sorted unique, padding
+    repeats id 0); ``rows`` is either the pre-merge [M, ...] gradient
+    payload with its ``inv`` [M] segment map, or — ``inv=None`` — already
+    per-uid rows [S, ...] (the reduce-scatter path, whose merge happened
+    owner-side mid-exchange).  ``denom`` scales the merged rows
+    (``merged / denom`` — the exchange's mean) before the apply.
+
+    Returns ``(table', accum', sumsq)``; ``sumsq`` is the merged rows'
+    sum of squares (the health gradient-norm contribution) computed in
+    the same pass.  The trajectory is bit-identical to the reference
+    chain ``segment_sum -> /denom -> sparse_adagrad_update``; ``sumsq``
+    may differ in final-ulp accumulation order.
+
+    Padded id-0 slots are ZERO-GRADIENT BY CONTRACT, and for ``inv=None``
+    payloads this dispatch enforces it before either impl runs: the coded
+    reduce-scatter exchange leaves decoded dump-slot noise (half-bucket
+    midpoints) in foreign shards' id-0 slots, and without the mask the
+    reference would train real row 0 on that noise while the fused kernel
+    (whose aliased block revisits must stay no-op writes) drops it — the
+    enforced zero keeps every impl on the identical trajectory and keeps
+    codec noise off row 0.  Merged ``inv`` payloads need no mask: pad
+    segments are never referenced, their sums are exactly zero."""
+    if inv is None:
+        k = uids.shape[0]
+        valid = ~((uids == 0) & (jnp.arange(k) > 0))
+        rows = rows * valid.astype(rows.dtype).reshape(
+            (-1,) + (1,) * (rows.ndim - 1)
+        )
+    _, fn = _resolve("merge_apply")
+    return fn(table, accum, uids, rows, inv, lr, eps, denom)
+
+
+# =========================================================================
+# (c) quantize-on-the-fly payload packing (+ folded EF residual)
+# =========================================================================
+
+
+def _qp_reference(table, x: jax.Array):
+    from lightctr_tpu.ops import quantize
+
+    return quantize.compress(table, x)
+
+
+def _qp_kernel(bnd_ref, x_ref, codes_ref, *, nbp, bc, code_bits):
+    """Compare-count encode: ``searchsorted(boundaries, x, side='left')``
+    == the number of boundaries strictly below x — a chunked broadcast
+    compare-accumulate, bit-identical to the codec's binary search."""
+    pl, _ = pallas_modules()
+    x = x_ref[...]                                         # [bp, 1]
+
+    def body(c, acc):
+        bb = bnd_ref[0, pl.ds(c * bc, bc)]                 # [bc]
+        return acc + jnp.sum((x > bb).astype(jnp.int32), axis=1,
+                             keepdims=True)
+
+    acc = jax.lax.fori_loop(0, nbp // bc, body,
+                            jnp.zeros(x.shape, jnp.int32))
+    codes_ref[...] = acc.astype(codes_ref.dtype)
+
+
+def _qp_flatten(table, x):
+    """(boundaries [1, NBp] +inf-padded, flat [P, 1], chunk, code dtype)."""
+    nb = int(table.boundaries.shape[0])
+    bc = min(256, max(8, nb))
+    nbp = -(-nb // bc) * bc
+    bnd = table.boundaries.astype(jnp.float32)
+    if nbp != nb:
+        bnd = jnp.pad(bnd, (0, nbp - nb), constant_values=jnp.inf)
+    dtype = jnp.uint8 if table.bits <= 8 else jnp.uint16
+    flat = x.reshape(-1, 1).astype(jnp.float32)
+    return bnd.reshape(1, nbp), flat, bc, nbp, dtype
+
+
+def _qp_pallas(table, x: jax.Array, *, interpret: bool):
+    pl, _ = pallas_modules()
+    bnd, flat, bc, nbp, dtype = _qp_flatten(table, x)
+    p = flat.shape[0]
+    bp = min(1024, max(8, p))
+    pp = -(-p // bp) * bp
+    if pp != p:
+        flat = jnp.pad(flat, ((0, pp - p), (0, 0)))
+    codes = pl.pallas_call(
+        partial(_qp_kernel, nbp=nbp, bc=bc, code_bits=table.bits),
+        grid=(pp // bp,),
+        out_shape=jax.ShapeDtypeStruct((pp, 1), dtype),
+        in_specs=[
+            pl.BlockSpec((1, nbp), lambda i: (0, 0)),
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(bnd, flat)
+    return codes[:p, 0].reshape(x.shape)
+
+
+def quantize_pack(table, x: jax.Array) -> jax.Array:
+    """Dispatch: float payload -> quantile codes, bit-identical to
+    ``ops.quantize.compress`` (the wire pack every coded collective hop
+    ships).  The Pallas variant covers codes up to 8 bits (the compare-
+    count sweep over a 16-bit table's 65535 boundaries is not worth VPU
+    time); wider codes resolve to the reference."""
+    impl = None
+    if table.bits > 8 and resolve_impl("quantize_pack") != "xla":
+        impl = "xla"
+    _, fn = _resolve("quantize_pack", impl=impl)
+    return fn(table, x)
+
+
+def _qp_ef_reference(table, rows, carried, mask):
+    """The `_ag_merge_rows` EF encode sequence: compensate with last
+    step's carry, encode, decode, fresh error — exactly the chain the
+    fused kernel runs in one pass."""
+    from lightctr_tpu.ops import quantize
+
+    val = rows + carried * mask
+    codes = quantize.compress(table, val)
+    dec = quantize.extract(table, codes)
+    return codes, (val - dec - carried) * mask
+
+
+def _qp_ef_kernel(bnd_ref, val_ref, rows_ref, car_ref, mask_ref,
+                  codes_ref, delta_ref, *, nbp, bc, nvp, vc):
+    """One pass over the payload: val = rows + carried*mask; encode
+    (compare-count); decode (chunked one-hot masked sum — exact: every
+    non-selected term contributes a signed zero); fresh EF error."""
+    pl, _ = pallas_modules()
+    rows = rows_ref[...]
+    car = car_ref[...]
+    m = mask_ref[...]
+    val = rows + car * m
+
+    def cbody(c, acc):
+        bb = bnd_ref[0, pl.ds(c * bc, bc)]
+        return acc + jnp.sum((val > bb).astype(jnp.int32), axis=1,
+                             keepdims=True)
+
+    codes = jax.lax.fori_loop(0, nbp // bc, cbody,
+                              jnp.zeros(val.shape, jnp.int32))
+
+    def dbody(c, dec):
+        vv = val_ref[0, pl.ds(c * vc, vc)]                 # [vc]
+        idx = c * vc + jax.lax.broadcasted_iota(
+            jnp.int32, (codes.shape[0], vc), 1
+        )
+        sel = (codes == idx).astype(jnp.float32)
+        return dec + jnp.sum(vv * sel, axis=1, keepdims=True)
+
+    dec = jax.lax.fori_loop(0, nvp // vc, dbody,
+                            jnp.zeros(val.shape, jnp.float32))
+    codes_ref[...] = codes.astype(codes_ref.dtype)
+    delta_ref[...] = (val - dec - car) * m
+
+
+def _qp_ef_pallas(table, rows, carried, mask, *, interpret: bool):
+    pl, _ = pallas_modules()
+    bnd, flat, bc, nbp, dtype = _qp_flatten(table, rows)
+    nv = int(table.values.shape[0])
+    vc = min(256, max(8, nv))
+    nvp = -(-nv // vc) * vc
+    vals = table.values.astype(jnp.float32)
+    if nvp != nv:
+        vals = jnp.pad(vals, (0, nvp - nv))
+    car = carried.reshape(-1, 1).astype(jnp.float32)
+    msk = jnp.broadcast_to(mask, rows.shape).reshape(-1, 1).astype(
+        jnp.float32
+    )
+    p = flat.shape[0]
+    bp = min(1024, max(8, p))
+    pp = -(-p // bp) * bp
+    if pp != p:
+        flat = jnp.pad(flat, ((0, pp - p), (0, 0)))
+        car = jnp.pad(car, ((0, pp - p), (0, 0)))
+        msk = jnp.pad(msk, ((0, pp - p), (0, 0)))
+    codes, delta = pl.pallas_call(
+        partial(_qp_ef_kernel, nbp=nbp, bc=bc, nvp=nvp, vc=vc),
+        grid=(pp // bp,),
+        out_shape=(
+            jax.ShapeDtypeStruct((pp, 1), dtype),
+            jax.ShapeDtypeStruct((pp, 1), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((1, nbp), lambda i: (0, 0)),
+            pl.BlockSpec((1, nvp), lambda i: (0, 0)),
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bp, 1), lambda i: (i, 0)),
+        ),
+        interpret=interpret,
+    )(bnd, vals.reshape(1, nvp), flat, car, msk)
+    return (codes[:p, 0].reshape(rows.shape),
+            delta[:p, 0].reshape(rows.shape))
+
+
+def quantize_pack_ef(table, rows: jax.Array, carried: jax.Array,
+                     mask: jax.Array):
+    """Dispatch: EF-folded payload pack -> ``(codes, delta)`` where
+    ``val = rows + carried*mask``, ``codes = compress(val)`` and
+    ``delta = (val - extract(codes) - carried) * mask`` — the fresh
+    error-feedback contribution the caller scatters back at the rows'
+    table slots.  One traversal instead of the reference's
+    compensate/encode/decode/error chain.  8-bit-and-under codes take
+    the Pallas path (see :func:`quantize_pack`)."""
+    impl = None
+    if table.bits > 8 and resolve_impl("quantize_pack_ef") != "xla":
+        impl = "xla"
+    _, fn = _resolve("quantize_pack_ef", impl=impl)
+    return fn(table, rows, carried, mask)
+
+
+register_kernel("dedup_ids", phase="dedup",
+                reference=_dedup_reference, pallas=_dedup_pallas)
+register_kernel("merge_rows", phase="merge",
+                reference=_merge_reference, pallas=_merge_pallas)
+register_kernel("merge_apply", phase="apply",
+                reference=_merge_apply_reference, pallas=_merge_apply_pallas)
+register_kernel("quantize_pack", phase="pack",
+                reference=_qp_reference, pallas=_qp_pallas)
+register_kernel("quantize_pack_ef", phase="pack",
+                reference=_qp_ef_reference, pallas=_qp_ef_pallas)

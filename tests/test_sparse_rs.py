@@ -346,6 +346,93 @@ def test_sparse_ef_requires_fixed_range(rng):
         sparse_all_reduce(mesh, jnp.asarray(uids), jnp.asarray(rows),
                           compress_bits=8, compress_range="dynamic",
                           residual=res)
+    with pytest.raises(ValueError, match="dynamic"):
+        sparse_reduce_scatter(mesh, jnp.asarray(uids), jnp.asarray(rows),
+                              vocab=8, compress_bits=8,
+                              compress_range="dynamic", residual=res)
+
+
+def test_rs_ef_residual_drains_and_recovers_clip(rng):
+    """The reduce-scatter mirror of the allgather EF drain test (the PR 7
+    follow-up): fixed compress_range + a spike beyond it.  WITHOUT EF the
+    clipped mass is lost at the member-side scatter encode; WITH the
+    residual carry the remainder is delivered over the following rounds
+    and the carry drains to sub-bucket noise.  Ids are owner-spread (one
+    per ``uid % n`` owner) so the default capacities hold — overflow has
+    its own carry-forward test below.  Mean exchange: stage 2 (the merged
+    owner shards) cannot clip, so stage-1 EF recovers everything up to
+    per-round rounding (see _rs_gather_rows)."""
+    n, vocab, k, dim, bits, crange = 4, 32, 6, 3, 8, 1.0
+    mesh = make_mesh(MeshSpec(data=n))
+    # owners 1, 2, 3, 0 — one id per owner, no bucket pressure
+    uids = np.tile(np.array([1, 2, 7, 8, 0, 0], np.int64), (n, 1))
+    spike = np.zeros((n, k, dim), np.float32)
+    spike[:, :4] = 2.5  # 2.5x the codec range: clips hard
+    zero = np.zeros_like(spike)
+    touched = [1, 2, 7, 8]
+
+    # single-shot, no EF: the spike round delivers at most ~range/member
+    applied_no = np.zeros((vocab, dim), np.float32)
+    for t in range(8):
+        g = spike if t == 0 else zero
+        gu, m, over = sparse_reduce_scatter(
+            mesh, jnp.asarray(uids), jnp.asarray(g), average=True,
+            vocab=vocab, compress_bits=bits, compress_range=crange,
+        )
+        assert int(np.asarray(over)[0]) == 0
+        applied_no += dense_scatter(vocab, dim, np.asarray(gu)[0],
+                                    np.asarray(m)[0])
+    assert applied_no[1, 0] < crange * 1.01  # clipped at ~range, not 2.5
+
+    res = sparse_ef_residual_init(mesh, (vocab, dim))
+    applied = np.zeros((vocab, dim), np.float32)
+    for t in range(8):
+        g = spike if t == 0 else zero
+        gu, m, over, res = sparse_reduce_scatter(
+            mesh, jnp.asarray(uids), jnp.asarray(g), average=True,
+            vocab=vocab, compress_bits=bits, compress_range=crange,
+            residual=res,
+        )
+        applied += dense_scatter(vocab, dim, np.asarray(gu)[0],
+                                 np.asarray(m)[0])
+    bucket_w = 2 * crange / (1 << bits)
+    assert float(np.max(np.abs(np.asarray(res)))) <= bucket_w, (
+        "residual must drain to sub-bucket noise"
+    )
+    # touched rows recover the full mean (2.5) to within rounding; the
+    # id-0 dump row keeps the coded path's half-bucket junk and is
+    # excluded (pre-existing coded-exchange behavior, not an EF effect)
+    np.testing.assert_allclose(applied[touched], 2.5, rtol=0,
+                               atol=8 * n * bucket_w)
+    # acceptance: delivered clipped mass beats the no-EF baseline
+    assert applied[touched].mean() > 1.5 * applied_no[touched].mean()
+
+
+def test_rs_ef_overflow_carries_full_value(rng):
+    """A bucket-overflow victim (3 ids on one owner, bucket_cap=2) ships
+    nothing — without EF that mass is silently dropped; with EF the FULL
+    value lands in the carry instead (the documented dropped-entry
+    contract), so the in-jit overflow counter plus the carry account for
+    every bit of gradient mass."""
+    n, vocab, dim, bits, crange = 4, 32, 2, 8, 1.0
+    mesh = make_mesh(MeshSpec(data=n))
+    # owners: 1, 1, 1 — uid 9 overflows bucket_cap=2 deterministically
+    uids = np.tile(np.array([1, 5, 9, 0], np.int64), (n, 1))
+    rows = 0.5 * np.ones((n, 4, dim), np.float32)
+    rows[:, 3] = 0.0
+    res = sparse_ef_residual_init(mesh, (vocab, dim))
+    gu, m, over, res = sparse_reduce_scatter(
+        mesh, jnp.asarray(uids), jnp.asarray(rows), average=True,
+        vocab=vocab, bucket_cap=2, shard_cap=8,
+        compress_bits=bits, compress_range=crange, residual=res,
+    )
+    assert int(np.asarray(over)[0]) > 0
+    merged = dense_scatter(vocab, dim, np.asarray(gu)[0], np.asarray(m)[0])
+    assert abs(merged[9, 0]) < 1e-6          # victim shipped nothing
+    r0 = np.asarray(res)[0]
+    np.testing.assert_allclose(r0[9], 0.5, rtol=0, atol=1e-6)  # full carry
+    bucket_w = 2 * crange / (1 << bits)
+    assert np.abs(r0[[1, 5]]).max() <= bucket_w / 2 + 1e-7  # quant noise
 
 
 # -- hybrid trainer: rs pick, parity, fallback ---------------------------
@@ -561,3 +648,58 @@ def test_hybrid_fixed_range_ef_tracks_exact_under_coarse_codec(rng):
         err_no = np.abs(np.asarray(tr_no.params[key])
                         - np.asarray(exact.params[key]))[touched].mean()
         assert err_ef < 0.5 * err_no, (key, err_ef, err_no)
+
+
+def test_hybrid_rs_fixed_range_ef_delivers_clipped_mass(rng):
+    """The REDUCE-SCATTER mirror of the fixed-range EF trainer test (the
+    ISSUE 9 satellite closing the PR 7 follow-up): a wide embedding table
+    in the rs-picked regime under a tight fixed range — the spike's
+    clipped mass lands in the per-table carry (stage-1 member-side EF on
+    the scatter encode) and is delivered over the following steps, so the
+    touched rows move measurably further than the no-EF run, whose
+    clipped mass is simply lost."""
+    f, nrows, nnz, dim = 4096, 1024, 8, 64
+    fids = rng.integers(1, f, size=(nrows, nnz)).astype(np.int32)
+    ones = np.ones(nrows, np.float32)
+
+    def mk(vals_scale):
+        return {
+            "fids": fids, "fields": np.zeros_like(fids),
+            "vals": vals_scale * np.ones((nrows, nnz), np.float32),
+            "mask": np.ones((nrows, nnz), np.float32), "labels": ones,
+        }
+
+    spike, normal = mk(20.0), mk(1.0)
+    params = fm.init(jax.random.PRNGKey(0), f, dim)
+    mesh = make_mesh(MeshSpec(data=N))
+
+    def trainer(ef):
+        tr = SparseTableCTRTrainer(
+            params, fm.logits, TrainConfig(learning_rate=0.05),
+            sparse_tables={"w": ["fids"], "v": ["fids"]}, mesh=mesh,
+            compress_bits=8, compress_range=0.05, compress_mode="uniform",
+            error_feedback=ef,
+        )
+        tr.health = None
+        return tr
+
+    tr, tr_no = trainer(True), trainer(False)
+    plan = tr._exchange_plan(spike)
+    assert plan["v"][1] == "sparse_rs", plan   # the regime under test
+    assert tr._rs_batch_fits(spike, plan)
+    tr.train_step(spike)
+    tr_no.train_step(spike)
+    assert tr.exchange_policy["v"] == "sparse_rs"
+    res_after_spike = float(
+        np.abs(np.asarray(tr.opt_state["sres"]["v"])).max())
+    assert res_after_spike > 0.05, "clip mass must land in the rs carry"
+    for _ in range(8):
+        tr.train_step(normal)
+        tr_no.train_step(normal)
+    touched = np.unique(fids)
+    v0 = np.asarray(params["v"])
+    dv_ef = np.abs(np.asarray(tr.params["v"]) - v0)[touched]
+    dv_no = np.abs(np.asarray(tr_no.params["v"]) - v0)[touched]
+    # labels=1 spike pushes the touched rows; EF delivers the clipped
+    # remainder late, no-EF loses it (measured ~2x in this regime)
+    assert dv_ef.mean() > 1.2 * dv_no.mean(), (dv_ef.mean(), dv_no.mean())

@@ -28,6 +28,11 @@ turns either into something readable:
       #    factor / bytes resident for FLAT stores, plus per-tier
       #    occupancy, hit/fault/demotion counters, and fault-path
       #    latency for TIERED stores
+  python -m tools.metrics_report --kernels SNAPSHOT_JSON
+      # -> which sparse-hot-path kernel implementation actually ran
+      #    (trainer_kernel_path_total{phase,impl} from a registry
+      #    snapshot or stats() dump): per-phase dispatch counts for
+      #    pallas / interpret / xla — measured, not assumed
 """
 
 from __future__ import annotations
@@ -303,6 +308,40 @@ def summarize_store(doc) -> dict:
     return {"shards": out_shards, "totals": totals}
 
 
+def summarize_kernels(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> per-phase kernel dispatch report: how many traces
+    resolved each implementation of ``trainer_kernel_path_total``.  The
+    counter increments once per dispatch at trace time (the pick is
+    static inside jit), so this answers "which implementation actually
+    ran" — the honesty check docs/KERNELS.md's bench methodology leans
+    on."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    phases: dict = {}
+    total_by_impl: dict = {}
+    prefix = "trainer_kernel_path_total{"
+    for name, val in counters.items():
+        if not name.startswith(prefix):
+            continue
+        labels = dict(
+            part.split("=", 1)
+            for part in name[len(prefix):-1].replace('"', "").split(",")
+        )
+        phase = labels.get("phase", "?")
+        impl = labels.get("impl", "?")
+        phases.setdefault(phase, {})[impl] = \
+            phases.get(phase, {}).get(impl, 0) + int(val)
+        total_by_impl[impl] = total_by_impl.get(impl, 0) + int(val)
+    return {
+        "phases": {p: dict(sorted(v.items())) for p, v in
+                   sorted(phases.items())},
+        "dispatches_by_impl": dict(sorted(total_by_impl.items())),
+        "fused_active": bool(total_by_impl.get("pallas", 0)
+                             + total_by_impl.get("interpret", 0)),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -322,6 +361,10 @@ def main(argv=None):
                     help="summarize store occupancy (flat AND tiered) "
                          "from a PS stats() dump — one shard's dict or a "
                          "ShardedPSClient.stats() list")
+    ap.add_argument("--kernels", metavar="SNAPSHOT_JSON",
+                    help="summarize sparse-kernel dispatch counts "
+                         "(trainer_kernel_path_total{phase,impl}) from a "
+                         "registry snapshot or stats() dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -357,9 +400,19 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.kernels:
+        with open(args.kernels) as f:
+            doc = json.load(f)
+        report = summarize_kernels(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
-                 "--health PATH, --serve STATS_JSON, or --store STATS_JSON")
+                 "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
+                 "or --kernels SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

@@ -373,6 +373,27 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
         "the grid must cover the rs-winning regime"
     )
 
+    # live kernel-dispatch cell (ISSUE 9): which sparse-hot-path kernel
+    # implementation the trainer cells above ACTUALLY ran, read from the
+    # same trainer_kernel_path_total{phase,impl} counters a production
+    # scrape sees (the dispatch counts to the process default registry at
+    # trace time) — off-TPU this records the XLA reference path honestly.
+    from lightctr_tpu import obs as obs_mod
+    from lightctr_tpu.ops import sparse_kernels
+    from tools.metrics_report import summarize_kernels
+
+    kernel_cell = summarize_kernels(obs_mod.default_registry().snapshot())
+    kernel_cell["resolved"] = {
+        name: sparse_kernels.resolve_impl(name)
+        for name in sorted(sparse_kernels.KERNELS)
+    }
+    kernel_cell["note"] = (
+        "dispatch counts from the live trainer cells above (once per "
+        "traced program per kernel); 'resolved' is the capability-gated "
+        "pick on THIS platform — pallas only on a real TPU, so a CPU run "
+        "records the reference path instead of faking a fused win"
+    )
+
     criteo_like = sweep[-1]
     report = {
         "metric": "sparse_exchange_bytes_reduction_at_criteo_density",
@@ -407,6 +428,7 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
             "crossover": crossover,
         },
         "rs_trainer_cell": trainer_rs,
+        "kernel_dispatch": kernel_cell,
     }
     print(json.dumps({k: v for k, v in report.items() if k != "sweep"},
                      indent=1))
