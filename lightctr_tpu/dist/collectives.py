@@ -21,6 +21,10 @@ one logical flat buffer for the collective.
 
 from __future__ import annotations
 
+import os
+import time
+from typing import NamedTuple, Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -524,6 +528,189 @@ def sparse_rs_bytes(
 RS_DENSE_MARGIN = 0.9
 
 
+# -- hierarchical two-level exchange: bandwidth model + byte accounting ------
+#
+# A multi-HOST deployment has two fabrics: the intra-host interconnect (ICI
+# — the mesh the in-jit collectives above run on) and the cross-host
+# datacenter network (DCN — the socket PS wire of dist/hier.py).  A flat
+# collective spanning both runs at the SLOWEST link's speed: every ring/
+# ppermute schedule above pipelines one segment per hop, so the hop crossing
+# the DCN gates the whole exchange.  The hierarchical exchange instead
+# aggregates WHERE THE DATA CROSSES THE SLOW LINK (the in-network-aggregation
+# argument, arXiv:2205.05243, on SparCML-style sparse payloads): replicas
+# merge over ICI first, then exactly ONE merged (uids, rows) payload per host
+# rides the DCN — cross-host bytes O(touched-per-host) regardless of local
+# replica count.  The pick between the flat algorithms and the hierarchy is
+# therefore a TIME comparison over measured link bandwidths, not a byte
+# comparison on one fabric.
+
+#: fallback link speeds (bytes/s) when neither the env override nor a probe
+#: supplied a measurement: a v4-ish ICI link vs a 2x25GbE-ish DCN share —
+#: the ~16x gap typical of TPU pods, so the un-probed default already
+#: prefers aggregation before the slow link
+DEFAULT_ICI_BPS = 4.0e9
+DEFAULT_DCN_BPS = 2.5e8
+
+#: env override: ``LIGHTCTR_LINK_BW="<ici_bytes_per_s>:<dcn_bytes_per_s>"``
+LINK_BW_ENV = "LIGHTCTR_LINK_BW"
+
+
+class LinkBandwidth(NamedTuple):
+    """Measured (or configured) fabric speeds the cost model prices with.
+    ``source``: "env" | "probe" | "default" — artifacts record where the
+    numbers came from, so a defaulted model can't masquerade as measured."""
+
+    ici_bps: float
+    dcn_bps: float
+    source: str = "default"
+
+
+_link_bw_cache: Optional[LinkBandwidth] = None
+
+
+def link_bandwidth(
+    probe_ici=None, probe_dcn=None, refresh: bool = False
+) -> LinkBandwidth:
+    """The process's link-bandwidth estimate, resolved once and cached
+    (re-probing every trace would make the trace-time pick flap with probe
+    noise — the measurement is sticky by construction; ``refresh=True``
+    re-resolves).  Priority: :data:`LINK_BW_ENV` override, then the probe
+    callables (zero-arg -> bytes/s; e.g. :func:`measure_ici_bw` /
+    ``HierExchangeClient.probe_bw``), then the documented defaults.  A
+    cached DEFAULT resolution never shadows a later call that brings
+    probes: an early probe-less ``pick_exchange_algo`` must not pin the
+    fallback numbers for the whole process."""
+    global _link_bw_cache
+    if _link_bw_cache is not None and not refresh:
+        if _link_bw_cache.source != "default" or (
+                probe_ici is None and probe_dcn is None):
+            return _link_bw_cache
+    env = os.environ.get(LINK_BW_ENV, "").strip()
+    if env:
+        ici_s, _, dcn_s = env.partition(":")
+        bw = LinkBandwidth(float(ici_s), float(dcn_s or ici_s), "env")
+    else:
+        ici = dcn = None
+        try:
+            ici = float(probe_ici()) if probe_ici is not None else None
+        except Exception:  # a failed probe degrades to the default, loudly
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ICI bandwidth probe failed; using default", exc_info=True
+            )
+        try:
+            dcn = float(probe_dcn()) if probe_dcn is not None else None
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "DCN bandwidth probe failed; using default", exc_info=True
+            )
+        source = "probe" if (ici is not None or dcn is not None) else "default"
+        bw = LinkBandwidth(ici or DEFAULT_ICI_BPS, dcn or DEFAULT_DCN_BPS,
+                           source)
+    if bw.ici_bps <= 0 or bw.dcn_bps <= 0:
+        raise ValueError(f"link bandwidths must be positive, got {bw}")
+    _link_bw_cache = bw
+    return bw
+
+
+def measure_ici_bw(mesh: Mesh, axis: str = "data",
+                   payload_bytes: int = 1 << 22, reps: int = 3) -> float:
+    """Startup ICI probe: median post-compile wall time of one tiled
+    ``all_gather`` of a ``payload_bytes`` fp32 vector over the mesh axis ->
+    bytes each member transmitted per second ((n-1)/n of the gathered
+    array rides this member's outgoing link)."""
+    n = mesh.shape[axis]
+    if n < 2:
+        return DEFAULT_ICI_BPS
+    per = max(1, payload_bytes // 4 // n)
+    x = jnp.zeros((n, per), jnp.float32)
+
+    def local(v):
+        return jax.lax.all_gather(v[0], axis, tiled=True)[None]
+
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=P(axis),
+                           out_specs=P(axis)))
+    jax.block_until_ready(fn(x))  # compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        ts.append(time.perf_counter() - t0)
+    moved = (n - 1) * per * 4  # bytes through one member's outgoing link
+    return moved / max(float(np.median(ts)), 1e-9)
+
+
+def expected_union(k: int, vocab: int, members: int) -> int:
+    """Expected unique-id union of ``members`` independent K-id streams
+    over ``vocab`` rows (the same uniform-id estimator
+    :func:`rs_default_caps` sizes its shards with)."""
+    density = min(max(int(k), 1) / float(max(int(vocab), 1)), 1.0)
+    u = float(vocab) * (1.0 - (1.0 - density) ** max(int(members), 1))
+    return max(1, min(int(u) + 1, int(vocab), int(members) * int(k)))
+
+
+def hier_wire_bytes(
+    k_out: int, k_in: int, dim: int, wire_bits: int | None = None,
+    include_ids: bool = True,
+) -> int:
+    """Bytes ONE HOST moves over the DCN per hierarchical exchange of one
+    table: push its ``k_out`` locally-merged entries + pull the
+    ``k_in``-entry cross-host union, each entry an id plus ``dim`` values
+    (``wire_bits`` None = the exact fp32 wire codec, 16 = the PS fp16
+    codec, <=8 = 1-byte codes).  Flat in local replica count by
+    construction — the replicas merged before the wire."""
+    idb = 4 if include_ids else 0
+    per = idb + int(dim) * _wire_value_bytes(wire_bits)
+    return int((int(k_out) + int(k_in)) * per)
+
+
+def hier_exchange_bytes(
+    local_n: int,
+    n_hosts: int,
+    k_padded: int,
+    vocab: int,
+    dim: int,
+    sparse_bits: int | None = None,
+    wire_bits: int | None = None,
+    slack: float = RS_SLACK,
+) -> tuple[str, int, int]:
+    """Static-shape byte model of the two-level exchange ->
+    ``(local_algo, local_ici_bytes, dcn_wire_bytes)``: the intra-host
+    merge rides the cheaper of the two in-jit sparse collectives over the
+    ``local_n``-replica mesh (``local_algo``), then one merged payload per
+    host (expected union of the local streams) is pushed and the expected
+    cross-host union pulled over the DCN.  ``k_padded`` is the PER-REPLICA
+    padded id count, as everywhere in this module."""
+    ag_b = sparse_exchange_bytes(local_n, k_padded, dim, sparse_bits)
+    bucket, shard = rs_default_caps(local_n, k_padded, vocab, slack)
+    rs_b = sparse_rs_bytes(local_n, bucket, shard, dim, sparse_bits)
+    local_algo, local_b = (
+        ("sparse", ag_b) if ag_b <= rs_b else ("sparse_rs", rs_b)
+    )
+    if local_n <= 1:
+        local_algo, local_b = "none", 0
+    k_out = expected_union(k_padded, vocab, local_n)
+    k_in = expected_union(k_padded, vocab, local_n * n_hosts)
+    return local_algo, local_b, hier_wire_bytes(k_out, k_in, dim, wire_bits)
+
+
+#: hysteresis the HIERARCHICAL pick must clear against the best flat
+#: algorithm's modeled time: the wire stage pays a push+pull round trip,
+#: host staging and the reduce rendezvous barrier that a pure
+#: bytes/bandwidth model does not see — a near-tie stays on the flat path
+#: (the same contract as :data:`RS_DENSE_MARGIN`)
+HIER_DCN_MARGIN = 0.9
+
+#: switch-away hysteresis when a previous pick is supplied: the challenger
+#: must beat the incumbent's modeled time by this factor before the pick
+#: moves — bandwidth re-probes jitter a few percent run to run, and a
+#: per-table algorithm that flaps re-traces the whole step program
+PICK_FLAP_MARGIN = 0.8
+
+
 def pick_exchange_algo(
     n: int,
     k_padded: int,
@@ -534,28 +721,91 @@ def pick_exchange_algo(
     margin: float = 1.0,
     slack: float = RS_SLACK,
     rs_margin: float = RS_DENSE_MARGIN,
+    local_n: int | None = None,
+    bw: LinkBandwidth | None = None,
+    wire_bits: int | None = None,
+    prev: str | None = None,
+    hier_margin: float = HIER_DCN_MARGIN,
 ) -> tuple[str, int]:
-    """Three-way trace-time pick (SparCML's density switch, now with the
-    reduce-scatter option): ``("dense" | "sparse" | "sparse_rs", bytes)``
-    from static shapes alone — density (k_padded/vocab), vocab, dim and
-    world size.  The cheaper sparse variant must still beat ``margin``
-    times the dense ring (same hysteresis contract as
-    :func:`prefer_sparse_exchange`), and the reduce-scatter variant
-    additionally ``rs_margin`` times it (see :data:`RS_DENSE_MARGIN`);
-    otherwise the worst-case-safe dense path wins."""
+    """Trace-time exchange pick -> ``(algo, bytes)``.
+
+    SINGLE-FABRIC form (``local_n`` None or == ``n``): the three-way byte
+    pick of PR 5 (SparCML's density switch with the reduce-scatter
+    option) — ``"dense" | "sparse" | "sparse_rs"`` from static shapes
+    alone.  The cheaper sparse variant must still beat ``margin`` times
+    the dense ring, the reduce-scatter variant additionally ``rs_margin``
+    times it (:data:`RS_DENSE_MARGIN`); otherwise the worst-case-safe
+    dense path wins.
+
+    TWO-FABRIC form (``local_n`` < ``n``, i.e. ``n_hosts = n / local_n``
+    hosts of ``local_n`` replicas): a bandwidth-aware COST model.  The
+    flat algorithms schedule host-oblivious — of each member's ``B``
+    transmitted bytes, the off-host peer share ``(n - local_n)/(n - 1)``
+    crosses a host boundary, and the host's ``local_n`` members share ONE
+    DCN uplink — so their modeled time is
+    ``local_n * B * cross / dcn_bps + B * (1 - cross) / ici_bps``.  The
+    ``"hier"`` candidate aggregates before the slow link (the in-network-
+    aggregation move): ``local_bytes / ici_bps + wire_bytes / dcn_bps``
+    (:func:`hier_exchange_bytes`) — the uplink carries one merged payload
+    per host instead of every replica's, which is exactly why cross-host
+    bytes stay flat in ``local_n``.  ``bw`` defaults to the process's
+    cached :func:`link_bandwidth` (env override / probe / default).
+    ``hier`` must beat the best flat candidate by ``hier_margin``
+    (:data:`HIER_DCN_MARGIN`), and with ``prev`` given the incumbent
+    keeps the pick unless the challenger wins by
+    :data:`PICK_FLAP_MARGIN` — two hystereses so the pick never flaps on
+    probe noise.  For the hier branch the returned bytes are the DCN WIRE
+    bytes per host (the scarce resource the pick is protecting);
+    ``wire_bits`` prices the wire codec (None = exact fp32, 16 = the PS
+    fp16 codec)."""
     dense_b = dense_ring_bytes(vocab, dim, n, dense_bits)
     ag_b = sparse_exchange_bytes(n, k_padded, dim, sparse_bits)
     bucket, shard = rs_default_caps(n, k_padded, vocab, slack)
     rs_b = sparse_rs_bytes(n, bucket, shard, dim, sparse_bits)
-    algo, sb = ("sparse", ag_b) if ag_b <= rs_b else ("sparse_rs", rs_b)
-    eff = margin * (rs_margin if algo == "sparse_rs" else 1.0)
-    if sb <= eff * dense_b:
-        return algo, sb
-    if algo == "sparse_rs" and ag_b <= margin * dense_b:
-        # rs failed its stricter dense hysteresis but the allgather still
-        # clears the plain density switch
-        return "sparse", ag_b
-    return "dense", dense_b
+
+    def flat_pick() -> tuple[str, int]:
+        algo, sb = ("sparse", ag_b) if ag_b <= rs_b else ("sparse_rs", rs_b)
+        eff = margin * (rs_margin if algo == "sparse_rs" else 1.0)
+        if sb <= eff * dense_b:
+            return algo, sb
+        if algo == "sparse_rs" and ag_b <= margin * dense_b:
+            # rs failed its stricter dense hysteresis but the allgather
+            # still clears the plain density switch
+            return "sparse", ag_b
+        return "dense", dense_b
+
+    if local_n is None or local_n >= n:
+        return flat_pick()
+    if n % local_n:
+        raise ValueError(
+            f"world {n} is not a whole number of {local_n}-replica hosts"
+        )
+    if bw is None:
+        bw = link_bandwidth()
+    n_hosts = n // local_n
+    _, hier_local_b, hier_wire_b = hier_exchange_bytes(
+        local_n, n_hosts, k_padded, vocab, dim,
+        sparse_bits=sparse_bits, wire_bits=wire_bits, slack=slack,
+    )
+    flat_algo, flat_b = flat_pick()
+    cross = (n - local_n) / (n - 1)  # off-host share of per-peer traffic
+
+    def flat_time(b: int) -> float:
+        return (local_n * b * cross / bw.dcn_bps
+                + b * (1.0 - cross) / bw.ici_bps)
+
+    times = {
+        flat_algo: flat_time(flat_b),
+        "hier": (hier_local_b / bw.ici_bps + hier_wire_b / bw.dcn_bps),
+    }
+    bytes_of = {flat_algo: flat_b, "hier": hier_wire_b}
+    best = min(times, key=times.get)
+    if best == "hier" and times["hier"] > hier_margin * times[flat_algo]:
+        best = flat_algo  # near-tie: stay on the flat path
+    if prev is not None and prev in times and best != prev:
+        if times[best] > PICK_FLAP_MARGIN * times[prev]:
+            best = prev  # incumbent keeps a contested pick
+    return best, bytes_of[best]
 
 
 def rs_fits(
@@ -946,6 +1196,8 @@ def _rs_gather_rows(
     compress_mode: str = "uniform",
     uids: jax.Array | None = None,
     residual: jax.Array | None = None,
+    owner_uids: jax.Array | None = None,
+    owner_residual: jax.Array | None = None,
 ):
     """Row half of the reduce-scatter exchange against a SHARED id plan
     (``dest``/``order`` from :func:`rs_owner_partition`, ``inv`` from
@@ -962,18 +1214,29 @@ def _rs_gather_rows(
     member-side scatter-phase encode is compensated with last step's
     remainder and the fresh clip+quantization error lands back at the
     rows' slots, so clipped mass is delivered late instead of lost; an
-    entry dropped by bucket overflow carries its FULL value forward.  The
-    owner-side merged-shard encode is NOT compensated: in ``average``
+    entry dropped by bucket overflow carries its FULL value forward.
+
+    ``owner_residual``: optional [vocab, ...] per-member STAGE-2 carry for
+    the owner-side merged-shard encode (requires ``owner_uids`` — the
+    owner's merged shard ids from :func:`_rs_merge_ids`).  In ``average``
     mode the merged mean of decoded (range-bounded) values cannot clip,
-    so stage 2 adds only sub-bucket rounding noise (in sum mode it can
-    clip — EF here assumes the trainer's mean exchange).  Returns
-    ``(gathered, new_residual)`` when a residual is given, else
-    ``gathered``."""
+    so stage 2 adds only sub-bucket rounding noise and the carry is
+    rejected as pointless; in SUM mode the owner's merge can reach
+    ``n * compress_range`` and the stage-2 encode clips systematically —
+    the owner-side carry mirrors the stage-1 member carry (each member
+    owns the ``uid % n == idx`` rows, so the per-member [vocab, ...]
+    carries partition cleanly and never collide across members).
+
+    Returns ``gathered``, ``(gathered, new_residual)`` when ``residual``
+    is given, and ``(gathered, new_residual | None, new_owner_residual)``
+    when ``owner_residual`` is."""
     from lightctr_tpu.ops import quantize, sparse_kernels
 
     use_ef = residual is not None
+    use_owner_ef = owner_residual is not None
     new_residual = None
-    if use_ef:
+    table = None
+    if use_ef or use_owner_ef:
         if compress_bits is None:
             raise ValueError("sparse error feedback needs compress_bits")
         if not isinstance(compress_range, (int, float)):
@@ -981,12 +1244,21 @@ def _rs_gather_rows(
                 "sparse error feedback compensates FIXED-range clipping; "
                 "compress_range='dynamic' never clips — pass a float range"
             )
-        if uids is None:
-            raise ValueError("sparse error feedback needs uids")
         table = quantize.build_table(
             -compress_range, compress_range,
             bits=compress_bits, mode=compress_mode,
         )
+    if use_owner_ef:
+        if average:
+            raise ValueError(
+                "owner_residual is a SUM-mode carry: the averaged merged "
+                "shard cannot clip, stage 2 needs no compensation"
+            )
+        if owner_uids is None:
+            raise ValueError("owner-side error feedback needs owner_uids")
+    if use_ef:
+        if uids is None:
+            raise ValueError("sparse error feedback needs uids")
         mask = _ef_valid_mask(uids, rows)
         carried = jnp.take(residual, uids, axis=0)
         val = rows + carried * mask
@@ -1024,6 +1296,21 @@ def _rs_gather_rows(
     )
     if average:
         merged = merged / n
+    if use_owner_ef:
+        # stage-2 EF: compensate the owner's merged-shard encode with the
+        # previous step's owner carry, scatter the fresh clip+quantization
+        # error back at the owned rows' slots (the fused EF pack pass) —
+        # the all-gathered codes decode identically on every member
+        mask_o = _ef_valid_mask(owner_uids, merged)
+        carried_o = jnp.take(owner_residual, owner_uids, axis=0)
+        codes_o, delta_o = sparse_kernels.quantize_pack_ef(
+            table, merged, carried_o, mask_o
+        )
+        new_owner_residual = owner_residual.at[owner_uids].add(delta_o)
+        gathered = quantize.extract(
+            table, jax.lax.all_gather(codes_o, axis_name, tiled=True)
+        )
+        return gathered, new_residual, new_owner_residual
     if compress_bits is not None:
         gathered = _coded_exchange(
             merged,
@@ -1049,6 +1336,7 @@ def _sparse_reduce_scatter_local(
     compress_range: float | str = "dynamic",
     compress_mode: str = "uniform",
     residual: jax.Array | None = None,
+    owner_residual: jax.Array | None = None,
 ):
     """Per-device body of :func:`sparse_reduce_scatter` (shard_map-inner,
     composable into larger programs — what the hybrid trainer embeds).
@@ -1065,9 +1353,10 @@ def _sparse_reduce_scatter_local(
     per value per step instead of the allgather variant's one, still far
     from the dense ring's per-hop accumulation.
 
-    ``residual``: [vocab, ...] per-member EF carry for clipped
-    fixed-range payloads (see :func:`_rs_gather_rows`); appends
-    ``new_residual`` to the return tuple."""
+    ``residual``: [vocab, ...] per-member stage-1 EF carry for clipped
+    fixed-range payloads; ``owner_residual``: [vocab, ...] stage-2
+    owner-side carry for SUM-mode exchanges (see :func:`_rs_gather_rows`);
+    each appends its new carry to the return tuple (stage-1 first)."""
     dest, order, bucket_ids, over_b = rs_owner_partition(uids, n, bucket_cap)
     all_ids = _rs_ring_exchange(bucket_ids, axis_name, n)
     uniq, inv, over_s = _rs_merge_ids(all_ids, shard_cap)
@@ -1077,7 +1366,14 @@ def _sparse_reduce_scatter_local(
         average=average, compress_bits=compress_bits,
         compress_range=compress_range, compress_mode=compress_mode,
         uids=uids, residual=residual,
+        owner_uids=uniq if owner_residual is not None else None,
+        owner_residual=owner_residual,
     )
+    if owner_residual is not None:
+        out_rows, new_residual, new_owner = out
+        if residual is not None:
+            return out_ids, out_rows, over_b + over_s, new_residual, new_owner
+        return out_ids, out_rows, over_b + over_s, new_owner
     if residual is not None:
         out_rows, new_residual = out
         return out_ids, out_rows, over_b + over_s, new_residual
@@ -1097,6 +1393,7 @@ def sparse_reduce_scatter(
     compress_range: float | str = "dynamic",
     compress_mode: str = "uniform",
     residual=None,
+    owner_residual=None,
 ):
     """Owner-partitioned sparse all-reduce — generation 2 of
     :func:`sparse_all_reduce` (SparCML's split allreduce,
@@ -1124,9 +1421,20 @@ def sparse_reduce_scatter(
     (:func:`sparse_ef_residual_init` layout — the PR 7 allgather EF,
     now on the reduce-scatter path; see :func:`_rs_gather_rows` for the
     stage-1/stage-2 contract).  Appends ``new_residual`` to the return.
+
+    ``owner_residual``: optional [n, vocab, ...] per-member STAGE-2
+    owner-side carry for SUM-mode (``average=False``) exchanges — the
+    merged owner shard can reach ``n * compress_range`` and the stage-2
+    encode clips systematically where the mean exchange cannot; the
+    owner carry mirrors the stage-1 member carry (same
+    :func:`sparse_ef_residual_init` layout; each member only ever
+    touches its ``uid % n`` owned rows, so the carries partition
+    cleanly).  Appends ``new_owner_residual`` to the return (after
+    ``new_residual`` when both are given).
     """
     n = mesh.shape[axis]
     use_ef = residual is not None
+    use_owner = owner_residual is not None
     if bucket_cap is None or shard_cap is None:
         if vocab is None:
             raise ValueError(
@@ -1137,27 +1445,32 @@ def sparse_reduce_scatter(
         bucket_cap = bucket_cap if bucket_cap is not None else db
         shard_cap = shard_cap if shard_cap is not None else ds
 
-    def local(u, r, res):
+    def local(u, r, res, ores):
         out = _sparse_reduce_scatter_local(
             u[0], r[0], axis, n, bucket_cap, shard_cap, average=average,
             compress_bits=compress_bits, compress_range=compress_range,
             compress_mode=compress_mode,
             residual=res[0] if use_ef else None,
+            owner_residual=ores[0] if use_owner else None,
         )
-        if use_ef:
-            gu, m, over, new_res = out
-            return gu[None], m[None], over[None], new_res[None]
-        gu, m, over = out
-        return gu[None], m[None], over[None], res
+        gu, m, over = out[0], out[1], out[2]
+        rest = out[3:]
+        new_res = rest[0][None] if use_ef else res
+        new_ores = rest[-1][None] if use_owner else ores
+        return gu[None], m[None], over[None], new_res, new_ores
 
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(P(axis), P(axis), P(axis)),
-                   out_specs=(P(axis), P(axis), P(axis), P(axis)))
+                   in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                   out_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)))
     res_in = residual if use_ef else jnp.zeros((n, 1), jnp.float32)
-    gu, m, over, new_res = fn(uids, rows, res_in)
+    ores_in = owner_residual if use_owner else jnp.zeros((n, 1), jnp.float32)
+    gu, m, over, new_res, new_ores = fn(uids, rows, res_in, ores_in)
+    out = (gu, m, over)
     if use_ef:
-        return gu, m, over, new_res
-    return gu, m, over
+        out = out + (new_res,)
+    if use_owner:
+        out = out + (new_ores,)
+    return out
 
 
 def psum_all_reduce(mesh: Mesh, stacked_tree, axis: str = "data", average: bool = True):

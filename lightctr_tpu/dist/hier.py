@@ -1,0 +1,557 @@
+"""Hierarchical two-level sparse exchange — the DCN half.
+
+The in-jit sparse collectives (``dist.collectives``) keep intra-host bytes
+O(touched) over the ICI; the socket PS wire merges across hosts.  Composing
+them is the software analogue of in-network aggregation (PAPERS.md:
+Programmable Switches, arXiv:2205.05243 — aggregate where the data crosses
+the slow link) applied to SparCML-style sparse payloads (arXiv:1802.08021):
+intra-host replicas first merge touched rows in-jit, then exactly ONE merged
+(uids, rows) payload per host rides this wire, and the pulled cross-host
+merge broadcasts back over the ICI — cross-host bytes stay
+O(touched-per-host) regardless of local replica count.
+
+This module is the rendezvous that wire needs:
+
+  - :class:`SparseReduceShard` — one owner shard of the reduce rendezvous:
+    a threaded socket service speaking the PS framing (``[u32 len][type]
+    [payload]``, ``dist.ps_server``) with the SAME hot-path ops.  MSG_PUSH
+    lands one host's merged (uids, rows) contribution for a ``(epoch,
+    table)`` round; once all ``n_hosts`` contributions arrived, MSG_PULL
+    answers the merged cross-host union (duplicate ids segment-summed,
+    exactly the owner-side merge of ``sparse_reduce_scatter`` — but across
+    the DCN).  A pull before the round completes gets the WITHHELD status
+    byte (the SSP pull convention) and the client retries with backoff.
+    Trace context rides the frames as in PR 3 (``wire.TRACE_FLAG``) and
+    telemetry lands in a registry served over MSG_STATS.
+  - :class:`HierExchangeClient` — the host-side stub: owner-partitions the
+    merged payload by ``uid % n_shards`` (the PS modulo family, so the
+    intra-host merge output is already shard-aligned), pushes every shard,
+    then pulls the merged unions back and splices them into one sorted
+    (uids, rows) pair.  ``push``/``pull`` are exposed separately so a
+    driver simulating several hosts in one process (the bench) can push
+    all hosts before any pull.
+
+Wire codec: the exact fp32 form (``pack_keys`` ++ raw fp32 rows — the PS
+admin-op encoding) is the default, because the exchange is a COLLECTIVE,
+not storage: every branch of the trainer's exchange stays dense-psum-exact,
+and a codec belongs behind an explicit knob exactly like ``compress_bits``
+on the in-jit paths.  ``codec="f16"`` ships ``wire.pack_rows`` instead (the
+PS hot-path fp16 policy, half the value bytes, the reference's training
+numerics).  Both forms are self-describing per the existing wire contracts.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from lightctr_tpu.dist import wire
+from lightctr_tpu.dist.ps_server import (
+    MAX_FRAME_BYTES,
+    MSG_CLOSE,
+    MSG_PULL,
+    MSG_PUSH,
+    MSG_STATS,
+    PSClient,
+    _recv_msg,
+)
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import trace as obs_trace
+from lightctr_tpu.obs.registry import MetricsRegistry, labeled
+
+#: push/pull header codec flag: bit 0 set = exact fp32 payload (pack_keys ++
+#: raw fp32 rows); clear = the fp16 ``wire.pack_rows`` frame
+FLAG_F32 = 1
+
+
+def _encode_payload(uids: np.ndarray, rows: np.ndarray, f32: bool) -> bytes:
+    if f32:
+        return wire.pack_keys(uids) + np.ascontiguousarray(
+            rows, np.float32
+        ).tobytes()
+    return wire.pack_rows(uids, rows)
+
+
+def _decode_payload(
+    payload: bytes, dim: int, f32: bool
+) -> Tuple[np.ndarray, np.ndarray]:
+    if f32:
+        keys, consumed = wire.split_keys(payload)
+        rows = np.frombuffer(payload[consumed:], np.float32)
+        if rows.size != len(keys) * dim:
+            raise ValueError(
+                f"f32 reduce payload carries {rows.size} values for "
+                f"{len(keys)} keys at dim {dim} (peer dim skew?)"
+            )
+        return keys, rows.reshape(len(keys), dim).copy()
+    keys, rows, consumed = wire.unpack_rows(payload, dim)
+    if consumed != len(payload):
+        raise ValueError(
+            f"reduce payload length mismatch: consumed {consumed} of "
+            f"{len(payload)} bytes (peer dim skew?)"
+        )
+    return keys, rows
+
+
+class _Round:
+    """One (epoch, table) reduction round: contributions keyed by host,
+    merged lazily on the first complete pull, garbage-collected once every
+    host pulled it back."""
+
+    __slots__ = ("contrib", "merged", "pulled", "dim")
+
+    def __init__(self, dim: int):
+        self.contrib: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.merged: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self.pulled: set = set()
+        self.dim = dim
+
+
+class SparseReduceShard:
+    """One owner shard of the cross-host reduce rendezvous (class
+    docstring above).  ``n_hosts`` is the round-completion bar: a pull is
+    withheld until that many distinct hosts pushed the round.
+
+    Determinism: contributions merge in HOST-ID order with one
+    ``np.add.at`` segment sum over the sorted union — every host pulls
+    bit-identical merged rows, the replicas-cannot-diverge contract of the
+    in-jit exchanges carried across the DCN."""
+
+    #: completed rounds older than this many epochs behind the newest seen
+    #: are dropped even if a host never pulled them (a crashed host must
+    #: not pin every round in memory forever)
+    ROUND_GC_LAG = 16
+
+    def __init__(self, n_hosts: int, host: str = "127.0.0.1", port: int = 0,
+                 registry: Optional[MetricsRegistry] = None):
+        if n_hosts < 1:
+            raise ValueError("n_hosts must be >= 1")
+        self.n_hosts = int(n_hosts)
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._rounds: Dict[Tuple[int, int], _Round] = {}
+        self._max_epoch = -(1 << 62)
+        self._counts = {"pushes": 0, "pulls": 0, "withheld": 0,
+                        "rounds_merged": 0, "protocol_errors": 0}
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._peers: List = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- the reduction ------------------------------------------------------
+
+    #: lingering probe rounds kept (a probe push whose client died before
+    #: the pull must not pin memory; epoch-lag GC cannot see them — probe
+    #: epochs are negative and deliberately exempt from it)
+    PROBE_ROUNDS_KEPT = 16
+
+    def _gc_locked(self) -> None:
+        # REAL rounds age out by epoch lag only (a completed round is
+        # retained until then so a host whose pull REPLY was lost can
+        # retry and still be served — see _pull); probe rounds are exempt
+        # from the lag (their epochs are negative, which would read as
+        # infinitely stale) and bounded by count instead
+        stale = [key for key in self._rounds
+                 if 0 <= key[0] < self._max_epoch - self.ROUND_GC_LAG]
+        # probe epochs grow MORE NEGATIVE as they get newer (per host,
+        # later reps are lower), so ascending order puts the newest first
+        # — keep the head, reap the tail (the oldest abandoned probes)
+        probes = sorted(key for key in self._rounds if key[0] < 0)
+        stale += probes[self.PROBE_ROUNDS_KEPT:]
+        for key in stale:
+            del self._rounds[key]
+
+    def _bar(self, epoch: int) -> int:
+        # negative epochs are single-contributor PROBE rounds (the
+        # bandwidth probe must complete without the other hosts)
+        return 1 if epoch < 0 else self.n_hosts
+
+    def _push(self, host_id: int, epoch: int, table: int,
+              keys: np.ndarray, rows: np.ndarray, dim: int) -> None:
+        with self._lock:
+            self._counts["pushes"] += 1
+            self._max_epoch = max(self._max_epoch, epoch)
+            rd = self._rounds.get((epoch, table))
+            if rd is None:
+                rd = self._rounds[(epoch, table)] = _Round(dim)
+            elif rd.dim != dim:
+                raise ValueError(
+                    f"round ({epoch}, {table}) dim skew: {rd.dim} vs {dim}"
+                )
+            if rd.merged is not None:
+                # a retried push after the merge (its reply was lost):
+                # at-least-once delivery, the contribution already counted
+                return
+            rd.contrib[host_id] = (keys, rows)
+            self._gc_locked()
+
+    def _pull(self, host_id: int, epoch: int, table: int
+              ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        bar = self._bar(epoch)
+        with self._lock:
+            rd = self._rounds.get((epoch, table))
+            if rd is None or (rd.merged is None
+                              and len(rd.contrib) < bar):
+                self._counts["withheld"] += 1
+                return None
+            if rd.merged is None:
+                # deterministic merge: host-id order, one segment sum
+                parts = [rd.contrib[h] for h in sorted(rd.contrib)]
+                keys = np.concatenate([p[0] for p in parts])
+                rows = np.concatenate([p[1] for p in parts])
+                uniq, inv = np.unique(keys, return_inverse=True)
+                merged = np.zeros((uniq.size, rd.dim), np.float32)
+                np.add.at(merged, inv.reshape(-1), rows)
+                rd.merged = (uniq, merged)
+                rd.contrib.clear()
+                self._counts["rounds_merged"] += 1
+            self._counts["pulls"] += 1
+            out = rd.merged
+            rd.pulled.add(host_id)
+            # REAL rounds are retained until the epoch-lag GC even after
+            # every host pulled: a pull whose REPLY was lost to a
+            # transient reset is retried by the client, and the retry
+            # must be served, not withheld until the timeout (pulls are
+            # as at-least-once-safe as pushes).  Probe rounds (bar 1,
+            # negative epoch) delete eagerly — a failed probe degrades
+            # to the default bandwidth by design.
+            if epoch < 0 and len(rd.pulled) >= bar:
+                del self._rounds[(epoch, table)]
+            return out
+
+    def stats(self) -> Dict:
+        with self._lock:
+            out = dict(self._counts)
+            out["rounds_open"] = len(self._rounds)
+            out["n_hosts"] = self.n_hosts
+        out["telemetry"] = self.registry.snapshot()
+        return out
+
+    # -- socket plumbing (the ps_server shape) ------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._peers = [(x, c) for x, c in self._peers if x.is_alive()]
+            self._peers.append((t, conn))
+
+    def _serve(self, conn: socket.socket):
+        reg = self.registry
+        try:
+            while True:
+                raw_type, payload = _recv_msg(conn, cap=MAX_FRAME_BYTES)
+                msg_type = raw_type & ~wire.TRACE_FLAG & 0xFF
+                frame_bytes = 5 + len(payload)
+                telem = obs_gate.enabled()
+                t0 = time.perf_counter() if telem else 0.0
+                try:
+                    rctx = None
+                    if raw_type & wire.TRACE_FLAG:
+                        rctx, used = wire.split_trace_ctx(payload)
+                        payload = payload[used:]
+                    op = {MSG_PUSH: "push", MSG_PULL: "pull",
+                          MSG_STATS: "stats"}.get(msg_type, "unknown")
+                    span_cm = obs_trace.span(
+                        "hier/" + op, remote=rctx, n_bytes=len(payload),
+                    ) if (msg_type != MSG_CLOSE
+                          and (rctx is not None or obs_trace.enabled())) \
+                        else _null_cm()
+                    sent = 0
+                    with span_cm:
+                        if msg_type == MSG_PUSH:
+                            hdr, used = wire.split_varint(payload, 5)
+                            host_id, epoch, table, dim, flags = (
+                                int(x) for x in hdr
+                            )
+                            keys, rows = _decode_payload(
+                                payload[used:], dim, bool(flags & FLAG_F32)
+                            )
+                            if len(keys) > 1 and not \
+                                    (np.diff(keys) > 0).all():
+                                raise ValueError(
+                                    "reduce push keys must be sorted unique"
+                                )
+                            self._push(host_id, epoch, table, keys, rows,
+                                       dim)
+                            conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
+                            sent = 6
+                        elif msg_type == MSG_PULL:
+                            hdr, _ = wire.split_varint(payload, 5)
+                            host_id, epoch, table, dim, flags = (
+                                int(x) for x in hdr
+                            )
+                            out = self._pull(host_id, epoch, table)
+                            if out is None:
+                                # round incomplete: the SSP withheld byte,
+                                # the client retries with backoff
+                                conn.sendall(
+                                    struct.pack("<IB", 1, 0) + b"\x01"
+                                )
+                                sent = 6
+                            else:
+                                body = _encode_payload(
+                                    out[0], out[1], bool(flags & FLAG_F32)
+                                )
+                                conn.sendall(
+                                    struct.pack("<IB", 1 + len(body), 0)
+                                    + b"\x00" + body
+                                )
+                                sent = 6 + len(body)
+                        elif msg_type == MSG_STATS:
+                            body = json.dumps(self.stats()).encode()
+                            conn.sendall(
+                                struct.pack("<IB", len(body), 0) + body
+                            )
+                            sent = 5 + len(body)
+                        elif msg_type == MSG_CLOSE:
+                            return
+                        else:
+                            conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                            sent = 6
+                    if telem:
+                        reg.inc(labeled("hier_reduce_requests_total", op=op))
+                        reg.observe(labeled("hier_reduce_op_seconds", op=op),
+                                    time.perf_counter() - t0)
+                        reg.inc("hier_reduce_bytes_received_total",
+                                frame_bytes)
+                        reg.inc("hier_reduce_bytes_sent_total", sent)
+                except (ValueError, struct.error):
+                    with self._lock:
+                        self._counts["protocol_errors"] += 1
+                    conn.sendall(struct.pack("<IB", 1, 0) + b"\xff")
+                    if telem:
+                        reg.inc("hier_reduce_protocol_errors_total")
+                    return
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._listener.close()
+        self._accept_thread.join(timeout=2.0)
+        for t, conn in self._peers:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t, _ in self._peers:
+            t.join(timeout=2.0)
+        self._peers = [(t, c) for t, c in self._peers if t.is_alive()]
+
+
+def _null_cm():
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class HierExchangeClient:
+    """Host-side stub of the hierarchical exchange: one transport per
+    reduce shard (the :class:`~lightctr_tpu.dist.ps_server.PSClient`
+    machinery — reconnect with backoff+jitter, trace headers, byte
+    counters), payloads owner-partitioned by ``uid % n_shards`` exactly
+    like the PS key router, so the intra-host merge output lands on the
+    shard that owns it without re-hashing.
+
+    ``codec``: ``"f32"`` (default — exact, the dense-psum-exact branch
+    contract) or ``"f16"`` (the PS hot-path ``pack_rows`` frame, half the
+    value bytes).  ``pull_timeout_s`` bounds the withheld-retry loop — a
+    peer host that died mid-step must surface as an error, not a hang.
+    """
+
+    #: withheld-pull backoff: start fast (the peer host is usually mid
+    #:  push), cap low (the rendezvous is latency-critical)
+    PULL_BACKOFF_BASE_S = 0.001
+    PULL_BACKOFF_CAP_S = 0.05
+
+    def __init__(self, addresses, host_id: int, n_hosts: int,
+                 codec: str = "f32", pull_timeout_s: float = 120.0,
+                 timeout: Optional[float] = None):
+        if not addresses:
+            raise ValueError("need at least one reduce shard address")
+        if codec not in ("f32", "f16"):
+            raise ValueError(f"unknown wire codec {codec!r}")
+        self.addresses = [tuple(a) for a in addresses]
+        self.n_shards = len(self.addresses)
+        self.host_id = int(host_id)
+        self.n_hosts = int(n_hosts)
+        self.codec = codec
+        self.pull_timeout_s = float(pull_timeout_s)
+        # PSClient as pure transport: dim is per-call in this protocol
+        # (rides the header), so the stub's own dim is never consulted
+        self.clients = [PSClient(a, dim=1, timeout=timeout)
+                        for a in self.addresses]
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def bytes_sent(self) -> int:
+        return sum(c.bytes_sent for c in self.clients)
+
+    @property
+    def bytes_received(self) -> int:
+        return sum(c.bytes_received for c in self.clients)
+
+    def _hdr(self, epoch: int, table: int, dim: int) -> bytes:
+        flags = FLAG_F32 if self.codec == "f32" else 0
+        return wire.pack_varint(np.array(
+            [self.host_id, epoch, table, dim, flags], np.int64
+        ))
+
+    # -- the exchange -------------------------------------------------------
+
+    def push(self, table: int, uids: np.ndarray, rows: np.ndarray,
+             epoch: int) -> None:
+        """Ship this host's merged (sorted-unique uids [n], rows [n, dim])
+        contribution for round ``(epoch, table)``, owner-partitioned
+        across the shards.  Every shard receives a frame (possibly empty —
+        the round bar counts HOSTS, so a host whose batch touched no ids
+        owned by a shard must still check in there)."""
+        uids = np.ascontiguousarray(uids, np.int64)
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim != 2 or rows.shape[0] != len(uids):
+            raise ValueError(
+                f"hier push rows must be [n_uids, dim], got {rows.shape} "
+                f"for {len(uids)} uids"
+            )
+        dim = rows.shape[1]
+        if len(uids) > 1 and not (np.diff(uids) > 0).all():
+            raise ValueError("hier push uids must be sorted unique")
+        hdr = self._hdr(epoch, table, dim)
+        f32 = self.codec == "f32"
+        shard = (uids % self.n_shards).astype(np.int64) if len(uids) else \
+            np.zeros(0, np.int64)
+        with obs_trace.span("hier_client/push", n_keys=int(uids.size),
+                            table=table, epoch=epoch):
+            for s, c in enumerate(self.clients):
+                idx = np.flatnonzero(shard == s)
+                body = _encode_payload(uids[idx], rows[idx], f32)
+                reply = c._rpc(MSG_PUSH, hdr + body)
+                if reply != b"\x00":
+                    raise ConnectionError(
+                        f"reduce shard {s} refused push for round "
+                        f"({epoch}, {table})"
+                    )
+
+    def pull(self, table: int, epoch: int, dim: int
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch round ``(epoch, table)``'s cross-host merge: per shard,
+        retry withheld replies with capped backoff until the round
+        completes, then splice the shard unions into one globally sorted
+        (uids [U], rows [U, dim]) pair."""
+        hdr = self._hdr(epoch, table, dim)
+        f32 = self.codec == "f32"
+        keys_parts, rows_parts = [], []
+        with obs_trace.span("hier_client/pull", table=table, epoch=epoch):
+            for s, c in enumerate(self.clients):
+                deadline = time.monotonic() + self.pull_timeout_s
+                attempt = 0
+                while True:
+                    # a shard-side protocol error replies b"\xff", which
+                    # _rpc surfaces as ProtocolRejection (raised, never
+                    # retried here); only the WITHHELD byte b"\x01" loops
+                    reply = c._rpc(MSG_PULL, hdr)
+                    if reply[:1] == b"\x00":
+                        k, r = _decode_payload(reply[1:], dim, f32)
+                        keys_parts.append(k)
+                        rows_parts.append(r)
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"reduce round ({epoch}, {table}) never "
+                            f"completed on shard {s} within "
+                            f"{self.pull_timeout_s}s (peer host down?)"
+                        )
+                    time.sleep(min(self.PULL_BACKOFF_CAP_S,
+                                   self.PULL_BACKOFF_BASE_S * (2 ** attempt)))
+                    attempt += 1
+        keys = np.concatenate(keys_parts) if keys_parts else \
+            np.zeros(0, np.int64)
+        rows = np.concatenate(rows_parts) if rows_parts else \
+            np.zeros((0, dim), np.float32)
+        order = np.argsort(keys, kind="stable")
+        return keys[order], rows[order]
+
+    def exchange(self, table: int, uids: np.ndarray, rows: np.ndarray,
+                 epoch: int) -> Tuple[np.ndarray, np.ndarray]:
+        """push + pull for one round — the per-table wire half of the
+        hierarchical exchange.  Blocks until every host's contribution
+        arrived (the rendezvous barrier)."""
+        rows = np.asarray(rows, np.float32)
+        self.push(table, uids, rows, epoch)
+        return self.pull(table, epoch, rows.shape[1])
+
+    # -- the DCN bandwidth probe (cost-model input) --------------------------
+
+    def probe_bw(self, payload_bytes: int = 1 << 18, reps: int = 3) -> float:
+        """Measured DCN bytes/s: round-trip a reduce round of
+        ``payload_bytes`` through shard 0 (push + pull moves the payload
+        both ways) on the reserved probe table, ``reps`` times, median.
+        Probe rounds ride NEGATIVE epochs, which the shard completes at a
+        single contribution — the probe needs no peer hosts (each host's
+        probe epochs are disjoint, so concurrent probes cannot collide)."""
+        dim = 64
+        n = max(1, payload_bytes // (4 * dim))
+        uids = np.arange(1, n + 1, dtype=np.int64) * self.n_shards  # shard 0
+        rows = np.ones((n, dim), np.float32)
+        c = self.clients[0]
+        flags = FLAG_F32 if self.codec == "f32" else 0
+        body = _encode_payload(uids, rows, bool(flags & FLAG_F32))
+        ts = []
+        for i in range(reps):
+            hdr = wire.pack_varint(np.array(
+                [self.host_id, -(self.host_id * reps + i + 1), PROBE_TABLE,
+                 dim, flags], np.int64
+            ))
+            t0 = time.perf_counter()
+            if c._rpc(MSG_PUSH, hdr + body) != b"\x00":
+                raise ConnectionError("probe push refused")
+            reply = c._rpc(MSG_PULL, hdr)
+            if reply[:1] != b"\x00":
+                raise ConnectionError("probe pull withheld (n_hosts > 1?)")
+            ts.append(time.perf_counter() - t0)
+        moved = 2 * len(body)  # push up + pull down
+        return moved / max(float(np.median(ts)), 1e-9)
+
+    def stats(self) -> List[Dict]:
+        out = []
+        for c in self.clients:
+            out.append(json.loads(c._rpc(MSG_STATS, b"").decode()))
+        return out
+
+    def close(self) -> None:
+        for c in self.clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+#: reserved table id for bandwidth-probe rounds — no real table uses it
+PROBE_TABLE = (1 << 30) - 1

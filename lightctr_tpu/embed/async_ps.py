@@ -182,6 +182,38 @@ class AsyncParamServer(SSPGateMixin):
         # moves — versioned invalidation with bounded staleness
         # (docs/SERVING.md), no per-row timestamps on the hot path
         self.write_version = 0
+        # per-key invalidation DELTAS: a bounded log of (version, touched
+        # uids) per bump, shipped in stats()["write_delta"] so the serving
+        # cache can drop ONLY the rows that actually changed instead of
+        # the whole cache.  Bounded two ways (entries and total uids);
+        # when a consumer's last-seen version predates the log's floor the
+        # delta no longer covers it and the consumer falls back to the
+        # full invalidation — correctness never rides on the log's depth.
+        self._write_log: list = []       # [(version, np.int64 uids)]
+        self._write_log_uids = 0
+        self._write_log_floor = 0        # log covers (floor, write_version]
+
+    #: write-delta log bounds: entries AND total logged uids — a stats
+    #: reply must stay a bounded control-plane payload no matter the
+    #: write pattern (overflow advances the floor; consumers whose last
+    #: observation predates the floor full-invalidate instead)
+    WRITE_LOG_MAX_ENTRIES = 128
+    WRITE_LOG_MAX_UIDS = 4096
+
+    def _note_write(self, keys: np.ndarray) -> None:
+        """Record the uids of one ``write_version`` bump (caller holds the
+        lock and has ALREADY bumped).  A superset of the truly-changed
+        keys is fine (the consumer merely drops a few extra cached rows);
+        a miss is not — every bump must either log or advance the floor."""
+        arr = np.ascontiguousarray(keys, np.int64).reshape(-1)
+        self._write_log.append((self.write_version, arr))
+        self._write_log_uids += int(arr.size)
+        while self._write_log and (
+                len(self._write_log) > self.WRITE_LOG_MAX_ENTRIES
+                or self._write_log_uids > self.WRITE_LOG_MAX_UIDS):
+            ver, dropped = self._write_log.pop(0)
+            self._write_log_uids -= int(dropped.size)
+            self._write_log_floor = ver
 
     # -- storage -----------------------------------------------------------
 
@@ -550,6 +582,7 @@ class AsyncParamServer(SSPGateMixin):
                 g = np.asarray(grads, np.float32).reshape(-1, self.dim)
                 self._apply(worker_id, self._slots_create(keys_arr), g)
                 self.write_version += 1
+                self._note_write(keys_arr)
             return True
 
     # -- elastic membership (rebalance support) -----------------------------
@@ -598,6 +631,7 @@ class AsyncParamServer(SSPGateMixin):
                 self._pending = []
                 self.evicted_keys += n
                 self.write_version += 1
+                self._note_write(keys_arr)
         if n and obs_gate.enabled():
             self.registry.inc("ps_store_evicted_keys_total", n)
         return n
@@ -653,6 +687,7 @@ class AsyncParamServer(SSPGateMixin):
                 self._shw[:, slots] = r
             if keys_arr.size:
                 self.write_version += 1
+                self._note_write(keys_arr)
 
     def snapshot(self) -> Dict[int, np.ndarray]:
         with self._lock:
@@ -700,6 +735,16 @@ class AsyncParamServer(SSPGateMixin):
                 "staleness_budget": self.staleness_threshold,
                 "evicted_keys": self.evicted_keys,
                 "write_version": self.write_version,
+                # per-key invalidation deltas (docs/SERVING.md): the
+                # bounded write log as [[version, [uids...]], ...] — a
+                # consumer at version v >= floor drops only the uids of
+                # entries with version > v; below the floor it must drop
+                # everything (the log no longer covers it)
+                "write_delta": {
+                    "floor": self._write_log_floor,
+                    "entries": [[int(v), u.tolist()]
+                                for v, u in self._write_log],
+                },
                 "n_keys": len(self._slot),
                 # sorted-lookup snapshot health (async_ps._alloc_slots):
                 "pending_depth": len(self._pending),

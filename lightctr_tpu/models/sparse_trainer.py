@@ -80,6 +80,17 @@ The exchanged trajectory matches the dense-psum data-parallel trainer to
 fp32 tolerance (parity-tested): merged mean row gradients equal the dense
 mean gradient's touched rows, and untouched rows move in neither world.
 
+MULTI-HOST replicated data parallelism (``hier_exchange`` given — a
+:class:`~lightctr_tpu.dist.hier.HierExchangeClient`) runs the
+HIERARCHICAL two-level exchange instead (docs/SPARSE_EXCHANGE.md): the
+local mesh's replicas merge touched rows in-jit first (program A), the
+host ships exactly ONE merged (uids, rows) payload per table over the
+DCN reduce rendezvous and pulls the cross-host merge back (the wire
+hop), and a second jitted program applies the identical global mean on
+every replica (program C) — cross-host bytes stay O(touched-per-host)
+regardless of local replica count, and the trajectory still equals the
+dense-psum trainer over the GLOBAL batch (2-process acceptance-tested).
+
 Platform note: the step donates (params, opt_state), so on accelerators
 the row scatters update the tables in place and the step is truly
 O(touched).  XLA's CPU backend does not honor donation — there each step
@@ -109,6 +120,44 @@ from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
 from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.utils.profiling import annotate
 
+def _hier_local_algo(n: int, kpad: int, vocab: int, dims,
+                     force_ag: bool = False):
+    """The ONE local ag-vs-rs comparison for the hierarchical exchange's
+    ICI merge stage -> ``(algo, rs_caps, per_table_bytes)``.  Both the
+    traced local-merge program and its host-side plan mirror call this,
+    so the capacities the compiled program uses and the ones
+    ``_rs_batch_fits`` checks cannot drift.  The dense ring is not a
+    candidate: the wire needs a sparse union.  Ids are priced once per
+    stream (``include_ids`` on the first table only)."""
+    from lightctr_tpu.dist.collectives import (
+        rs_default_caps, sparse_exchange_bytes, sparse_rs_bytes,
+    )
+
+    ag = [sparse_exchange_bytes(n, kpad, d, include_ids=(i == 0))
+          for i, d in enumerate(dims)]
+    caps = rs_default_caps(n, kpad, vocab)
+    rs = [sparse_rs_bytes(n, caps[0], caps[1], d, include_ids=(i == 0))
+          for i, d in enumerate(dims)]
+    if force_ag or sum(ag) <= sum(rs):
+        return "sparse", None, ag
+    return "sparse_rs", caps, rs
+
+
+#: every ``trainer_*`` telemetry series this module emits — the AST lint in
+#: tests/test_obs.py pins emissions to this declaration (and declarations to
+#: emissions), so an exchange counter can never ship dark or go stale
+EXCHANGE_SERIES = (
+    "trainer_exchange_bytes_total",      # {table, policy} bytes/step
+    "trainer_exchange_algo_total",       # {table, algo} steps per decision
+    "trainer_sparse_exchange_bytes_total",
+    "trainer_sparse_rs_bytes_total",
+    "trainer_dense_ring_bytes_total",
+    "trainer_hier_wire_bytes_total",     # hierarchical: DCN hop, per host
+    "trainer_hier_local_bytes_total",    # hierarchical: ICI merge hop
+    "trainer_rs_fallback_total",
+    "trainer_rs_overflow_total",
+)
+
 
 class SparseTableCTRTrainer(CTRTrainer):
     """CTRTrainer whose listed table leaves update O(touched) per step.
@@ -128,6 +177,18 @@ class SparseTableCTRTrainer(CTRTrainer):
         leaf takes the sparse exchange only while its padded sparse bytes
         stay under ``margin * dense_ring_bytes``; below 1.0 demands a real
         win before leaving the worst-case-safe dense path.
+    hier_exchange: a :class:`~lightctr_tpu.dist.hier.HierExchangeClient`
+        — arms the HIERARCHICAL two-level exchange (docs/SPARSE_EXCHANGE.md):
+        the local mesh's replicas merge touched rows in-jit first (the
+        cheaper of the two sparse collectives, owner-partition family),
+        then exactly ONE merged (uids, rows) payload per host rides the
+        DCN reduce rendezvous, and the pulled cross-host merge broadcasts
+        back over the ICI into a second jitted apply program — cross-host
+        bytes stay O(touched-per-host) regardless of local replica count.
+        Requires a mesh (the local replicas), replicated params, and the
+        exact exchange (``compress_bits=None`` — the wire codec is the
+        client's knob); every branch, local-overflow fallback included,
+        stays dense-psum-exact.
     """
 
     def __init__(
@@ -146,6 +207,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         compress_mode: Optional[str] = None,
         error_feedback: Optional[bool] = None,
         dense_switch_margin: float = 1.0,
+        hier_exchange=None,
     ):
         if not sparse_tables:
             raise ValueError("sparse_tables must name at least one table leaf")
@@ -176,6 +238,22 @@ class SparseTableCTRTrainer(CTRTrainer):
         # param_shardings (embed-axis row sharding) GSPMD owns the
         # collectives and the single-program step below is kept.
         self._hybrid_dp = mesh is not None and param_shardings is None
+        # hierarchical mode: the hybrid one-program step is replaced by a
+        # local-merge program + the DCN wire hop + an apply program
+        self._hier = hier_exchange is not None
+        if self._hier:
+            if mesh is None or param_shardings is not None:
+                raise ValueError(
+                    "hier_exchange needs a mesh of replicated local "
+                    "replicas (no param_shardings)"
+                )
+            if compress_bits is not None:
+                raise ValueError(
+                    "hier_exchange is the exact exchange; the wire codec "
+                    "is the HierExchangeClient's knob (codec='f16'), "
+                    "compress_bits must stay None"
+                )
+            self._hybrid_dp = False
         # {table: "sparse" | "sparse_rs" | "dense"} — the three-way
         # trace-time pick each table leaf got (diagnostics / tests):
         # allgather sparse exchange, owner-partitioned reduce-scatter, or
@@ -203,12 +281,40 @@ class SparseTableCTRTrainer(CTRTrainer):
         self._fallback_logged = False
         self._plan_cache: Dict = {}
         self._scan_cache_ag: Dict = {}
+        # hierarchical-exchange state: the wire client, the per-step round
+        # counter (every host's trainer steps in lockstep, so the counter
+        # IS the round id), the fixed table-id order the rendezvous keys
+        # rounds by (ctor args are identical on every host), and the
+        # trace-time local-merge decisions (primary / ag-fallback program
+        # families record separately, as the hybrid fallback does)
+        self._hier_client = hier_exchange
+        self._hier_epoch = 0
+        self._hier_tables = list(self._spec)
+        self.hier_local_policy: Dict[str, str] = {}
+        self.hier_local_bytes_per_step: Dict[str, int] = {}
+        self._hier_fb_local_policy: Dict[str, str] = {}
+        self._hier_fb_local_bytes: Dict[str, int] = {}
+        self._hier_last_local = False  # last step ran the ag fallback
+        self._hier_wire_dense_bytes = 0
+        self._hier_local_j = None
+        self._hier_local_ag_j = None
+        self._hier_apply_j = None
         super().__init__(
             params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
             param_shardings=param_shardings, compress_bits=compress_bits,
             compress_range=compress_range, compress_mode=compress_mode,
             error_feedback=error_feedback,
         )
+        if self._hier:
+            import jax as _jax
+
+            self._hier_local_j = _jax.jit(self._make_hier_local_step())
+            self._hier_apply_j = _jax.jit(
+                self._make_hier_apply_step(), donate_argnums=(0, 1)
+            )
+            # the base ctor jitted _build_step()'s program; the hier step
+            # is a HOST orchestrator around two jitted programs instead
+            self._step = self._hier_step
         # table trainers also watch per-table touched-uid skew (the same
         # id streams the sparse exchange dedups — hot/dead detection)
         if self.health is not None:
@@ -703,6 +809,312 @@ class SparseTableCTRTrainer(CTRTrainer):
             check_vma=False,
         )
 
+    # -- hierarchical two-level exchange (docs/SPARSE_EXCHANGE.md) -------
+    #
+    # Three pieces per step: (A) one jitted shard_map program computes
+    # per-replica O(touched) grads and merges them ACROSS THE LOCAL MESH
+    # in-jit (the ICI hop — replicated output, so the host reads ONE
+    # merged (uids, rows) pair per id stream); (B) the host strips the
+    # dedup padding and runs the wire rendezvous (the DCN hop: push this
+    # host's merged sums, pull the cross-host merge — exactly one payload
+    # per host, so cross-host bytes are flat in local replica count); (C)
+    # a second jitted program applies the identical global mean on every
+    # replica (merge_apply with pre-merged rows) — replicas cannot
+    # diverge, and with every host applying the same update neither can
+    # hosts.  The trajectory equals the dense-psum data-parallel trainer
+    # over the GLOBAL batch (the 2-process acceptance test's oracle).
+
+    #: wire table id of the dense-leaf stream: dense gradients flatten to
+    #: one [L] vector and ride the same rendezvous as dim-1 rows keyed by
+    #: position, with the replica-summed loss appended as the last entry
+    #: (the cross-host loss mean needs a wire hop anyway — it shares this
+    #: one).  Real tables use ids 0..len(spec)-1 in spec order.
+    _HIER_DENSE_TABLE = 1 << 20
+
+    def _make_hier_local_step(self):
+        """Program A: per-replica grads + the in-jit LOCAL merge (SUM over
+        local replicas, never averaged — the global denominator is applied
+        after the wire merge).  Per id stream the merge rides the cheaper
+        of the two sparse collectives (``self._force_ag`` pins the
+        allgather for the overflow-fallback program family); the dense
+        leaves and the loss psum into one flat vector.  Every output is
+        replica-identical (terminal collectives), so the shard_map emits
+        replicated values the host reads once."""
+        from jax.flatten_util import ravel_pytree
+        from jax.sharding import PartitionSpec as P
+
+        from lightctr_tpu.core.compat import shard_map
+        from lightctr_tpu.dist.collectives import (
+            _ag_exchange_rows,
+            _ag_gather_ids,
+            _rs_merge_ids,
+            _rs_ring_exchange,
+            _rs_gather_rows,
+            rs_owner_partition,
+        )
+        from lightctr_tpu.ops import sparse_kernels
+
+        loss_fn = self._make_loss_fn()
+        spec = self._spec
+        groups = self._field_groups(spec)
+        mesh = self.mesh
+        n = mesh.shape["data"]
+        dedup_and_gather = self._dedup_and_gather
+        force_ag = self._force_ag
+        if force_ag:
+            policy, xbytes = self._hier_fb_local_policy, \
+                self._hier_fb_local_bytes
+        else:
+            policy, xbytes = self.hier_local_policy, \
+                self.hier_local_bytes_per_step
+
+        def local_step(params, batch):
+            tables, dense, batch2, uids, rows = dedup_and_gather(
+                spec, params, batch
+            )
+
+            def loss_on(rows, dense):
+                return loss_fn({**dense, **rows}, batch2)
+
+            loss, (g_rows, g_dense) = jax.value_and_grad(
+                loss_on, argnums=(0, 1)
+            )(rows, dense)
+            # dense grads + the per-replica mean loss ride ONE flat psum:
+            # [sum over local replicas of grads..., sum of losses]
+            flat, _ = ravel_pytree(g_dense)
+            dense_flat = jax.lax.psum(
+                jnp.concatenate([flat, loss[None].astype(jnp.float32)]),
+                "data",
+            )
+            over_total = jnp.zeros((), jnp.int32)
+            out_ids: Dict = {}
+            out_rows: Dict = {}
+            for fields, keys in groups.items():
+                u = uids[keys[0]]
+                kpad = u.shape[0]
+                vocab = max(tables[k].shape[0] for k in keys)
+                dims = [int(np.prod(tables[k].shape[1:])) for k in keys]
+                # the SAME comparison the host-side plan mirror makes —
+                # caps and program family cannot drift (_hier_local_algo)
+                algo, caps, per_bytes = _hier_local_algo(
+                    n, kpad, vocab, dims, force_ag=force_ag
+                )
+                if algo == "sparse":
+                    with annotate("sparse_tables/hier_local",
+                                  algo="sparse", tables=len(keys)):
+                        _, uniq, inv = _ag_gather_ids(u, "data")
+                        for i, k in enumerate(keys):
+                            policy[k] = "sparse"
+                            xbytes[k] = per_bytes[i]
+                            all_rows, _ = _ag_exchange_rows(g_rows[k], "data")
+                            out_ids[k] = uniq
+                            out_rows[k] = sparse_kernels.merge_rows(
+                                all_rows, inv, uniq.shape[0]
+                            )
+                else:
+                    bucket_cap, shard_cap = caps
+                    with annotate("sparse_tables/hier_local",
+                                  algo="sparse_rs", tables=len(keys)):
+                        dest, order, bucket_ids, ov_b = \
+                            rs_owner_partition(u, n, bucket_cap)
+                        all_ids = _rs_ring_exchange(bucket_ids, "data", n)
+                        uniq, inv, ov_s = _rs_merge_ids(all_ids, shard_cap)
+                        over_total = over_total + ov_b + ov_s
+                        ids_g = jax.lax.all_gather(uniq, "data", tiled=True)
+                        for i, k in enumerate(keys):
+                            policy[k] = "sparse_rs"
+                            xbytes[k] = per_bytes[i]
+                            out_ids[k] = ids_g
+                            out_rows[k] = _rs_gather_rows(
+                                g_rows[k], dest, order, inv, "data", n,
+                                bucket_cap, shard_cap, average=False,
+                            )
+            over = jax.lax.psum(over_total, "data")
+            return out_ids, out_rows, dense_flat, over
+
+        ospec = ({k: P() for k in spec}, {k: P() for k in spec}, P(), P())
+        return shard_map(
+            local_step, mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=ospec, check_vma=False,
+        )
+
+    def _make_hier_apply_step(self):
+        """Program C: apply the wire-merged GLOBAL MEAN on every replica —
+        tables through the fused merge-apply (rows arrive pre-merged:
+        ``inv=None``), dense leaves through optax, the merged sum of
+        squares feeding the health gradient norm from the same passes.
+        Identical inputs on every host => identical parameters
+        everywhere."""
+        from jax.flatten_util import ravel_pytree
+
+        tx = self.tx
+        spec = self._spec
+        lr, eps = self.cfg.learning_rate, self._eps
+
+        def apply_step(params, opt_state, payload, dense_mean, loss, over):
+            from lightctr_tpu.ops import sparse_kernels
+
+            tables = {k: params[k] for k in spec}
+            dense = {k: v for k, v in params.items() if k not in spec}
+            _, unravel = ravel_pytree(dense)
+            g_dense = unravel(dense_mean)
+            gn2 = optax.global_norm(g_dense) ** 2
+            updates, new_dense_state = tx.update(
+                g_dense, opt_state["dense"], dense
+            )
+            dense = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), dense, updates
+            )
+            new_accum = {}
+            with annotate("sparse_tables/apply"):
+                for k in spec:
+                    gu, grows = payload[k]
+                    tables[k], new_accum[k], ssq = sparse_kernels.merge_apply(
+                        tables[k], opt_state["accum"][k], gu, grows, None,
+                        lr=lr, eps=eps,
+                    )
+                    gn2 = gn2 + ssq
+            health = jnp.stack([
+                loss, jnp.sqrt(gn2), over.astype(jnp.float32)
+            ])
+            return ({**dense, **tables},
+                    {"dense": new_dense_state, "accum": new_accum},
+                    loss, health)
+
+        return apply_step
+
+    def _hier_local_plan(self, batch) -> Dict[str, tuple]:
+        """Host-side mirror of the local step's per-stream algo choice —
+        literally the same :func:`_hier_local_algo` call the traced
+        program makes, cached per batch-shape signature, shaped like
+        :meth:`_exchange_plan` so :meth:`_rs_batch_fits` (over the LOCAL
+        mesh world) can consume it."""
+        n = self.mesh.shape["data"]
+        groups = self._field_groups(self._spec)
+        sig = ("hier",) + tuple(
+            (fields, tuple(tuple(np.shape(batch[f])) for f in fields))
+            for fields in groups
+        )
+        plan = self._plan_cache.get(sig)
+        if plan is not None:
+            return plan
+        plan = {}
+        for fields, keys in groups.items():
+            kpad = sum(
+                int(np.prod(np.shape(batch[f]))) for f in fields
+            ) // n
+            vocab = max(int(self.params[k].shape[0]) for k in keys)
+            dims = [int(np.prod(self.params[k].shape[1:])) for k in keys]
+            algo, caps, _ = _hier_local_algo(n, kpad, vocab, dims)
+            for k in keys:
+                plan[k] = (fields, algo, caps)
+        self._plan_cache[sig] = plan
+        return plan
+
+    def _hier_local_ag(self):
+        if self._hier_local_ag_j is None:
+            self._force_ag = True
+            try:
+                self._hier_local_ag_j = jax.jit(self._make_hier_local_step())
+            finally:
+                self._force_ag = False
+        return self._hier_local_ag_j
+
+    @staticmethod
+    def _hier_strip_pads(uids: np.ndarray, rows: np.ndarray):
+        """Collapse a dedup-convention (uids, rows) pair to its real
+        entries, globally sorted (the reduce-scatter local merge emits
+        per-owner-sorted shards): drop id-0 repeats beyond slot 0 — slot 0
+        survives whether id 0 is real or the conventional fill (a zero row
+        there is a no-op on both the wire merge and the apply)."""
+        real = ~((uids == 0) & (np.arange(len(uids)) > 0))
+        u, r = uids[real], rows[real]
+        order = np.argsort(u, kind="stable")
+        return u[order], r[order]
+
+    @staticmethod
+    def _hier_pad(uids: np.ndarray, rows: np.ndarray):
+        """Pad a sorted-unique wire result back into the dedup convention
+        at the next power of two (bounded jit-shape family for the apply
+        program): id-0 fill, zero rows."""
+        m = len(uids)
+        size = 1 << max(3, (max(m, 1) - 1).bit_length())
+        u = np.zeros(size, np.int32)
+        u[:m] = uids.astype(np.int32)
+        r = np.zeros((size,) + rows.shape[1:], np.float32)
+        r[:m] = rows
+        return u, r
+
+    def _hier_step(self, params, opt_state, batch):
+        """The per-step orchestrator ``self._step`` points at in hier
+        mode: program A (local merge) -> the wire rendezvous -> program C
+        (apply the global mean).  The local reduce-scatter capacities are
+        expected sizes with slack, so every batch is checked host-side
+        first and a would-overflow batch runs the allgather local-merge
+        program instead — every branch stays exact."""
+        from lightctr_tpu.dist.collectives import hier_wire_bytes
+
+        client = self._hier_client
+        n_local = self.mesh.shape["data"]
+        total = n_local * client.n_hosts
+        wire_bits = None if client.codec == "f32" else 16
+        epoch = self._hier_epoch
+        self._hier_epoch += 1
+
+        plan = self._hier_local_plan(batch)
+        fits = self._rs_batch_fits(batch, plan)
+        self._hier_last_local = not fits
+        if fits:
+            local = self._hier_local_j
+        else:
+            self.telemetry.inc("trainer_rs_fallback_total")
+            local = self._hier_local_ag()
+        out_ids, out_rows, dense_flat, over = local(params, batch)
+
+        # -- the DCN hop: one merged payload per host.  All tables PUSH
+        # before any pull: each round's barrier is crossed while later
+        # tables' payloads are already in flight, so a step pays ~one
+        # rendezvous round trip, not one per table --------------------------
+        payload = {}
+        with annotate("sparse_tables/hier_wire", tables=len(self._spec),
+                      epoch=epoch):
+            pushed = {}
+            for ti, k in enumerate(self._hier_tables):
+                u = np.asarray(out_ids[k])
+                r = np.asarray(out_rows[k]).reshape(len(u), -1)
+                u, r = self._hier_strip_pads(u, r)
+                client.push(ti, u, r, epoch)
+                pushed[k] = (ti, len(u), r.shape[1])
+            # dense leaves + loss: positions as dim-1 rows on the same wire
+            dvec = np.asarray(dense_flat, np.float32).reshape(-1, 1)
+            client.push(self._HIER_DENSE_TABLE,
+                        np.arange(len(dvec), dtype=np.int64), dvec, epoch)
+            for k, (ti, k_out, dim) in pushed.items():
+                g_u, g_r = client.pull(ti, epoch, dim)
+                self.exchange_policy[k] = "hier"
+                self.exchange_bytes_per_step[k] = hier_wire_bytes(
+                    k_out, len(g_u), dim, wire_bits
+                )
+                pu, pr = self._hier_pad(
+                    g_u, g_r.reshape((len(g_u),)
+                                     + self.params[k].shape[1:]) / total
+                )
+                payload[k] = (jnp.asarray(pu), jnp.asarray(pr))
+            d_u, d_r = client.pull(self._HIER_DENSE_TABLE, epoch, 1)
+            self._hier_wire_dense_bytes = hier_wire_bytes(
+                len(dvec), len(d_u), 1, wire_bits
+            )
+        dsum = d_r.reshape(-1) / total
+        loss = float(dsum[-1])
+        dense_mean = jnp.asarray(dsum[:-1], jnp.float32)
+
+        new_params, new_state, loss_out, health = self._hier_apply_j(
+            params, opt_state, payload, dense_mean,
+            jnp.float32(loss), jnp.asarray(over),
+        )
+        del loss_out  # the host already holds the float
+        return new_params, new_state, loss, health
+
     # -- reduce-scatter capacity plan / overflow fallback ---------------
 
     def _exchange_plan(self, batch) -> Dict[str, tuple]:
@@ -822,6 +1234,11 @@ class SparseTableCTRTrainer(CTRTrainer):
         return super().fit(arrays, **kw)
 
     def fit_fullbatch_scan(self, arrays, epochs):
+        if self._hier:
+            raise ValueError(
+                "the hierarchical exchange steps through a host wire hop "
+                "and cannot ride lax.scan; use fit()/train_step()"
+            )
         if (self._hybrid_dp
                 and not self._rs_batch_fits(arrays,
                                             self._exchange_plan(arrays))):
@@ -881,6 +1298,16 @@ class SparseTableCTRTrainer(CTRTrainer):
         return sparse_b, rs_b, dense_b
 
     def _step_event_fields(self) -> Dict:
+        if self._hier and self.exchange_policy:
+            _, wire_b, _ = self._hier_byte_totals()
+            lb = (self._hier_fb_local_bytes if self._hier_last_local
+                  else self.hier_local_bytes_per_step)
+            return {
+                "exchange_policy": dict(self.exchange_policy),
+                "hier_wire_bytes": wire_b + self._hier_wire_dense_bytes,
+                "hier_local_bytes": sum(lb.values()),
+                "hier_local_fallback": self._hier_last_local,
+            }
         if not (self._hybrid_dp and self._live_exchange_dicts()[0]):
             return {}
         sparse_b, rs_b, dense_b = self._exchange_byte_totals()
@@ -891,6 +1318,14 @@ class SparseTableCTRTrainer(CTRTrainer):
             "sparse_rs_bytes": rs_b,
             "dense_ring_bytes": dense_b,
         }
+
+    def _hier_byte_totals(self):
+        """(per-table wire dict, wire total over tables, local total) of
+        the last hier step."""
+        wire = dict(self.exchange_bytes_per_step)
+        lb = (self._hier_fb_local_bytes if self._hier_last_local
+              else self.hier_local_bytes_per_step)
+        return wire, sum(wire.values()), sum(lb.values())
 
     def _health_signals(self, batch) -> Dict:
         """Per-table touched-uid counts for the skew detector — the same
@@ -915,7 +1350,7 @@ class SparseTableCTRTrainer(CTRTrainer):
     def _record_step(self, dt: float, batch, health=None) -> None:
         super()._record_step(dt, batch, health=health)
         policy, xbytes = self._live_exchange_dicts()
-        if not (self._hybrid_dp and policy):
+        if not ((self._hybrid_dp or self._hier) and policy):
             return
         reg = self.telemetry
         for k, pol in policy.items():
@@ -926,15 +1361,28 @@ class SparseTableCTRTrainer(CTRTrainer):
                 b,
             )
             # per-table algorithm counter: which exchange each table leaf
-            # actually ran this step (the three-way pick, fallback included)
+            # actually ran this step (the four-way pick, fallback included)
             reg.inc(obs.labeled("trainer_exchange_algo_total",
                                 table=k, algo=pol))
             if pol == "sparse":
                 reg.inc("trainer_sparse_exchange_bytes_total", b)
             elif pol == "sparse_rs":
                 reg.inc("trainer_sparse_rs_bytes_total", b)
+            elif pol == "hier":
+                # per-hop accounting: the table's DCN wire bytes here, its
+                # share of the ICI local-merge hop below
+                reg.inc("trainer_hier_wire_bytes_total", b)
             else:
                 reg.inc("trainer_dense_ring_bytes_total", b)
+        if self._hier:
+            # the dense+loss stream rides the wire once per step too, and
+            # the local ICI merge hop has its own counter (the program
+            # family that actually ran records its own byte dicts)
+            reg.inc("trainer_hier_wire_bytes_total",
+                    self._hier_wire_dense_bytes)
+            lb = (self._hier_fb_local_bytes if self._hier_last_local
+                  else self.hier_local_bytes_per_step)
+            reg.inc("trainer_hier_local_bytes_total", sum(lb.values()))
         # the pick is static post-trace: one ``exchange`` event per table
         # per PROGRAM, not one per step.  Primary and fallback decisions
         # log independently (a fallback first step must not be

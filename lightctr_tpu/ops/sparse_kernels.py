@@ -396,6 +396,70 @@ def _apply_kernel(uids_ref, w_ref, a_ref, g_ref, w_out, a_out, ssq_ref,
     w_out[...] = w_ref[...] - lr * g * jax.lax.rsqrt(a_new + eps)
 
 
+#: env override for the apply kernel's rows-per-grid-step: ``1`` = the
+#: scalar-prefetch-windowed per-row kernel, ``>1`` = the row-block kernel
+#: (:func:`_apply_block_kernel`) batching that many rows per grid step
+APPLY_ROWS_ENV = "LIGHTCTR_APPLY_ROWS"
+
+
+def apply_rows_per_step(interpret: bool) -> int:
+    """Rows the apply kernel batches per grid step.  Default: 8 under the
+    interpreter (grid-step overhead dominates there, the block variant is
+    validated bit-for-bit by the parity suite), 1 compiled (the windowed
+    per-row kernel keeps table traffic to scalar-prefetched (1, dim) DMA
+    windows; the block variant's full-ref dynamic stores await real-TPU
+    validation in tests_tpu before becoming the compiled default).
+    :data:`APPLY_ROWS_ENV` overrides either way."""
+    env = os.environ.get(APPLY_ROWS_ENV, "").strip()
+    if env:
+        return max(1, int(env))
+    return 8 if interpret else 1
+
+
+def _apply_block_kernel(uids_ref, w_ref, a_ref, g_ref, w_out, a_out,
+                        ssq_ref, *, lr, eps, denom, s, rb):
+    """Row-block fused apply: ``rb`` touched rows per grid step (the PR 9
+    follow-up — the per-row kernel pays one grid step per row, pure
+    overhead at small dims).  Table/accum ride as FULL refs with dynamic
+    per-row loads/stores (the :func:`_merge_kernel` access pattern), so
+    grid steps shrink ``rb``-fold; step 0 seeds the outputs wholesale
+    (compiled aliasing makes that a self-copy, the interpreter needs it —
+    out buffers start uninitialized).  Same rotation contract as
+    :func:`_apply_kernel`: the caller rotates original slot 0 to run
+    LAST, so pad revisits of row 0 write pre-update values before the one
+    real write, which is correct under both aliasing semantics; slots
+    padded past ``s`` (block round-up) are skipped outright."""
+    pl, _ = pallas_modules()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _seed():
+        ssq_ref[0, 0] = 0.0
+        w_out[...] = w_ref[...]
+        a_out[...] = a_ref[...]
+
+    def body(j, _):
+        p = i * rb + j
+
+        @pl.when(p < s)
+        def _row():
+            uid = uids_ref[p, 0]
+            g = g_ref[pl.ds(p, 1), :]
+            if denom != 1.0:
+                g = g / denom
+            # original slot of position p is (p + 1) % s: slot 0 <=> p==s-1
+            g = g * jnp.where((uid == 0) & (p != s - 1), 0.0, 1.0)
+            ssq_ref[0, 0] += jnp.sum(g * g)
+            a_new = a_ref[pl.ds(uid, 1), :] + g * g
+            a_out[pl.ds(uid, 1), :] = a_new
+            w_out[pl.ds(uid, 1), :] = w_ref[pl.ds(uid, 1), :] \
+                - lr * g * jax.lax.rsqrt(a_new + eps)
+
+        return 0
+
+    jax.lax.fori_loop(0, rb, body, 0)
+
+
 def _merge_apply_pallas(
     table, accum, uids, rows, inv, lr, eps, denom, *, interpret: bool
 ):
@@ -413,6 +477,24 @@ def _merge_apply_pallas(
     # rotate so original slot 0 is the LAST grid step (see _apply_kernel)
     uids_r = jnp.roll(uids.astype(jnp.int32), -1)
     merged_r = jnp.roll(merged, -1, axis=0)
+    rb = apply_rows_per_step(interpret)
+    if rb > 1 and s > 1:
+        sp = -(-s // rb) * rb
+        uids_p = jnp.pad(uids_r, (0, sp - s)).reshape(sp, 1)
+        merged_p = jnp.pad(merged_r, ((0, sp - s), (0, 0)))
+        w2, a2, ssq = pl.pallas_call(
+            partial(_apply_block_kernel, lr=lr, eps=eps, denom=denom,
+                    s=s, rb=rb),
+            grid=(sp // rb,),
+            out_shape=(
+                jax.ShapeDtypeStruct((vocab, d), table.dtype),
+                jax.ShapeDtypeStruct((vocab, d), accum.dtype),
+                jax.ShapeDtypeStruct((1, 1), jnp.float32),
+            ),
+            input_output_aliases={1: 0, 2: 1},
+            interpret=interpret,
+        )(uids_p, table.reshape(vocab, d), accum.reshape(vocab, d), merged_p)
+        return w2.reshape(shape), a2.reshape(shape), ssq[0, 0]
     spec_row = pl.BlockSpec((1, d), lambda i, u: (u[i], 0))
     spec_seq = pl.BlockSpec((1, d), lambda i, u: (i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -75,6 +75,7 @@ class HotEmbeddingCache:
         self.rejected = 0
         self.invalidations = 0
         self.invalidated_rows = 0
+        self.delta_invalidations = 0
 
     # -- the touched-uid ledger ---------------------------------------------
 
@@ -201,6 +202,47 @@ class HotEmbeddingCache:
 
     # -- versioned invalidation ---------------------------------------------
 
+    @property
+    def version(self):
+        """The last adopted write-version observation (None = unarmed)."""
+        with self._lock:
+            return self._version
+
+    def apply_delta(self, version, uids) -> int:
+        """Per-key invalidation (docs/SERVING.md): adopt a moved version
+        while dropping ONLY the listed uids — the rows whose server-side
+        values actually changed since the previous observation — instead
+        of the whole cache.  The caller (the serving server's version
+        poll) is responsible for ``uids`` COVERING the version range; when
+        the PS write log no longer covers it, call :meth:`set_version`
+        (full drop) instead.  Returns the rows dropped."""
+        version = tuple(version) if isinstance(version, (list, tuple)) \
+            else (version,)
+        dropped = 0
+        with self._lock:
+            if self._version is None:
+                self._version = version  # first observation arms only
+                return 0
+            if self._version == version:
+                return 0
+            self._version = version
+            store = self._rows
+            for u in np.asarray(uids, np.int64).reshape(-1).tolist():
+                if store.pop(u, None) is not None:
+                    dropped += 1
+            if dropped:
+                self._min_freq = None
+                self.invalidated_rows += dropped
+            self.delta_invalidations += 1
+            n_entries = len(store)
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("serve_cache_delta_invalidations_total")
+            reg.inc("serve_cache_invalidated_rows_total", dropped)
+            reg.gauge_set("serve_cache_entries", n_entries)
+            reg.gauge_set("serve_cache_bytes", n_entries * self.dim * 4)
+        return dropped
+
     def set_version(self, version) -> bool:
         """Adopt the PS write-version observation (any hashable — the
         server passes the tuple of per-shard ``write_version``s).  A MOVED
@@ -247,6 +289,7 @@ class HotEmbeddingCache:
                 "evictions": self.evictions,
                 "rejected": self.rejected,
                 "invalidations": self.invalidations,
+                "delta_invalidations": self.delta_invalidations,
                 "invalidated_rows": self.invalidated_rows,
                 "tracked_uids": len(self._freq),
             }

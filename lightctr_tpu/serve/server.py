@@ -528,7 +528,15 @@ class PredictionServer:
     def refresh_version(self) -> bool:
         """Poll the PS shards' ``write_version`` and invalidate the cache
         when the tuple moved.  Never raises (an unreachable shard is a
-        retry-later; its slot reads -1 so recovery also invalidates)."""
+        retry-later; its slot reads -1 so recovery also invalidates).
+
+        PER-KEY DELTAS: each shard's stats may carry ``write_delta`` (the
+        store's bounded write log).  When every moved shard's log still
+        covers the cache's last-seen version, only the uids that actually
+        changed are dropped (:meth:`HotEmbeddingCache.apply_delta`) — the
+        rest of the hot set keeps serving.  A shard that is down, predates
+        the log, or overflowed it degrades THIS poll to the whole-cache
+        drop, never to staleness."""
         if self.ps is None or self.cache is None:
             return False
         self._last_version_poll = time.monotonic()
@@ -538,7 +546,22 @@ class PredictionServer:
             return False
         shards = st if isinstance(st, list) else [st]
         version = tuple(int(s.get("write_version", -1)) for s in shards)
-        return self.cache.set_version(version)
+        prev = self.cache.version
+        if prev is None or len(prev) != len(version) or version == prev:
+            return self.cache.set_version(version)  # arm / no-op / reshape
+        changed: list = []
+        for s, v_new, v_old in zip(shards, version, prev):
+            if v_new == v_old:
+                continue
+            wd = s.get("write_delta")
+            if (v_new < v_old or not wd
+                    or v_old < int(wd.get("floor", 1 << 62))):
+                return self.cache.set_version(version)  # not covered
+            for ver, uids in wd.get("entries", ()):
+                if int(ver) > v_old:
+                    changed.extend(uids)
+        self.cache.apply_delta(version, changed)
+        return True
 
     # -- reads / lifecycle ---------------------------------------------------
 

@@ -1,0 +1,459 @@
+"""Hierarchical two-level sparse exchange (ISSUE 10): the DCN reduce
+rendezvous (`dist/hier.py`), the hier trainer mode (local ICI merge -> one
+merged payload per host over the wire -> replicated apply), the local
+overflow fallback, and the 2-process x multi-replica acceptance — the
+trajectory must match the dense-psum-exact oracle and the cross-host wire
+bytes must stay FLAT when the local replica count doubles."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightctr_tpu import TrainConfig
+from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+from lightctr_tpu.dist.hier import HierExchangeClient, SparseReduceShard
+from lightctr_tpu.models import fm
+from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer
+from lightctr_tpu.obs import MetricsRegistry
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+
+# -- the reduce rendezvous ------------------------------------------------
+
+
+def test_reduce_shard_merges_rounds_and_withholds():
+    """One shard, two hosts: a pull before both pushes lands is WITHHELD
+    (the SSP status byte — the client retries); once complete, every host
+    pulls the identical merged union (duplicate ids segment-summed in
+    host order), and the round is garbage-collected after the last
+    pull."""
+    shard = SparseReduceShard(n_hosts=2)
+    c0 = HierExchangeClient([shard.address], host_id=0, n_hosts=2,
+                            pull_timeout_s=5.0)
+    c1 = HierExchangeClient([shard.address], host_id=1, n_hosts=2,
+                            pull_timeout_s=5.0)
+    try:
+        u0 = np.array([1, 2, 5], np.int64)
+        r0 = np.arange(6, dtype=np.float32).reshape(3, 2)
+        u1 = np.array([2, 3], np.int64)
+        r1 = np.ones((2, 2), np.float32)
+        c0.push(0, u0, r0, epoch=0)
+        with pytest.raises(TimeoutError):
+            HierExchangeClient([shard.address], 0, 2,
+                               pull_timeout_s=0.05).pull(0, 0, 2)
+        assert shard.stats()["withheld"] >= 1
+        c1.push(0, u1, r1, epoch=0)
+        g0 = c0.pull(0, 0, 2)
+        g1 = c1.pull(0, 0, 2)
+        np.testing.assert_array_equal(g0[0], [1, 2, 3, 5])
+        np.testing.assert_allclose(
+            g0[1], [[0, 1], [3, 4], [1, 1], [4, 5]], rtol=0, atol=0)
+        np.testing.assert_array_equal(g0[0], g1[0])
+        np.testing.assert_allclose(g0[1], g1[1], rtol=0, atol=0)
+        # a pull whose REPLY was lost retries and must be SERVED (the
+        # round is retained past the last pull), never withheld until
+        # the timeout — pulls are as at-least-once-safe as pushes
+        g0_again = c0.pull(0, 0, 2)
+        np.testing.assert_array_equal(g0_again[0], g0[0])
+        # retention is bounded: the epoch-lag GC reaps completed rounds
+        # once newer epochs advance past the lag window
+        c0.push(1, u0[:1], r0[:1],
+                epoch=shard.ROUND_GC_LAG + 1)
+        assert (0, 0) not in shard._rounds
+    finally:
+        c0.close()
+        c1.close()
+        shard.close()
+
+
+def test_reduce_client_owner_partitions_across_shards():
+    """Two shards: uids split by ``uid % n_shards`` (the PS modulo
+    family), empty per-shard frames still check in (the round bar counts
+    hosts), and the spliced pull is globally sorted.  Both wire codecs
+    round-trip; f16 quantizes to half precision."""
+    shards = [SparseReduceShard(n_hosts=1) for _ in range(2)]
+    addrs = [s.address for s in shards]
+    try:
+        for codec, atol in (("f32", 0.0), ("f16", 1e-2)):
+            c = HierExchangeClient(addrs, host_id=0, n_hosts=1, codec=codec)
+            uids = np.array([3, 4, 7, 10, 21], np.int64)  # odd/even mix
+            rows = np.linspace(-1, 1, 15).astype(np.float32).reshape(5, 3)
+            gu, gr = c.exchange(5 if codec == "f16" else 4, uids, rows,
+                                epoch=0)
+            np.testing.assert_array_equal(gu, uids)
+            np.testing.assert_allclose(gr, rows, rtol=0, atol=atol)
+            c.close()
+        # all ids on one shard: the OTHER shard still completes its round
+        c = HierExchangeClient(addrs, host_id=0, n_hosts=1)
+        uids = np.array([2, 4], np.int64)  # both even -> shard 0
+        gu, gr = c.exchange(6, uids, np.ones((2, 1), np.float32), epoch=1)
+        np.testing.assert_array_equal(gu, uids)
+        c.close()
+    finally:
+        for s in shards:
+            s.close()
+
+
+def test_reduce_shard_rejects_malformed_and_counts():
+    """Unsorted push keys are a protocol error (loud, counted), and the
+    bandwidth probe rides single-contributor negative-epoch rounds
+    without peer hosts."""
+    shard = SparseReduceShard(n_hosts=2)
+    c = HierExchangeClient([shard.address], host_id=0, n_hosts=2)
+    try:
+        with pytest.raises(ValueError, match="sorted unique"):
+            c.push(0, np.array([5, 3], np.int64),
+                   np.ones((2, 2), np.float32), epoch=0)
+        bw = c.probe_bw(payload_bytes=1 << 14, reps=2)
+        assert bw > 0
+        assert shard.stats()["rounds_open"] == 0  # probe rounds GC'd
+        # probe rounds are EXEMPT from the epoch-lag GC (their negative
+        # epochs would read as infinitely stale): a mid-run re-probe
+        # after real epochs advanced must still complete
+        c.push(2, np.array([2], np.int64), np.ones((1, 2), np.float32),
+               epoch=40)
+        assert c.probe_bw(payload_bytes=1 << 12, reps=1) > 0
+    finally:
+        c.close()
+        shard.close()
+
+
+# -- in-process hier trainer (threads as hosts) ---------------------------
+
+
+def _fm_batch(rng, n_rows, f, nnz=4):
+    fids = rng.integers(1, f, size=(n_rows, nnz)).astype(np.int32)
+    return {
+        "fids": fids, "fields": np.zeros_like(fids),
+        "vals": np.ones((n_rows, nnz), np.float32),
+        "mask": np.ones((n_rows, nnz), np.float32),
+        "labels": (np.arange(n_rows) % 2).astype(np.float32),
+    }
+
+
+def _run_hier_hosts(params, cfg, halves, addrs, n_hosts, local_n, steps,
+                    registries=None):
+    """Drive ``n_hosts`` hier trainers from threads (the rendezvous
+    barrier synchronizes them) -> {host: (losses, params, trainer)}."""
+    results = {}
+    errors = []
+
+    def run_host(hid):
+        client = HierExchangeClient(addrs, host_id=hid, n_hosts=n_hosts)
+        try:
+            tr = SparseTableCTRTrainer(
+                params, fm.logits, cfg,
+                sparse_tables={"w": ["fids"], "v": ["fids"]},
+                fused_fn=fm.logits_with_l2,
+                mesh=make_mesh(MeshSpec(data=local_n)),
+                hier_exchange=client,
+            )
+            tr.health = None
+            if registries is not None:
+                tr.telemetry = registries[hid]
+            losses = [float(tr.train_step(halves[hid]))
+                      for _ in range(steps)]
+            results[hid] = (losses,
+                            {k: np.asarray(v) for k, v in tr.params.items()},
+                            tr)
+        except Exception as e:  # surface thread failures to the test
+            errors.append((hid, repr(e)))
+        finally:
+            client.close()
+
+    threads = [threading.Thread(target=run_host, args=(h,))
+               for h in range(n_hosts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert set(results) == set(range(n_hosts))
+    return results
+
+
+def test_hier_trainer_matches_single_process_oracle(rng):
+    """2 hosts x 2 local replicas in one process (threads): the hier
+    trajectory equals the single-device full-batch trainer's (the
+    dense-psum-exact oracle) to fp32 tolerance, both hosts end
+    bit-identical, the policy records ``hier`` and the per-hop byte
+    counters land."""
+    f, dim, steps = 512, 8, 4
+    full = _fm_batch(rng, 128, f)
+    halves = [{k: v[:64] for k, v in full.items()},
+              {k: v[64:] for k, v in full.items()}]
+    params = fm.init(jax.random.PRNGKey(0), f, dim)
+    cfg = TrainConfig(learning_rate=0.1)
+    shards = [SparseReduceShard(n_hosts=2) for _ in range(2)]
+    regs = {0: MetricsRegistry(), 1: MetricsRegistry()}
+    try:
+        results = _run_hier_hosts(
+            params, cfg, halves, [s.address for s in shards], 2, 2, steps,
+            registries=regs,
+        )
+    finally:
+        for s in shards:
+            s.close()
+
+    oracle = SparseTableCTRTrainer(
+        params, fm.logits, cfg,
+        sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2,
+    )
+    oracle.health = None
+    o_losses = [float(oracle.train_step(full)) for _ in range(steps)]
+
+    l0, p0, tr0 = results[0]
+    l1, p1, _ = results[1]
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=1e-6)
+    np.testing.assert_allclose(l0, o_losses, rtol=1e-4, atol=1e-6)
+    for k in ("w", "v"):
+        np.testing.assert_array_equal(p0[k], p1[k])
+        np.testing.assert_allclose(p0[k], np.asarray(oracle.params[k]),
+                                   rtol=1e-4, atol=1e-5)
+    assert tr0.exchange_policy == {"w": "hier", "v": "hier"}
+    assert tr0.hier_local_policy["w"] in ("sparse", "sparse_rs")
+    assert all(b > 0 for b in tr0.exchange_bytes_per_step.values())
+    snap = regs[0].snapshot()
+    c = snap["counters"]
+    assert c["trainer_hier_wire_bytes_total"] > 0
+    assert c["trainer_hier_local_bytes_total"] > 0
+    from lightctr_tpu.obs import labeled
+
+    assert c[labeled("trainer_exchange_algo_total",
+                     table="v", algo="hier")] == steps
+
+
+def test_hier_trainer_local_overflow_falls_back_to_allgather(rng):
+    """A batch skewed onto one LOCAL owner (every id ≡ 0 mod local_n)
+    would overflow the local reduce-scatter buckets: the host capacity
+    check routes the LOCAL merge to the allgather program (counted in
+    ``trainer_rs_fallback_total``), the wire payload is unchanged, and
+    the trajectory still matches the oracle — hosts do NOT need to agree
+    on the local program family."""
+    f, dim, steps, local_n = 2048, 16, 3, 4
+    full = _fm_batch(rng, 1024, f, nnz=8)
+    # skew HOST 0's ids onto local owner 0; host 1 keeps a natural batch
+    skewed = np.maximum(full["fids"][:512] // local_n, 1) * local_n
+    full["fids"][:512] = skewed.astype(np.int32)
+    halves = [{k: v[:512] for k, v in full.items()},
+              {k: v[512:] for k, v in full.items()}]
+    params = fm.init(jax.random.PRNGKey(1), f, dim)
+    cfg = TrainConfig(learning_rate=0.05)
+    shards = [SparseReduceShard(n_hosts=2)]
+    regs = {0: MetricsRegistry(), 1: MetricsRegistry()}
+    try:
+        results = _run_hier_hosts(
+            params, cfg, halves, [s.address for s in shards], 2, local_n,
+            steps, registries=regs,
+        )
+    finally:
+        for s in shards:
+            s.close()
+    tr0, tr1 = results[0][2], results[1][2]
+    # the regime under test: the local pick IS reduce-scatter, host 0's
+    # skew overflows it (fallback every step), host 1 never does
+    plan0 = tr0._hier_local_plan(halves[0])
+    assert plan0["v"][1] == "sparse_rs", plan0
+    assert not tr0._rs_batch_fits(halves[0], plan0)
+    assert tr1._rs_batch_fits(halves[1], tr1._hier_local_plan(halves[1]))
+    assert regs[0].snapshot()["counters"][
+        "trainer_rs_fallback_total"] == steps
+    assert "trainer_rs_fallback_total" not in \
+        regs[1].snapshot()["counters"]
+    assert tr0._hier_fb_local_policy["v"] == "sparse"
+    assert tr1.hier_local_policy["v"] == "sparse_rs"
+    oracle = SparseTableCTRTrainer(
+        params, fm.logits, cfg,
+        sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2,
+    )
+    oracle.health = None
+    o_losses = [float(oracle.train_step(full)) for _ in range(steps)]
+    np.testing.assert_allclose(results[0][0], o_losses, rtol=1e-4,
+                               atol=1e-6)
+    for k in ("w", "v"):
+        np.testing.assert_allclose(
+            results[0][1][k], np.asarray(oracle.params[k]),
+            rtol=1e-4, atol=1e-5)
+
+
+def test_hier_trainer_rejects_unsupported_configs(rng):
+    shard = SparseReduceShard(n_hosts=1)
+    client = HierExchangeClient([shard.address], host_id=0, n_hosts=1)
+    params = fm.init(jax.random.PRNGKey(0), 64, 4)
+    try:
+        with pytest.raises(ValueError, match="mesh"):
+            SparseTableCTRTrainer(
+                params, fm.logits, TrainConfig(),
+                sparse_tables={"w": ["fids"], "v": ["fids"]},
+                hier_exchange=client,
+            )
+        with pytest.raises(ValueError, match="compress_bits"):
+            SparseTableCTRTrainer(
+                params, fm.logits, TrainConfig(),
+                sparse_tables={"w": ["fids"], "v": ["fids"]},
+                mesh=make_mesh(MeshSpec(data=2)), compress_bits=8,
+                hier_exchange=client,
+            )
+        tr = SparseTableCTRTrainer(
+            params, fm.logits, TrainConfig(),
+            sparse_tables={"w": ["fids"], "v": ["fids"]},
+            mesh=make_mesh(MeshSpec(data=2)), hier_exchange=client,
+        )
+        with pytest.raises(ValueError, match="scan"):
+            tr.fit_fullbatch_scan(_fm_batch(rng, 16, 64), 2)
+    finally:
+        client.close()
+        shard.close()
+
+
+# -- the 2-process x multi-replica acceptance -----------------------------
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+    host_id, local_n, port0, port1, data_path, out_path = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+        int(sys.argv[4]), sys.argv[5], sys.argv[6])
+    import os
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lightctr_tpu.utils.devicecheck import pin_cpu_platform
+    pin_cpu_platform(local_n)
+    import numpy as np
+    import jax
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.core.mesh import MeshSpec, make_mesh
+    from lightctr_tpu.dist.hier import HierExchangeClient
+    from lightctr_tpu.models import fm
+    from lightctr_tpu.models.sparse_trainer import SparseTableCTRTrainer
+
+    data = np.load(data_path)
+    half = slice(None, 128) if host_id == 0 else slice(128, None)
+    batch = {k: data[k][half] for k in
+             ("fids", "fields", "vals", "mask", "labels")}
+    params = fm.init(jax.random.PRNGKey(0), int(data["f"]), int(data["dim"]))
+    client = HierExchangeClient(
+        [("127.0.0.1", port0), ("127.0.0.1", port1)],
+        host_id=host_id, n_hosts=2)
+    tr = SparseTableCTRTrainer(
+        params, fm.logits, TrainConfig(learning_rate=0.1),
+        sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2,
+        mesh=make_mesh(MeshSpec(data=local_n)), hier_exchange=client)
+    tr.health = None
+    losses = [float(tr.train_step(batch)) for _ in range(4)]
+    np.savez(
+        out_path,
+        losses=np.asarray(losses, np.float64),
+        w=np.asarray(tr.params["w"]),
+        v=np.asarray(tr.params["v"]),
+        socket_bytes=np.int64(client.bytes_sent + client.bytes_received),
+        wire_model_bytes=np.int64(
+            sum(tr.exchange_bytes_per_step.values())
+            + tr._hier_wire_dense_bytes),
+        policy_hier=np.bool_(
+            set(tr.exchange_policy.values()) == {"hier"}),
+    )
+    client.close()
+    print("WORKER_DONE", host_id, flush=True)
+    """
+)
+
+
+def test_two_process_hier_acceptance(tmp_path, rng):
+    """THE acceptance criterion: 2 OS processes x {2, then 4} local
+    replicas train through the reduce rendezvous hosted here.  The
+    hierarchical trajectory matches the dense-psum-exact oracle (the
+    single-device full-batch trainer), both hosts agree bit-for-bit, and
+    the measured cross-host wire bytes/step stay FLAT (+-10%) when the
+    local replica count doubles — the whole point of merging before the
+    DCN."""
+    f, dim = 512, 8
+    full = _fm_batch(rng, 256, f)
+    data_path = tmp_path / "batch.npz"
+    np.savez(data_path, f=np.int64(f), dim=np.int64(dim), **full)
+
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # each worker pins its OWN device count
+    env["PYTHONPATH"] = REPO_ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    script = tmp_path / "hier_worker.py"
+    script.write_text(_WORKER)
+
+    # both replica configs run CONCURRENTLY (each against its own pair of
+    # reduce shards) — four workers, one wall-clock wait
+    configs = {}
+    try:
+        for local_n in (2, 4):
+            shards = [SparseReduceShard(n_hosts=2) for _ in range(2)]
+            procs = []
+            for hid in (0, 1):
+                out = tmp_path / f"r{local_n}_h{hid}.npz"
+                procs.append((out, subprocess.Popen(
+                    [sys.executable, str(script), str(hid), str(local_n),
+                     str(shards[0].address[1]), str(shards[1].address[1]),
+                     str(data_path), str(out)],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True, env=env, cwd=REPO_ROOT,
+                )))
+            configs[local_n] = (shards, procs)
+        by_replicas = {}
+        for local_n, (shards, procs) in configs.items():
+            outs = []
+            for out, p in procs:
+                stdout, stderr = p.communicate(timeout=240)
+                assert p.returncode == 0, stderr[-3000:]
+                assert "WORKER_DONE" in stdout
+                outs.append(dict(np.load(out)))
+            by_replicas[local_n] = outs
+    finally:
+        for shards, procs in configs.values():
+            for _, p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for s in shards:
+                s.close()
+
+    # oracle: single-device full-batch trainer in THIS process
+    params = fm.init(jax.random.PRNGKey(0), f, dim)
+    oracle = SparseTableCTRTrainer(
+        params, fm.logits, TrainConfig(learning_rate=0.1),
+        sparse_tables={"w": ["fids"], "v": ["fids"]},
+        fused_fn=fm.logits_with_l2,
+    )
+    oracle.health = None
+    o_losses = [float(oracle.train_step(full)) for _ in range(4)]
+
+    for local_n, (h0, h1) in by_replicas.items():
+        assert bool(h0["policy_hier"]) and bool(h1["policy_hier"])
+        np.testing.assert_allclose(h0["losses"], h1["losses"],
+                                   rtol=0, atol=1e-6)
+        np.testing.assert_allclose(h0["losses"], o_losses,
+                                   rtol=1e-4, atol=1e-6, err_msg=(
+                                       f"local_n={local_n} trajectory"))
+        for k in ("w", "v"):
+            np.testing.assert_array_equal(h0[k], h1[k])
+            np.testing.assert_allclose(
+                h0[k], np.asarray(oracle.params[k]), rtol=1e-4, atol=1e-5)
+
+    # cross-host bytes FLAT in local replica count: the per-host batch is
+    # fixed, so doubling the replicas must not move the wire bytes beyond
+    # the +-10% acceptance band — in the model AND on the real sockets
+    w2 = float(by_replicas[2][0]["wire_model_bytes"])
+    w4 = float(by_replicas[4][0]["wire_model_bytes"])
+    assert abs(w4 - w2) <= 0.1 * w2, (w2, w4)
+    s2 = float(by_replicas[2][0]["socket_bytes"])
+    s4 = float(by_replicas[4][0]["socket_bytes"])
+    assert abs(s4 - s2) <= 0.1 * s2, (s2, s4)

@@ -838,3 +838,86 @@ def test_metrics_report_kernels_section(tmp_path, capsys, monkeypatch):
     assert report["phases"]["merge"] == {"xla": 1}
     assert report["dispatches_by_impl"]["xla"] == 2
     assert report["fused_active"] is False
+
+
+# -- exchange telemetry lints + report (ISSUE 10) ----------------------------
+
+
+def test_every_exchange_series_is_declared_and_emitted():
+    """No dark exchange counters: every ``trainer_*`` metric the sparse
+    trainer EMITS (a literal first argument of a registry
+    ``inc``/``gauge_set``/``observe`` call, directly or through
+    ``labeled(...)``/``obs.labeled(...)``) must be declared in
+    ``models.sparse_trainer.EXCHANGE_SERIES`` — and every declared series
+    must actually be emitted.  The hierarchical per-hop counters
+    (``trainer_hier_wire/local_bytes_total``) can therefore never ship
+    unregistered or go stale."""
+    from lightctr_tpu.models import sparse_trainer
+
+    src = (LIB_ROOT / "models" / "sparse_trainer.py").read_text()
+    tree = ast.parse(src, filename="models/sparse_trainer.py")
+
+    emitted = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "gauge_set", "observe")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Call) and arg.args and (
+                (isinstance(arg.func, ast.Name)
+                 and arg.func.id == "labeled")
+                or (isinstance(arg.func, ast.Attribute)
+                    and arg.func.attr == "labeled")):
+            arg = arg.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("trainer_"):
+            emitted.add(arg.value)
+
+    declared = set(sparse_trainer.EXCHANGE_SERIES)
+    assert emitted, "no trainer_* emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "trainer_* series emitted but missing from EXCHANGE_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "EXCHANGE_SERIES declares series the trainer never emits "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(sparse_trainer.EXCHANGE_SERIES) == len(declared), \
+        "duplicate names in EXCHANGE_SERIES"
+
+
+def test_metrics_report_exchange_section(tmp_path, capsys):
+    """--exchange parses the per-table algo/byte series — the
+    hierarchical algo and its per-hop local/wire split included — out of
+    a registry snapshot."""
+    import tools.metrics_report as metrics_report
+
+    reg = obs.MetricsRegistry()
+    reg.inc(obs.labeled("trainer_exchange_algo_total",
+                        table="v", algo="hier"), 3)
+    reg.inc(obs.labeled("trainer_exchange_algo_total",
+                        table="w", algo="sparse_rs"), 3)
+    reg.inc(obs.labeled("trainer_exchange_bytes_total",
+                        table="v", policy="hier"), 3000)
+    reg.inc("trainer_hier_wire_bytes_total", 3000)
+    reg.inc("trainer_hier_local_bytes_total", 12000)
+    reg.inc("trainer_sparse_rs_bytes_total", 900)
+    reg.inc("trainer_rs_fallback_total", 1)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(reg.snapshot()))
+    assert metrics_report.main(["--exchange", str(path)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["tables"]["v"]["algo_steps"] == {"hier": 3}
+    assert report["tables"]["w"]["algo_steps"] == {"sparse_rs": 3}
+    assert report["tables"]["v"]["bytes"] == {"hier": 3000}
+    assert report["bytes_by_algo"]["hier_wire"] == 3000
+    assert report["bytes_by_algo"]["hier_local"] == 12000
+    assert report["bytes_by_algo"]["sparse_rs"] == 900
+    assert report["rs_fallback_steps"] == 1
+    assert report["hier_active"] is True
+    assert report["hier_local_to_wire_x"] == 4.0

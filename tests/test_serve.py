@@ -446,7 +446,12 @@ def test_ps_backed_server_cache_and_write_invalidation(rng):
         new_rows[:, 0] += 1.0   # shift every w: scores must move
         admin.preload_arrays(keys, new_rows)
         assert srv.refresh_version()
-        assert srv.cache.stats()["invalidations"] == 1
+        # EVERY key changed, but the write log still covers the move, so
+        # this lands as one per-key delta drop (full-cache invalidations
+        # stay for uncovered moves — see the churn test below)
+        st2 = srv.cache.stats()
+        assert st2["invalidations"] + st2["delta_invalidations"] == 1
+        assert st2["invalidated_rows"] >= st0["misses"]
         new_params = {"w": params["w"] + 1.0, "v": params["v"]}
         np.testing.assert_allclose(cli.predict(b),
                                    _forward(new_params, b), atol=2e-3)
@@ -460,6 +465,72 @@ def test_ps_backed_server_cache_and_write_invalidation(rng):
         s = cli.predict(junk)
         np.testing.assert_allclose(s, [0.5], atol=1e-3)  # sigmoid(0)
         assert store.stats()["n_keys"] == n_keys_before
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.close()
+        admin.close()
+        svc.close()
+
+
+def test_per_key_invalidation_keeps_hit_rate_under_churn(rng):
+    """ISSUE 10 satellite (the PR 7/8 follow-up): a training push that
+    touches ONE key must drop exactly that key from the hot-embedding
+    cache — the rest of the hot set keeps serving (hit rate survives
+    churn), where the old whole-cache invalidation zeroed it.  When the
+    PS write log no longer covers the cache's last observation (floor
+    advanced past it), the poll degrades to the full drop — bounded
+    staleness never rides on the log depth."""
+    params = fm.init(jax.random.PRNGKey(6), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", {},
+                           row_leaves=serve.fm_ps_row_leaves(K),
+                           row_dim=ROW_DIM),
+        ps=PSClient(svc.address, ROW_DIM), max_batch=16, max_wait_us=100,
+        queue_cap=64, deadline_ms=5000, cache_capacity=F,
+    )
+    cli = None
+    try:
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=8)
+        cli.predict(b)
+        cached0 = len(srv.cache)
+        assert cached0 > 1
+        touched = np.unique(b["fids"].reshape(-1).astype(np.int64))
+        victim = int(touched[0])
+
+        # churn: one trained key -> delta drop of exactly that key
+        admin.push_arrays(0, np.array([victim], np.int64),
+                          np.zeros((1, ROW_DIM), np.float32), worker_epoch=0)
+        assert srv.refresh_version()
+        st = srv.cache.stats()
+        assert st["delta_invalidations"] == 1
+        assert st["invalidations"] == 0
+        assert st["invalidated_rows"] == 1
+        assert len(srv.cache) == cached0 - 1
+
+        # the re-predict repulls ONLY the victim: hit rate under churn
+        misses0 = st["misses"]
+        cli.predict(b)
+        st2 = srv.cache.stats()
+        assert st2["misses"] == misses0 + 1
+
+        # floor overflow: many bumps past the (shrunk) log bound -> the
+        # delta no longer covers the cache's observation -> full drop
+        store.WRITE_LOG_MAX_ENTRIES = 2
+        for i in range(4):
+            admin.push_arrays(
+                0, np.array([int(touched[1]) + 0], np.int64),
+                np.zeros((1, ROW_DIM), np.float32), worker_epoch=0)
+        assert srv.refresh_version()
+        st3 = srv.cache.stats()
+        assert st3["invalidations"] == 1
+        assert len(srv.cache) == 0
     finally:
         if cli is not None:
             cli.close()

@@ -162,6 +162,46 @@ def test_merge_apply_apply_only_and_1d_table(rng):
     np.testing.assert_array_equal(np.asarray(w1)[0], table[0])  # pad row
 
 
+def test_merge_apply_row_block_matches_windowed(rng, monkeypatch):
+    """The apply kernel's rows-per-grid-step knob (the PR 9 follow-up):
+    the row-block variant (``LIGHTCTR_APPLY_ROWS=8``, full-ref dynamic
+    RMW, rb rows per grid step) and the windowed per-row kernel (``=1``)
+    agree with the reference to the documented FMA ulp — across a size
+    that does NOT divide the block (padded tail slots must be skipped,
+    not applied), dedup pads, and a REAL id 0 whose rotated slot runs
+    last."""
+    s, vocab, d = 11, 32, 3
+    uids_np = np.zeros(s, np.int64)
+    u = np.unique(rng.integers(1, vocab, size=s - 2))
+    uids_np[1:1 + u.size] = u  # slot 0 stays id 0 — REAL here
+    rows = rng.normal(size=(s, d)).astype(np.float32)
+    rows[1 + u.size:] = 0.0  # pads carry zero rows
+    table = rng.normal(size=(vocab, d)).astype(np.float32)
+    accum = np.abs(rng.normal(size=(vocab, d))).astype(np.float32)
+    args = (jnp.asarray(table), jnp.asarray(accum), jnp.asarray(uids_np),
+            jnp.asarray(rows), None)
+    w0, a0, s0 = sk.KERNELS["merge_apply"].reference(
+        *args, lr=0.1, eps=1e-7, denom=2.0)
+    outs = {}
+    for rb in ("1", "8"):
+        monkeypatch.setenv(sk.APPLY_ROWS_ENV, rb)
+        outs[rb] = sk.KERNELS["merge_apply"].pallas(
+            *args, lr=0.1, eps=1e-7, denom=2.0, interpret=True)
+    for rb, (w1, a1, s1) in outs.items():
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w0),
+                                   rtol=0, atol=2e-7, err_msg=f"rb={rb}")
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a0),
+                                   rtol=2e-6, atol=0, err_msg=f"rb={rb}")
+        np.testing.assert_allclose(float(s1), float(s0), rtol=1e-5)
+        untouched = np.setdiff1d(np.arange(vocab), uids_np)
+        np.testing.assert_array_equal(np.asarray(w1)[untouched],
+                                      table[untouched])
+    assert sk.apply_rows_per_step(True) == 8  # env still "8" here
+    monkeypatch.delenv(sk.APPLY_ROWS_ENV)
+    assert sk.apply_rows_per_step(True) == 8   # interpret default: block
+    assert sk.apply_rows_per_step(False) == 1  # compiled default: windowed
+
+
 # -- (c) quantize pack: bit-identical codes ------------------------------
 
 

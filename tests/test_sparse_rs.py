@@ -11,7 +11,11 @@ import numpy as np
 from lightctr_tpu import TrainConfig
 from lightctr_tpu.core.mesh import MeshSpec, make_mesh
 from lightctr_tpu.dist import (
+    LinkBandwidth,
     dense_ring_bytes,
+    expected_union,
+    hier_exchange_bytes,
+    hier_wire_bytes,
     pick_exchange_algo,
     rs_default_caps,
     rs_fits,
@@ -230,6 +234,108 @@ def test_cost_model_matches_payload_shapes_and_pick_crossover():
         == "sparse_rs"
 
 
+# -- bandwidth-aware cost model: the four-way pick (ISSUE 10) ------------
+
+
+def test_cost_model_hier_predicted_bytes_match_payload_shapes():
+    """The hierarchical branch's returned bytes equal the bytes derived
+    from the payload shapes the exchange actually ships: push the
+    expected local union + pull the expected global union, each entry an
+    int32 id + dim fp32 values (fp16 with wire_bits=16) — the same
+    helper-level contract the PR 5 cost-model test pins for the flat
+    algorithms."""
+    vocab, dim, local_n, n = 4096, 16, 8, 16
+    for k in (256, 2048):
+        k_out = expected_union(k, vocab, local_n)
+        k_in = expected_union(k, vocab, n)
+        manual = (k_out + k_in) * (4 + 4 * dim)
+        assert hier_wire_bytes(k_out, k_in, dim) == manual
+        assert hier_wire_bytes(k_out, k_in, dim, wire_bits=16) == \
+            (k_out + k_in) * (4 + 2 * dim)
+        local_algo, local_b, wire_b = hier_exchange_bytes(
+            local_n, n // local_n, k, vocab, dim
+        )
+        assert wire_b == manual
+        assert local_b == {
+            "sparse": sparse_exchange_bytes(local_n, k, dim),
+            "sparse_rs": sparse_rs_bytes(
+                local_n, *rs_default_caps(local_n, k, vocab), dim),
+        }[local_algo]
+        # a DCN slow enough that the wire dominates: the pick takes hier
+        # and returns exactly the wire bytes
+        algo, b = pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n,
+            bw=LinkBandwidth(4e9, 1e7, "env"),
+        )
+        assert (algo, b) == ("hier", wire_b)
+
+
+def test_cost_model_crossover_in_bandwidth_ratio():
+    """Synthetic ICI/DCN sweeps: with the DCN the bottleneck the pick
+    aggregates before the slow link (hier); as the DCN approaches and
+    passes the ICI the flat single-fabric algorithm wins back.  The flip
+    is monotone — exactly one crossover along the sweep."""
+    vocab, dim, local_n, n, k = 4096, 16, 8, 16, 2048
+    ici = 4e9
+    picks = []
+    for dcn in (1e7, 1e8, 1e9, 4e9, 1e10, 4e10, 1e12):
+        algo, _ = pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n,
+            bw=LinkBandwidth(ici, dcn, "env"),
+        )
+        picks.append(algo)
+    assert picks[0] == "hier", picks
+    assert picks[-1] != "hier", picks
+    flips = sum(1 for a, b_ in zip(picks, picks[1:]) if a != b_)
+    assert flips == 1, picks
+    # single-fabric form unchanged: local_n None/==n is the byte pick
+    flat = pick_exchange_algo(n, k, vocab, dim)
+    assert pick_exchange_algo(n, k, vocab, dim, local_n=n) == flat
+    assert flat[0] in ("sparse", "sparse_rs", "dense")
+    import pytest
+
+    with pytest.raises(ValueError, match="whole number"):
+        pick_exchange_algo(n, k, vocab, dim, local_n=5,
+                           bw=LinkBandwidth(1e9, 1e8, "env"))
+
+
+def test_cost_model_hysteresis_never_flaps():
+    """The incumbent-pick hysteresis: around the crossover bandwidth, a
+    re-probe jittering a few percent must not flip the decision in either
+    direction — a flapping per-table pick re-traces the whole step
+    program."""
+    vocab, dim, local_n, n, k = 4096, 16, 8, 16, 2048
+    ici = 4e9
+
+    def pick_at(dcn, prev=None):
+        return pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n,
+            bw=LinkBandwidth(ici, dcn, "env"), prev=prev,
+        )[0]
+
+    # locate the crossover by bisection (prev-free picks)
+    lo, hi = 1e7, 1e12
+    assert pick_at(lo) == "hier" and pick_at(hi) != "hier"
+    for _ in range(60):
+        mid = (lo * hi) ** 0.5
+        if pick_at(mid) == "hier":
+            lo = mid
+        else:
+            hi = mid
+    boundary = (lo * hi) ** 0.5
+    # at the boundary, whatever the incumbent is it KEEPS the pick under
+    # +-10% probe jitter — in both directions
+    for prev in (pick_at(lo), pick_at(hi)):
+        for jitter in (0.9, 0.95, 1.0, 1.05, 1.1):
+            assert pick_at(boundary * jitter, prev=prev) == prev, (
+                prev, jitter,
+            )
+    # hysteresis does not trap the pick forever: far from the boundary
+    # the challenger's win clears PICK_FLAP_MARGIN and the pick moves
+    assert pick_at(1e7, prev=pick_at(hi)) == "hier"
+    assert pick_at(1e12, prev="hier") != "hier"
+
+
 # -- shared id streams ---------------------------------------------------
 
 
@@ -314,14 +420,15 @@ def test_sparse_ef_residual_drains_and_recovers_clip(rng):
     assert lost[1, 0] < n * crange * 1.01  # clipped at ~n*range, not n*2.5
 
     # with EF: carry the clip remainder, stream zero gradients after
+    # (jitted once — the loop re-dispatches one program)
+    step = jax.jit(lambda u, r, res: sparse_all_reduce(
+        mesh, u, r, average=False, compress_bits=bits,
+        compress_range=crange, residual=res))
     res = sparse_ef_residual_init(mesh, (vocab, dim))
     applied = np.zeros((vocab, dim), np.float32)
     for t in range(8):
         g = spike if t == 0 else zero
-        gu, m, res = sparse_all_reduce(
-            mesh, jnp.asarray(uids), jnp.asarray(g), average=False,
-            compress_bits=bits, compress_range=crange, residual=res,
-        )
+        gu, m, res = step(jnp.asarray(uids), jnp.asarray(g), res)
         applied += dense_scatter(vocab, dim, np.asarray(gu)[0],
                                  np.asarray(m)[0])
     bucket_w = 2 * crange / (1 << bits)
@@ -372,13 +479,17 @@ def test_rs_ef_residual_drains_and_recovers_clip(rng):
     touched = [1, 2, 7, 8]
 
     # single-shot, no EF: the spike round delivers at most ~range/member
+    # (jitted once — the drain loops re-dispatch one program each)
+    plain = jax.jit(lambda u, r: sparse_reduce_scatter(
+        mesh, u, r, average=True, vocab=vocab,
+        compress_bits=bits, compress_range=crange))
+    with_ef = jax.jit(lambda u, r, res: sparse_reduce_scatter(
+        mesh, u, r, average=True, vocab=vocab,
+        compress_bits=bits, compress_range=crange, residual=res))
     applied_no = np.zeros((vocab, dim), np.float32)
     for t in range(8):
         g = spike if t == 0 else zero
-        gu, m, over = sparse_reduce_scatter(
-            mesh, jnp.asarray(uids), jnp.asarray(g), average=True,
-            vocab=vocab, compress_bits=bits, compress_range=crange,
-        )
+        gu, m, over = plain(jnp.asarray(uids), jnp.asarray(g))
         assert int(np.asarray(over)[0]) == 0
         applied_no += dense_scatter(vocab, dim, np.asarray(gu)[0],
                                     np.asarray(m)[0])
@@ -388,11 +499,7 @@ def test_rs_ef_residual_drains_and_recovers_clip(rng):
     applied = np.zeros((vocab, dim), np.float32)
     for t in range(8):
         g = spike if t == 0 else zero
-        gu, m, over, res = sparse_reduce_scatter(
-            mesh, jnp.asarray(uids), jnp.asarray(g), average=True,
-            vocab=vocab, compress_bits=bits, compress_range=crange,
-            residual=res,
-        )
+        gu, m, over, res = with_ef(jnp.asarray(uids), jnp.asarray(g), res)
         applied += dense_scatter(vocab, dim, np.asarray(gu)[0],
                                  np.asarray(m)[0])
     bucket_w = 2 * crange / (1 << bits)
@@ -406,6 +513,100 @@ def test_rs_ef_residual_drains_and_recovers_clip(rng):
                                atol=8 * n * bucket_w)
     # acceptance: delivered clipped mass beats the no-EF baseline
     assert applied[touched].mean() > 1.5 * applied_no[touched].mean()
+
+
+def test_rs_owner_ef_drains_sum_mode_stage2_clip(rng):
+    """ISSUE 10 satellite (the PR 9 follow-up): in SUM mode the owner's
+    merged shard reaches ``n * value`` and the STAGE-2 encode clips where
+    the mean exchange cannot — mirrored by the owner-side residual: the
+    clipped merged mass is carried at the owner's row slots and delivered
+    over the following rounds, draining to sub-bucket noise, while the
+    no-carry run loses everything past the range."""
+    n, vocab, k, dim, bits, crange = 4, 32, 6, 3, 8, 1.0
+    mesh = make_mesh(MeshSpec(data=n))
+    # one id per owner, no bucket pressure; per-member value 0.6 stays
+    # inside the range (stage 1 cannot clip) but the 4-way merged sum
+    # 2.4 blows past it (stage 2 clips without the owner carry)
+    uids = np.tile(np.array([1, 2, 7, 8, 0, 0], np.int64), (n, 1))
+    spike = np.zeros((n, k, dim), np.float32)
+    spike[:, :4] = 0.6
+    zero = np.zeros_like(spike)
+    touched = [1, 2, 7, 8]
+
+    # jitted once: the drain loop re-dispatches the same program
+    plain = jax.jit(lambda u, r: sparse_reduce_scatter(
+        mesh, u, r, average=False, vocab=vocab,
+        compress_bits=bits, compress_range=crange))
+    with_ef = jax.jit(lambda u, r, res: sparse_reduce_scatter(
+        mesh, u, r, average=False, vocab=vocab,
+        compress_bits=bits, compress_range=crange, owner_residual=res))
+
+    applied_no = np.zeros((vocab, dim), np.float32)
+    for t in range(2):
+        g = spike if t == 0 else zero
+        gu, m, over = plain(jnp.asarray(uids), jnp.asarray(g))
+        assert int(np.asarray(over)[0]) == 0
+        applied_no += dense_scatter(vocab, dim, np.asarray(gu)[0],
+                                    np.asarray(m)[0])
+    assert applied_no[1, 0] < crange * 1.01  # stage-2 clip: ~range, not 2.4
+
+    ores = sparse_ef_residual_init(mesh, (vocab, dim))
+    applied = np.zeros((vocab, dim), np.float32)
+    for t in range(6):
+        g = spike if t == 0 else zero
+        gu, m, over, ores = with_ef(jnp.asarray(uids), jnp.asarray(g), ores)
+        applied += dense_scatter(vocab, dim, np.asarray(gu)[0],
+                                 np.asarray(m)[0])
+    bucket_w = 2 * crange / (1 << bits)
+    # the carry partitions by owner: row u only ever moves on member
+    # u % n's carry, and it must have drained
+    assert float(np.max(np.abs(np.asarray(ores)[:, touched]))) <= bucket_w
+    np.testing.assert_allclose(applied[touched], n * 0.6, rtol=0,
+                               atol=6 * n * bucket_w)
+    assert applied[touched].mean() > 1.8 * applied_no[touched].mean()
+
+
+def test_rs_owner_ef_rejected_in_mean_mode(rng):
+    import pytest
+
+    mesh = make_mesh(MeshSpec(data=2))
+    uids = np.tile(np.arange(1, 5, dtype=np.int64), (2, 1))
+    rows = np.ones((2, 4, 2), np.float32)
+    ores = sparse_ef_residual_init(mesh, (8, 2))
+    with pytest.raises(ValueError, match="SUM-mode"):
+        sparse_reduce_scatter(mesh, jnp.asarray(uids), jnp.asarray(rows),
+                              vocab=8, average=True, compress_bits=8,
+                              compress_range=1.0, owner_residual=ores)
+
+
+def test_rs_both_stage_carries_compose_under_clip(rng):
+    """Stage-1 (member) + stage-2 (owner) carries together: a payload
+    that clips BOTH encodes (per-member value past the range AND a merged
+    sum past it) still delivers the full sum over the rounds — each
+    stage's loss lands in its own carry."""
+    n, vocab, k, dim, bits, crange = 4, 32, 6, 2, 8, 1.0
+    mesh = make_mesh(MeshSpec(data=n))
+    uids = np.tile(np.array([1, 2, 7, 8, 0, 0], np.int64), (n, 1))
+    spike = np.zeros((n, k, dim), np.float32)
+    spike[:, :4] = 1.7  # past the range: stage 1 clips; 4x sum clips too
+    zero = np.zeros_like(spike)
+    touched = [1, 2, 7, 8]
+    step = jax.jit(lambda u, r, res, ores: sparse_reduce_scatter(
+        mesh, u, r, average=False, vocab=vocab,
+        compress_bits=bits, compress_range=crange,
+        residual=res, owner_residual=ores))
+    res = sparse_ef_residual_init(mesh, (vocab, dim))
+    ores = sparse_ef_residual_init(mesh, (vocab, dim))
+    applied = np.zeros((vocab, dim), np.float32)
+    for t in range(12):
+        g = spike if t == 0 else zero
+        gu, m, over, res, ores = step(jnp.asarray(uids), jnp.asarray(g),
+                                      res, ores)
+        applied += dense_scatter(vocab, dim, np.asarray(gu)[0],
+                                 np.asarray(m)[0])
+    bucket_w = 2 * crange / (1 << bits)
+    np.testing.assert_allclose(applied[touched], n * 1.7, rtol=0,
+                               atol=16 * n * bucket_w)
 
 
 def test_rs_ef_overflow_carries_full_value(rng):

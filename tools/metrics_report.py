@@ -342,6 +342,63 @@ def summarize_kernels(doc) -> dict:
     }
 
 
+def summarize_exchange(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> gradient-exchange report: per-table algorithm
+    decisions (``trainer_exchange_algo_total{table,algo}`` — dense ring,
+    sparse allgather, sparse reduce-scatter, or the HIERARCHICAL
+    two-level exchange), per-table bytes, the per-algorithm byte totals,
+    and for the hierarchical path its per-HOP split: the ICI local-merge
+    bytes vs the DCN wire bytes (the number that stays flat in local
+    replica count — docs/SPARSE_EXCHANGE.md)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+
+    def _labeled(prefix):
+        out = {}
+        p = prefix + "{"
+        for name, val in counters.items():
+            if not name.startswith(p):
+                continue
+            labels = dict(
+                part.split("=", 1)
+                for part in name[len(p):-1].replace('"', "").split(",")
+            )
+            out[tuple(sorted(labels.items()))] = int(val)
+        return out
+
+    tables: dict = {}
+    for labels, val in _labeled("trainer_exchange_algo_total").items():
+        ld = dict(labels)
+        t = tables.setdefault(ld.get("table", "?"), {"algo_steps": {}})
+        t["algo_steps"][ld.get("algo", "?")] = val
+    for labels, val in _labeled("trainer_exchange_bytes_total").items():
+        ld = dict(labels)
+        t = tables.setdefault(ld.get("table", "?"), {"algo_steps": {}})
+        t.setdefault("bytes", {})[ld.get("policy", "?")] = val
+    totals = {
+        "sparse_allgather": counters.get(
+            "trainer_sparse_exchange_bytes_total", 0),
+        "sparse_rs": counters.get("trainer_sparse_rs_bytes_total", 0),
+        "dense_ring": counters.get("trainer_dense_ring_bytes_total", 0),
+        "hier_wire": counters.get("trainer_hier_wire_bytes_total", 0),
+        "hier_local": counters.get("trainer_hier_local_bytes_total", 0),
+    }
+    report = {
+        "tables": {k: tables[k] for k in sorted(tables)},
+        "bytes_by_algo": totals,
+        "rs_fallback_steps": counters.get("trainer_rs_fallback_total", 0),
+        "rs_overflow_entries": counters.get("trainer_rs_overflow_total", 0),
+        "hier_active": bool(totals["hier_wire"]),
+    }
+    if totals["hier_wire"]:
+        # the hierarchy's reason to exist, as a single number: how many
+        # ICI bytes were merged down to each DCN byte
+        report["hier_local_to_wire_x"] = round(
+            totals["hier_local"] / max(totals["hier_wire"], 1), 3)
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -365,6 +422,11 @@ def main(argv=None):
                     help="summarize sparse-kernel dispatch counts "
                          "(trainer_kernel_path_total{phase,impl}) from a "
                          "registry snapshot or stats() dump")
+    ap.add_argument("--exchange", metavar="SNAPSHOT_JSON",
+                    help="summarize gradient-exchange decisions and bytes "
+                         "(trainer_exchange_*/trainer_hier_* series, the "
+                         "hierarchical per-hop local/wire split included) "
+                         "from a registry snapshot or stats() dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -395,6 +457,15 @@ def main(argv=None):
         with open(args.store) as f:
             doc = json.load(f)
         report = summarize_store(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
+    if args.exchange:
+        with open(args.exchange) as f:
+            doc = json.load(f)
+        report = summarize_exchange(doc)
         print(json.dumps(report, indent=1))
         if args.out:
             with open(args.out, "w") as f:
