@@ -16,9 +16,48 @@ and the semantics oracle (``native=False``).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Iterator, Optional
 
 import numpy as np
+
+
+def _stop_requested(stop) -> bool:
+    """``stop`` is the loop/follow escape hatch for otherwise-infinite
+    streams: None (never stop), a ``threading.Event``-like (``is_set``),
+    or a zero-arg callable."""
+    if stop is None:
+        return False
+    if hasattr(stop, "is_set"):
+        return bool(stop.is_set())
+    return bool(stop())
+
+
+def _new_buffers(batch_size: int, max_nnz: int) -> Dict[str, np.ndarray]:
+    return {
+        "fids": np.zeros((batch_size, max_nnz), np.int32),
+        "fields": np.zeros((batch_size, max_nnz), np.int32),
+        "vals": np.zeros((batch_size, max_nnz), np.float32),
+        "mask": np.zeros((batch_size, max_nnz), np.float32),
+        "labels": np.zeros((batch_size,), np.float32),
+        "row_mask": np.zeros((batch_size,), np.float32),
+    }
+
+
+def _fill_row(buf, fill, label, row, max_nnz, feature_cnt, field_cnt):
+    """Write one parsed row into batch slot ``fill`` (the one row-packing
+    idiom: the eager python path and the follow tailer share it)."""
+    buf["labels"][fill] = label
+    buf["row_mask"][fill] = 1.0
+    for j, (field, fid, val) in enumerate(row[:max_nnz]):
+        if feature_cnt is not None:
+            fid %= feature_cnt
+        if field_cnt is not None:
+            field %= field_cnt
+        buf["fids"][fill, j] = fid
+        buf["fields"][fill, j] = field
+        buf["vals"][fill, j] = val
+        buf["mask"][fill, j] = 1.0
 
 
 def iter_libffm_batches(
@@ -31,6 +70,13 @@ def iter_libffm_batches(
     native: Optional[bool] = None,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    *,
+    loop: bool = False,
+    follow: bool = False,
+    shuffle_batches: int = 0,
+    seed: int = 0,
+    stop=None,
+    poll_s: float = 0.05,
 ) -> Iterator[Dict[str, np.ndarray]]:
     """Yield batch dicts with keys fids/fields/vals/mask/labels (+``row_mask``
     flagging real rows when the tail batch is padded).  ``native=None``
@@ -42,11 +88,68 @@ def iter_libffm_batches(
     of the reference's per-worker input split (``data/proc_file_split.py``)
     and of :func:`lightctr_tpu.data.batching.shard_for_hosts`, so multi-host
     ingest needs no pre-split files.  Each worker's batches hold only its own
-    rows (every batch still ``batch_size`` rows)."""
+    rows (every batch still ``batch_size`` rows).
+
+    ONLINE modes (docs/ONLINE.md — the continuous trainer's ingest):
+
+    - ``loop=True``: infinite epochs — the file re-streams forever, each
+      epoch optionally re-shuffled through a bounded batch buffer
+      (``shuffle_batches``) whose rng is seeded ``(seed, epoch)``: the
+      order is deterministic per (seed, epoch) and different across
+      epochs.  ``drop_remainder`` applies per epoch, so every wrapped
+      epoch yields the same batch count.
+    - ``follow=True``: tail a GROWING file — at end-of-data the reader
+      polls every ``poll_s`` seconds for appended lines instead of
+      terminating.  A trailing PARTIAL line (no newline yet — a writer
+      mid-append) is never parsed; it waits for its newline.  Batches
+      are emitted only when full (a follow stream has no meaningful
+      tail).  Python row parsing only, no sharding.
+    - ``stop``: escape hatch for both (Event or callable) — checked
+      between batches, so an infinite stream shuts down cleanly."""
     from lightctr_tpu.native import bindings
 
     if (process_index is None) != (process_count is None):
         raise ValueError("process_index and process_count go together")
+    if follow:
+        if loop:
+            raise ValueError("follow and loop are exclusive "
+                             "(a tailed file never reaches its wrap)")
+        if process_count is not None:
+            raise ValueError("follow mode does not shard "
+                             "(tail one file per follower)")
+        yield from _iter_follow(
+            path, batch_size, max_nnz, feature_cnt, field_cnt,
+            shuffle_batches, seed, stop, poll_s,
+        )
+        return
+    if loop:
+        epoch = 0
+        while not _stop_requested(stop):
+            inner = iter_libffm_batches(
+                path, batch_size, max_nnz, feature_cnt, field_cnt,
+                drop_remainder, native, process_index, process_count,
+            )
+            if shuffle_batches > 1:
+                inner = _shuffle_buffer(
+                    inner, np.random.default_rng([seed, epoch]),
+                    shuffle_batches,
+                )
+            for b in inner:
+                if _stop_requested(stop):
+                    return
+                yield b
+            epoch += 1
+        return
+    if shuffle_batches > 1:
+        yield from _shuffle_buffer(
+            iter_libffm_batches(
+                path, batch_size, max_nnz, feature_cnt, field_cnt,
+                drop_remainder, native, process_index, process_count,
+            ),
+            np.random.default_rng([seed, 0]),
+            shuffle_batches,
+        )
+        return
     if process_count is not None:
         if not (0 <= process_index < process_count):
             raise ValueError(
@@ -81,19 +184,9 @@ def iter_libffm_batches(
         )
         return
 
-    def new_buffers():
-        return {
-            "fids": np.zeros((batch_size, max_nnz), np.int32),
-            "fields": np.zeros((batch_size, max_nnz), np.int32),
-            "vals": np.zeros((batch_size, max_nnz), np.float32),
-            "mask": np.zeros((batch_size, max_nnz), np.float32),
-            "labels": np.zeros((batch_size,), np.float32),
-            "row_mask": np.zeros((batch_size,), np.float32),
-        }
-
     from lightctr_tpu.data.sparse import parse_libffm_line
 
-    buf = new_buffers()
+    buf = _new_buffers(batch_size, max_nnz)
     fill = 0
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
@@ -101,24 +194,75 @@ def iter_libffm_batches(
             if parsed is None:
                 continue
             label, row = parsed
-            buf["labels"][fill] = label
-            buf["row_mask"][fill] = 1.0
-            for j, (field, fid, val) in enumerate(row[:max_nnz]):
-                if feature_cnt is not None:
-                    fid %= feature_cnt
-                if field_cnt is not None:
-                    field %= field_cnt
-                buf["fids"][fill, j] = fid
-                buf["fields"][fill, j] = field
-                buf["vals"][fill, j] = val
-                buf["mask"][fill, j] = 1.0
+            _fill_row(buf, fill, label, row, max_nnz, feature_cnt,
+                      field_cnt)
             fill += 1
             if fill == batch_size:
                 yield buf
-                buf = new_buffers()
+                buf = _new_buffers(batch_size, max_nnz)
                 fill = 0
     if fill and not drop_remainder:
         yield buf
+
+
+def _shuffle_buffer(inner, rng, k: int):
+    """Bounded-buffer stream shuffle at batch granularity: hold up to
+    ``k`` batches, emit a uniformly random resident as each new one
+    arrives (then drain in random order).  Deterministic for a given rng
+    seed — the loop mode's per-epoch reshuffle."""
+    buf: list = []
+    for b in inner:
+        buf.append(b)
+        if len(buf) >= k:
+            i = int(rng.integers(len(buf)))
+            buf[i], buf[-1] = buf[-1], buf[i]
+            yield buf.pop()
+    while buf:
+        i = int(rng.integers(len(buf)))
+        buf[i], buf[-1] = buf[-1], buf[i]
+        yield buf.pop()
+
+
+def _iter_follow(path, batch_size, max_nnz, feature_cnt, field_cnt,
+                 shuffle_batches, seed, stop, poll_s):
+    """Tail-follow reader: stream the file's current content, then poll
+    for growth.  The one subtlety is the PARTIAL TAIL LINE — a writer
+    caught mid-append leaves bytes with no newline; parsing them would
+    misread half a row (or raise on a torn token), so everything after
+    the last newline is buffered and re-joined with the next read.  A
+    line is parsed exactly once, when its newline lands."""
+    if shuffle_batches > 1:
+        raise ValueError(
+            "follow mode cannot shuffle (a tail has no epoch to buffer)"
+        )
+    del seed
+    from lightctr_tpu.data.sparse import parse_libffm_line
+
+    buf = _new_buffers(batch_size, max_nnz)
+    fill = 0
+    lineno = 0
+    partial = ""
+    with open(path) as f:
+        while not _stop_requested(stop):
+            chunk = f.read(1 << 16)
+            if not chunk:
+                time.sleep(poll_s)
+                continue
+            pieces = (partial + chunk).split("\n")
+            partial = pieces.pop()  # no newline yet: wait for the writer
+            for line in pieces:
+                lineno += 1
+                parsed = parse_libffm_line(line, path, lineno)
+                if parsed is None:
+                    continue
+                label, row = parsed
+                _fill_row(buf, fill, label, row, max_nnz, feature_cnt,
+                          field_cnt)
+                fill += 1
+                if fill == batch_size:
+                    yield buf
+                    buf = _new_buffers(batch_size, max_nnz)
+                    fill = 0
 
 
 def _stride_rebatch(inner, batch_size, process_index, process_count, drop_remainder):

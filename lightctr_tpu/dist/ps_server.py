@@ -107,6 +107,20 @@ MSG_PREDICT_BATCH = 16
 #                     source of a state-carrying join migration.
 MSG_MIGRATE_STATE = 17
 MSG_SNAPSHOT_STATE = 18
+# online-learning op (lightctr_tpu/online, docs/ONLINE.md): push-based
+# serving freshness off the store's bounded write log —
+#   SUBSCRIBE -> varint([since_version, timeout_ms]); the handler LONG-POLLS
+#                the store (wait_write_delta, capped at
+#                SUBSCRIBE_MAX_WAIT_S server-side) until write_version moves
+#                past since_version or the wait expires, then replies JSON
+#                {"write_version", "floor", "covered", "entries":
+#                 [[version, [uids...], write_ts], ...]} with every logged
+#                entry past since_version.  covered=False means the log
+#                floor advanced beyond the subscriber's observation — only
+#                a full cache drop is safe.  A store without the write-log
+#                surface answers the protocol-error byte; subscribers
+#                degrade to MSG_STATS polling.
+MSG_SUBSCRIBE = 19
 
 # wire-op names for the telemetry series (obs registry)
 _OP_NAMES = {
@@ -118,7 +132,14 @@ _OP_NAMES = {
     MSG_PREDICT_BATCH: "predict_batch",
     MSG_MIGRATE_STATE: "migrate_state",
     MSG_SNAPSHOT_STATE: "snapshot_state",
+    MSG_SUBSCRIBE: "subscribe",
 }
+
+# server-side cap on one SUBSCRIBE long-poll: bounds how long a handler
+# thread can sit parked on the store condition (service shutdown joins
+# connection threads with a short timeout), while keeping the idle re-poll
+# cost to one tiny RTT every couple of seconds
+SUBSCRIBE_MAX_WAIT_S = 2.0
 
 # One garbage length prefix must not make the server buffer gigabytes before
 # any validation: cap frames well above any real payload (2^20 keys at
@@ -497,6 +518,27 @@ class ParamServerService:
                                     + rows.astype(np.float32).tobytes()
                                     + accs.astype(np.float32).tobytes())
                             send(struct.pack("<IB", len(body), 0) + body)
+                        elif msg_type == MSG_SUBSCRIBE:
+                            hdr, _ = wire.split_varint(payload, 2)
+                            since, tmo_ms = int(hdr[0]), int(hdr[1])
+                            waiter = getattr(
+                                self.ps, "wait_write_delta", None
+                            )
+                            if waiter is None:
+                                # a store without the write-log surface
+                                # (or one that disabled it): deterministic
+                                # rejection — subscribers degrade to
+                                # MSG_STATS polling, never to staleness
+                                raise ValueError(
+                                    "store has no write-delta subscription"
+                                )
+                            rep = waiter(
+                                since,
+                                min(max(tmo_ms, 0) / 1e3,
+                                    SUBSCRIBE_MAX_WAIT_S),
+                            )
+                            body = json.dumps(rep).encode()
+                            send(struct.pack("<IB", len(body), 0) + body)
                         elif msg_type == MSG_EVICT:
                             keys = wire.unpack_keys(payload)
                             n = self.ps.evict_batch(keys)
@@ -837,6 +879,23 @@ class PSClient:
         """Server-side counter snapshot (withheld/dropped/rejected, unrouted
         set, epoch ledger) — the artifact-facing admin op."""
         return json.loads(self._rpc(MSG_STATS, b"").decode())
+
+    def subscribe_deltas(self, since: int, timeout_ms: int = 2000) -> Dict:
+        """Long-poll the shard's bounded write log (MSG_SUBSCRIBE): blocks
+        server-side until ``write_version`` moves past ``since`` or the
+        wait expires (capped at :data:`SUBSCRIBE_MAX_WAIT_S` server-side),
+        returning ``{"write_version", "floor", "covered", "entries"}`` —
+        the push-based freshness feed :class:`lightctr_tpu.online.
+        FreshnessSubscriber` drives serving-cache invalidation with.
+        Construct the client with a socket ``timeout`` comfortably above
+        ``timeout_ms``, or the long-poll reads as a dead shard.  Raises
+        :class:`ProtocolRejection` against a store without the write-log
+        surface (callers degrade to :meth:`stats` polling)."""
+        payload = wire.pack_varint(np.array(
+            [max(0, int(since)), max(0, int(timeout_ms))], np.int64
+        ))
+        reply = self._rpc(MSG_SUBSCRIBE, payload)
+        return json.loads(reply.decode())
 
     def farewell(self, worker_id: int) -> None:
         """Clean departure: deregister from liveness tracking (FIN)."""

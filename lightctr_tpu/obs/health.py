@@ -371,6 +371,42 @@ class LatencySLODetector(Detector):
         return status, detail
 
 
+class FreshnessSLODetector(Detector):
+    """Online-serving freshness SLO: the age of the NEWEST update this
+    serving replica has applied (``lightctr_tpu.online.freshness`` feeds
+    ``now - server-stamped write time`` of the last applied write-log
+    entry, or the instant of the last full refresh).  In a continuous
+    train-and-serve deployment updates never stop arriving, so a growing
+    age means serving lags training — the subscriber wedged, the shard
+    unreachable, or the trainer itself stalled (docs/ONLINE.md).  Past
+    the SLO the verdict degrades; past ``hard_factor`` x it is
+    unhealthy.  The age signal carries its own time hysteresis (it must
+    GROW past the budget), so the detector trips and recovers in one
+    observation — like the heartbeat detector."""
+
+    name = "freshness_slo"
+    signals = ("freshness",)
+    trip_after = 1
+    recover_after = 1
+
+    def __init__(self, slo_s: float = 10.0, hard_factor: float = 3.0):
+        self.slo_s = float(slo_s)
+        self.hard_factor = float(hard_factor)
+
+    def check(self, signals):
+        f = signals["freshness"]
+        age = float(f.get("age_s", 0.0))
+        detail = {"age_s": round(age, 3), "slo_s": self.slo_s}
+        for k in ("applied", "full_refreshes"):
+            if k in f:
+                detail[k] = int(f[k])
+        if age > self.slo_s * self.hard_factor:
+            return UNHEALTHY, detail
+        if age > self.slo_s:
+            return DEGRADED, detail
+        return OK, detail
+
+
 class TierThrashDetector(Detector):
     """Tiered-store thrash: the hot tier cycling rows in and out faster
     than it serves them means the working set no longer fits the fast
@@ -419,7 +455,7 @@ KNOWN_DETECTORS = {
     for cls in (
         NaNLossDetector, LossSpikeDetector, GradNormDetector,
         TableSkewDetector, StalenessDetector, HeartbeatGapDetector,
-        LatencySLODetector, TierThrashDetector,
+        LatencySLODetector, TierThrashDetector, FreshnessSLODetector,
     )
 }
 

@@ -1,0 +1,258 @@
+"""Dense-model hot-swap behind a shadow-scoring parity gate.
+
+The online trainer periodically exports its dense half as a compressed
+artifact (``models/export.py``); this module is the serving side of that
+hand-off (docs/ONLINE.md): load the candidate, score a HELD replay slice
+with the candidate and the live model side by side, and flip
+(:meth:`~lightctr_tpu.serve.model.ServingModel.swap_params`, one atomic
+reference assignment between micro-batches) only when the two agree
+within tolerance.  A corrupted export — torn file, wrong kind, NaN
+weights, or weights that simply score differently than any plausible
+training step could explain — is REFUSED, counted, and evented; the live
+model keeps serving.
+
+The replay slice is captured once, including the PS rows it scored
+against for row-backed models, so the gate compares MODELS under
+identical inputs — concurrent training churn cannot masquerade as (or
+mask) a corrupted export.
+
+Export hand-off protocol (:func:`publish_export` writes it, the watcher
+reads it): artifacts are ``tmp -> fsync -> rename`` atomic, and a
+``LATEST`` pointer file (same atomic dance) names the newest one — a
+reader never sees a torn artifact through the pointer.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from lightctr_tpu.obs import events as events_mod
+from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs.registry import labeled
+from lightctr_tpu.serve.model import ServingModel
+
+_LOG = logging.getLogger(__name__)
+
+LATEST_POINTER = "LATEST"
+
+
+def publish_export(export_dir: str, params: Dict, model: str, step: int,
+                   **save_kw) -> str:
+    """Write ``model_<step>.npz`` atomically (tmp + fsync dir-entry via
+    rename) and flip the ``LATEST`` pointer to it.  Returns the artifact
+    path.  ``save_kw`` forwards to
+    :func:`lightctr_tpu.models.export.save_compressed_npz`."""
+    from lightctr_tpu.models.export import save_compressed_npz
+
+    os.makedirs(export_dir, exist_ok=True)
+    name = f"model_{int(step):010d}.npz"
+    final = os.path.join(export_dir, name)
+    tmp = os.path.join(export_dir, f".tmp_{name}")
+    save_compressed_npz(tmp, params, model=model, **save_kw)
+    # fsync the ARTIFACT bytes before any rename: the pointer below is
+    # durable, so without this a crash could leave a durable LATEST
+    # naming a torn artifact — the exact inversion of the guarantee
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
+    ptr_tmp = os.path.join(export_dir, ".tmp_" + LATEST_POINTER)
+    with open(ptr_tmp, "w") as f:
+        f.write(name)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(ptr_tmp, os.path.join(export_dir, LATEST_POINTER))
+    # fsync the directory so both renames (artifact + pointer) survive
+    # a crash together
+    dirfd = os.open(export_dir, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+    return final
+
+
+def read_latest(export_dir: str) -> Optional[str]:
+    """The artifact path the ``LATEST`` pointer names (None when no
+    export has been published yet)."""
+    try:
+        with open(os.path.join(export_dir, LATEST_POINTER)) as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    return os.path.join(export_dir, name) if name else None
+
+
+class ModelSwapper:
+    """Shadow-scoring swap gate over one live :class:`ServingModel`.
+
+    ``replay``: request-array dicts (the model's batch layout) held as
+    the parity probe; for PS-row-backed models pass ``pull_rows(uids) ->
+    [n, row_dim] rows`` so the slice can capture its row inputs once.
+    ``tolerance``: max absolute score divergence the gate accepts —
+    budget it for the export codec (an int8-coded export of the CURRENT
+    weights should pass; a corrupted one should not).
+    """
+
+    def __init__(
+        self,
+        model: ServingModel,
+        replay: List[Dict],
+        tolerance: float = 5e-3,
+        pull_rows=None,
+        registry=None,
+    ):
+        from lightctr_tpu.obs.registry import default_registry
+
+        if not replay:
+            raise ValueError("swap gate needs a non-empty replay slice")
+        self.model = model
+        self.tolerance = float(tolerance)
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._lock = threading.Lock()
+        self.attempts = 0
+        self.accepted = 0
+        self.refusals: Dict[str, int] = {}
+        self.last_diff: Optional[float] = None
+        self.last_path: Optional[str] = None
+        self._replay = []
+        for arrays in replay:
+            if model.row_leaves:
+                if pull_rows is None:
+                    raise ValueError(
+                        "row-backed model: pass pull_rows to capture the "
+                        "replay slice's row inputs"
+                    )
+                uids = model.touched_uids(arrays)
+                rows = np.asarray(pull_rows(uids), np.float32).reshape(
+                    len(uids), model.row_dim
+                )
+                self._replay.append((arrays, uids, rows))
+            else:
+                self._replay.append((arrays, None, None))
+        self._watch_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+
+    @staticmethod
+    def _score(model: ServingModel, arrays, uids, rows) -> np.ndarray:
+        if model.row_leaves:
+            return model.score_rows(arrays, uids, rows)
+        return model.score(arrays)
+
+    # -- the gate ------------------------------------------------------------
+
+    def offer(self, path: str) -> bool:
+        """Gate one candidate artifact; True = swapped in.  Never raises
+        on a bad artifact — refusing is this method's job."""
+        with self._lock:
+            self.attempts += 1
+            self.last_path = path
+            if obs_gate.enabled():
+                self.registry.inc("online_swap_attempts_total")
+            try:
+                from lightctr_tpu.models.export import load_compressed_npz
+
+                cand_params, meta = load_compressed_npz(path)
+                if meta.get("model") != self.model.kind:
+                    return self._refuse(
+                        path, "kind",
+                        got=meta.get("model"), want=self.model.kind,
+                    )
+                cand = ServingModel(
+                    self.model.kind, cand_params,
+                    row_leaves=self.model.row_leaves,
+                    row_dim=self.model.row_dim,
+                    id_fields=self.model.id_fields,
+                )
+            except Exception as e:  # torn npz surfaces as zlib/OS/Value
+                # errors depending on where the truncation lands — ANY
+                # load failure is a refusal, never a serving crash
+                return self._refuse(path, "load", error=repr(e))
+            worst = 0.0
+            try:
+                for arrays, uids, rows in self._replay:
+                    old = self._score(self.model, arrays, uids, rows)
+                    new = self._score(cand, arrays, uids, rows)
+                    if not np.all(np.isfinite(new)):
+                        return self._refuse(path, "nonfinite")
+                    worst = max(worst, float(np.abs(new - old).max()))
+            except Exception as e:
+                return self._refuse(path, "score", error=repr(e))
+            self.last_diff = worst
+            if obs_gate.enabled():
+                self.registry.gauge_set("online_swap_shadow_diff", worst)
+            # NaN in OLD scores would make `worst` NaN, and `NaN > tol`
+            # is False — compare through isfinite so nothing slips past
+            if not np.isfinite(worst) or worst > self.tolerance:
+                return self._refuse(path, "parity", max_abs_diff=worst)
+            version = self.model.swap_params(cand.params)
+            self.accepted += 1
+            if obs_gate.enabled():
+                self.registry.inc("online_swap_accepted_total")
+            events_mod.emit("model_swap", path=path, accepted=True,
+                            version=version, max_abs_diff=worst)
+            _LOG.info("model swap accepted: %s (v%d, max|d|=%.2e)",
+                      path, version, worst)
+            return True
+
+    def _refuse(self, path: str, reason: str, **detail) -> bool:
+        self.refusals[reason] = self.refusals.get(reason, 0) + 1
+        if obs_gate.enabled():
+            self.registry.inc(labeled(
+                "online_swap_refused_total", reason=reason,
+            ))
+        events_mod.emit("model_swap", path=path, accepted=False,
+                        reason=reason, **detail)
+        _LOG.warning("model swap REFUSED (%s): %s %s", reason, path, detail)
+        return False
+
+    # -- export-dir watcher --------------------------------------------------
+
+    def watch(self, export_dir: str, poll_s: float = 0.5) -> None:
+        """Poll ``export_dir``'s ``LATEST`` pointer on a daemon thread and
+        offer every new artifact to the gate."""
+        if self._watch_thread is not None:
+            raise RuntimeError("already watching")
+        self._watch_stop.clear()
+
+        def loop():
+            offered = None
+            while not self._watch_stop.is_set():
+                path = read_latest(export_dir)
+                if path is not None and path != offered:
+                    offered = path
+                    self.offer(path)
+                self._watch_stop.wait(poll_s)
+
+        self._watch_thread = threading.Thread(
+            target=loop, daemon=True, name="swap-watcher",
+        )
+        self._watch_thread.start()
+
+    def stop_watch(self) -> None:
+        if self._watch_thread is None:
+            return
+        self._watch_stop.set()
+        self._watch_thread.join(timeout=5.0)
+        self._watch_thread = None
+
+    close = stop_watch
+
+    # -- reads ---------------------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "attempts": self.attempts,
+                "accepted": self.accepted,
+                "refusals": dict(self.refusals),
+                "last_diff": self.last_diff,
+                "last_path": self.last_path,
+                "model_version": self.model.version,
+                "tolerance": self.tolerance,
+            }

@@ -1,0 +1,323 @@
+"""The continuous train side of the online plane: stream -> pull -> grad
+-> push, forever.
+
+The reference's ``Distributed_Algo_Abst`` Wide&Deep worker is exactly this
+loop (PAPER.md: pull the touched rows, one gradient step, push — never
+stop); :class:`OnlineTrainer` is its repo-native form over the socket PS
+(docs/ONLINE.md):
+
+  - the batch stream is any iterator of padded libFFM batch dicts —
+    normally ``data.streaming.iter_libffm_batches(loop=True)`` (infinite
+    epochs with per-epoch reshuffle) or ``follow=True`` (tail a growing
+    file), so training runs indefinitely;
+  - the SPARSE half lives in PS rows (the fused ``[w | v]`` /
+    ``[w | embed]`` layout serving already reads — ``serve.fm_ps_row_leaves``),
+    updated server-side by the store's Adagrad: the
+    :class:`~lightctr_tpu.serve.server.PredictionServer` scores from the
+    SAME live rows, and every push lands in the write log the freshness
+    subscribers ride;
+  - the DENSE half (Wide&Deep's MLP) is worker-local (Parallax's split),
+    updated with local Adagrad and periodically EXPORTED as a compressed
+    artifact (:func:`lightctr_tpu.online.swap.publish_export`) for the
+    serving side's shadow-gated hot-swap.
+
+Gradients are computed on the padded unique-row block (the soak recipe,
+``tools/criteo_ps_soak.py``): id streams pad to a fixed width so the jit
+cache holds one program, pad slots alias the last real row but are never
+indexed by a batch position, so their gradient is exactly zero and the
+push ships only real rows.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from lightctr_tpu.obs import events as events_mod
+from lightctr_tpu.obs import gate as obs_gate
+
+_LOG = logging.getLogger(__name__)
+
+
+def _stop_requested(stop) -> bool:
+    if stop is None:
+        return False
+    if hasattr(stop, "is_set"):
+        return bool(stop.is_set())
+    return bool(stop())
+
+
+class OnlineTrainer:
+    """Indefinite pull->grad->push loop against a live PS.
+
+    ``ps``: a :class:`~lightctr_tpu.dist.ps_server.PSClient` /
+    ``ShardedPSClient`` (row dim must be ``1 + factor_dim``).  ``kind``:
+    ``"fm"`` (fully PS-row-resident) or ``"widedeep"`` (PS rows + local
+    dense MLP; requires ``field_cnt`` and ``dense_params`` holding the
+    ``fc1``/``fc2`` leaves, e.g. from ``widedeep.init``).  ``export_dir``
+    + ``export_every``: publish the dense half every N steps (widedeep
+    only) through the atomic LATEST-pointer protocol the serving-side
+    :class:`~lightctr_tpu.online.swap.ModelSwapper` watches.
+    """
+
+    def __init__(
+        self,
+        ps,
+        kind: str,
+        factor_dim: int,
+        field_cnt: Optional[int] = None,
+        dense_params: Optional[Dict] = None,
+        dense_lr: float = 0.05,
+        eps: float = 1e-7,
+        worker_id: int = 0,
+        export_dir: Optional[str] = None,
+        export_every: int = 0,
+        export_codec: str = "int8",
+        registry=None,
+    ):
+        from lightctr_tpu.obs.registry import default_registry
+
+        if kind not in ("fm", "widedeep"):
+            raise ValueError(f"unknown online trainer kind {kind!r}")
+        if kind == "widedeep":
+            if field_cnt is None or dense_params is None:
+                raise ValueError(
+                    "widedeep needs field_cnt and dense_params (fc1/fc2)"
+                )
+            self.dense = {
+                k: dict(v) for k, v in dense_params.items()
+            }
+            self._dense_acc = {
+                k: {kk: np.zeros_like(np.asarray(vv, np.float32))
+                    for kk, vv in v.items()}
+                for k, v in self.dense.items()
+            }
+        elif export_every:
+            raise ValueError("fm has no dense half to export")
+        self.ps = ps
+        self.kind = kind
+        self.factor_dim = int(factor_dim)
+        self.row_dim = 1 + self.factor_dim
+        self.field_cnt = None if field_cnt is None else int(field_cnt)
+        self.dense_lr = float(dense_lr)
+        self.eps = float(eps)
+        self.worker_id = int(worker_id)
+        self.export_dir = export_dir
+        self.export_every = int(export_every)
+        self.export_codec = export_codec
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.steps = 0
+        self.examples = 0
+        self.exports = 0
+        self.push_failures = 0
+        self.last_loss: Optional[float] = None
+        self._grads_fn = None  # built lazily (jax import at step time)
+
+    # -- jitted gradient programs -------------------------------------------
+
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from lightctr_tpu.ops import losses as losses_lib
+
+        if self.kind == "fm":
+            from lightctr_tpu.models import fm
+
+            def fm_loss(rows, batch):
+                params = {"w": rows[:, 0], "v": rows[:, 1:]}
+                z = fm.logits(params, batch)
+                return losses_lib.logistic_loss(
+                    z, batch["labels"], reduction="mean"
+                )
+
+            self._grads_fn = jax.jit(jax.value_and_grad(fm_loss))
+        else:
+            from lightctr_tpu.models import widedeep
+
+            def wd_loss(w_rows, e_rows, fc1, fc2, batch):
+                params = {"w": w_rows, "embed": e_rows,
+                          "fc1": fc1, "fc2": fc2}
+                z = widedeep.logits(params, batch)
+                return losses_lib.logistic_loss(
+                    z, batch["labels"], reduction="mean"
+                )
+
+            self._grads_fn = jax.jit(
+                jax.value_and_grad(wd_loss, argnums=(0, 1, 2, 3))
+            )
+        self._jnp = jnp
+
+    # -- SSP pull with retry -------------------------------------------------
+
+    def _pull(self, keys: np.ndarray, stop=None) -> Optional[np.ndarray]:
+        while True:
+            out = self.ps.pull_arrays(
+                keys, worker_epoch=self.steps, worker_id=self.worker_id
+            )
+            if out is not None:
+                return out[1]
+            if _stop_requested(stop):
+                return None
+            time.sleep(0.005)  # SSP-withheld: retry (pull.h:63-67)
+
+    # -- one step ------------------------------------------------------------
+
+    def step(self, mb: Dict[str, np.ndarray], stop=None) -> Optional[float]:
+        """One pull->grad->push step over a FULL padded batch (stream the
+        loop with ``drop_remainder=True`` — the loop/follow modes only
+        yield full batches).  Returns the loss, or None when a stop
+        request interrupted the SSP retry."""
+        if self._grads_fn is None:
+            self._build()
+        jnp = self._jnp
+        fids = np.asarray(mb["fids"])
+        b, p = fids.shape
+        if self.kind == "fm":
+            u = np.unique(fids.reshape(-1).astype(np.int64))
+            rows = self._pull(u, stop)
+            if rows is None:
+                return None
+            cap = b * p
+            u_pad = np.pad(u, (0, cap - len(u)), mode="edge")
+            gathered = rows[np.searchsorted(u, u_pad)]
+            batch = {
+                "fids": np.searchsorted(u, fids).astype(np.int32),
+                "vals": mb["vals"], "mask": mb["mask"],
+                "labels": mb["labels"],
+            }
+            loss, g = self._grads_fn(
+                jnp.asarray(gathered),
+                {k: jnp.asarray(v) for k, v in batch.items()},
+            )
+            ok = self.ps.push_arrays(
+                self.worker_id, u, np.asarray(g)[: len(u)],
+                worker_epoch=self.steps,
+            )
+        else:
+            from lightctr_tpu.models.widedeep import field_representatives
+
+            rep, rep_mask = field_representatives(
+                fids, np.asarray(mb["fields"]), np.asarray(mb["mask"]),
+                self.field_cnt,
+            )
+            uw = np.unique(fids.reshape(-1).astype(np.int64))
+            ue = np.unique(rep.reshape(-1).astype(np.int64))
+            keys = np.union1d(uw, ue)
+            rows = self._pull(keys, stop)
+            if rows is None:
+                return None
+            cap_w, cap_e = b * p, b * self.field_cnt
+            iw = np.searchsorted(
+                keys, np.pad(uw, (0, cap_w - len(uw)), mode="edge"))
+            ie = np.searchsorted(
+                keys, np.pad(ue, (0, cap_e - len(ue)), mode="edge"))
+            batch = {
+                "fids": np.searchsorted(uw, fids).astype(np.int32),
+                "rep_fids": np.searchsorted(ue, rep).astype(np.int32),
+                "vals": mb["vals"], "mask": mb["mask"],
+                "rep_mask": rep_mask, "labels": mb["labels"],
+            }
+            loss, (g_w, g_e, g_fc1, g_fc2) = self._grads_fn(
+                jnp.asarray(rows[iw, 0]), jnp.asarray(rows[ie, 1:]),
+                {k: jnp.asarray(v) for k, v in self.dense["fc1"].items()},
+                {k: jnp.asarray(v) for k, v in self.dense["fc2"].items()},
+                {k: jnp.asarray(v) for k, v in batch.items()},
+            )
+            G = np.zeros((len(keys), self.row_dim), np.float32)
+            G[iw[: len(uw)], 0] = np.asarray(g_w)[: len(uw)]
+            G[ie[: len(ue)], 1:] = np.asarray(g_e)[: len(ue)]
+            ok = self.ps.push_arrays(
+                self.worker_id, keys, G, worker_epoch=self.steps,
+            )
+            self._apply_dense({"fc1": g_fc1, "fc2": g_fc2})
+        loss = float(loss)
+        self.steps += 1
+        self.examples += int(mb.get("row_mask", np.ones(b)).sum())
+        self.last_loss = loss
+        if not ok:
+            # a dropped/partial push is the reference's lossy-async
+            # semantics, not a crash — but it must be visible
+            self.push_failures += 1
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("online_steps_total")
+            reg.inc("online_examples_total",
+                    int(mb.get("row_mask", np.ones(b)).sum()))
+            reg.gauge_set("online_loss", loss)
+            if not ok:
+                reg.inc("online_push_failures_total")
+        if (self.export_every and self.export_dir
+                and self.steps % self.export_every == 0):
+            self.export()
+        return loss
+
+    def _apply_dense(self, grads: Dict) -> None:
+        """Local Adagrad over the dense tree (the worker owns its MLP —
+        the Parallax split's dense side)."""
+        for leaf, g_tree in grads.items():
+            for k, g in g_tree.items():
+                g = np.asarray(g, np.float32)
+                acc = self._dense_acc[leaf][k]
+                acc += g * g
+                w = np.asarray(self.dense[leaf][k], np.float32)
+                self.dense[leaf][k] = w - self.dense_lr * g / np.sqrt(
+                    acc + self.eps
+                )
+
+    # -- dense export --------------------------------------------------------
+
+    def export(self) -> Optional[str]:
+        """Publish the dense half now (widedeep only).  Never raises —
+        a full disk must not stop training; the failure is logged and
+        the LATEST pointer keeps naming the previous good artifact."""
+        if self.kind != "widedeep" or not self.export_dir:
+            return None
+        from lightctr_tpu.online.swap import publish_export
+
+        t0 = time.perf_counter()
+        try:
+            path = publish_export(
+                self.export_dir, dict(self.dense), model=self.kind,
+                step=self.steps, codec=self.export_codec,
+            )
+        except OSError:
+            _LOG.warning("dense export failed; continuing", exc_info=True)
+            return None
+        self.exports += 1
+        if obs_gate.enabled():
+            self.registry.inc("online_exports_total")
+            self.registry.observe("online_export_seconds",
+                                  time.perf_counter() - t0)
+        events_mod.emit("online_export", step=self.steps, path=path)
+        return path
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, stream: Iterable[Dict], max_steps: Optional[int] = None,
+            stop=None) -> int:
+        """Drain ``stream`` (typically infinite — loop/follow mode) until
+        it ends, ``stop`` is requested, or ``max_steps`` land.  Returns
+        the step count."""
+        for mb in stream:
+            if _stop_requested(stop):
+                break
+            if self.step(mb, stop=stop) is None:
+                break
+            if max_steps is not None and self.steps >= max_steps:
+                break
+        return self.steps
+
+    def stats(self) -> Dict:
+        return {
+            "kind": self.kind,
+            "steps": self.steps,
+            "examples": self.examples,
+            "exports": self.exports,
+            "push_failures": self.push_failures,
+            "last_loss": self.last_loss,
+        }

@@ -135,6 +135,9 @@ class ServingModel:
                 )
         self.row_dim = row_dim
         self.id_fields = tuple(id_fields or _ID_FIELDS[kind])
+        # hot-swap generation: bumped by every swap_params flip so the
+        # online plane (and its tests) can see which model is live
+        self.version = 0
 
         def _score_local(params, batch):
             return sigmoid(self.logits_fn(params, batch))
@@ -148,6 +151,32 @@ class ServingModel:
 
         self._jit_local = jax.jit(_score_local)
         self._jit_rows = jax.jit(_score_rows)
+
+    # -- dense hot-swap ------------------------------------------------------
+
+    def swap_params(self, params: Dict) -> int:
+        """Atomically flip the LOCAL (dense) leaves to ``params`` — the
+        online plane's model hot-swap (docs/ONLINE.md).  The scorer passes
+        ``self.params`` into the jitted call once per micro-batch, so the
+        single reference assignment lands BETWEEN batches, never inside
+        one; PS-row-backed leaves are untouched (they stay live rows).
+        The leaf set must match the current one — structural changes are
+        a redeploy, not a swap.  Callers gate this behind the
+        shadow-scoring parity check (:class:`lightctr_tpu.online.swap.
+        ModelSwapper`); returns the new model version."""
+        prepared = {
+            k: jnp.asarray(v) if not isinstance(v, dict) else
+            jax.tree_util.tree_map(jnp.asarray, v)
+            for k, v in params.items()
+        }
+        if set(prepared) != set(self.params):
+            raise ValueError(
+                f"swap changes the leaf set {sorted(self.params)} -> "
+                f"{sorted(prepared)} (structural change; redeploy instead)"
+            )
+        self.params = prepared
+        self.version += 1
+        return self.version
 
     # -- shape plumbing ------------------------------------------------------
 

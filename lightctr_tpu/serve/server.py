@@ -557,9 +557,12 @@ class PredictionServer:
             if (v_new < v_old or not wd
                     or v_old < int(wd.get("floor", 1 << 62))):
                 return self.cache.set_version(version)  # not covered
-            for ver, uids in wd.get("entries", ()):
-                if int(ver) > v_old:
-                    changed.extend(uids)
+            for entry in wd.get("entries", ()):
+                # [version, uids] or [version, uids, write-ts] — the log
+                # grew a wall timestamp for the freshness plane; this
+                # poll-path consumer needs only the first two fields
+                if int(entry[0]) > v_old:
+                    changed.extend(entry[1])
         self.cache.apply_delta(version, changed)
         return True
 

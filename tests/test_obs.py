@@ -560,28 +560,30 @@ def test_every_ps_wire_op_has_a_latency_series_name():
     from lightctr_tpu.dist import wire
     assert all(v < wire.TRACE_FLAG for v in ops.values())
 
-    # the serving plane (serve/) rides the same framing and telemetry
-    # block: any MSG_* constant DEFINED there (rather than imported from
-    # ps_server, the canonical op registry) would dodge the vars() scan
-    # above — lint the ASTs so a serve-side op can't ship dark either
-    serve_root = LIB_ROOT / "serve"
+    # the serving plane (serve/) and the online plane (online/) ride the
+    # same framing and telemetry block: any MSG_* constant DEFINED there
+    # (rather than imported from ps_server, the canonical op registry)
+    # would dodge the vars() scan above — lint the ASTs so a wire op
+    # assigned in either package can't ship dark either
     rogue = []
-    for path in sorted(serve_root.glob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and node.targets[0].id.startswith("MSG_")
-                    and isinstance(node.value, ast.Constant)
-                    and isinstance(node.value.value, int)):
-                continue
-            if node.value.value not in ps_server._OP_NAMES:
-                rogue.append(
-                    f"{path.name}:{node.lineno} {node.targets[0].id}"
-                )
+    for pkg in ("serve", "online"):
+        for path in sorted((LIB_ROOT / pkg).glob("*.py")):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id.startswith("MSG_")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)):
+                    continue
+                if node.value.value not in ps_server._OP_NAMES:
+                    rogue.append(
+                        f"{pkg}/{path.name}:{node.lineno} "
+                        f"{node.targets[0].id}"
+                    )
     assert not rogue, (
-        "serve/ defines MSG_* ops missing from ps_server._OP_NAMES "
+        "serve//online/ define MSG_* ops missing from ps_server._OP_NAMES "
         "(latency series would record as op=\"unknown\"): "
         + ", ".join(rogue)
     )
@@ -921,3 +923,115 @@ def test_metrics_report_exchange_section(tmp_path, capsys):
     assert report["rs_fallback_steps"] == 1
     assert report["hier_active"] is True
     assert report["hier_local_to_wire_x"] == 4.0
+
+
+# -- online plane telemetry lints + report (ISSUE 11) ------------------------
+
+
+def test_every_online_series_is_declared_and_emitted():
+    """No dark online counters: every ``online_*`` / ``serve_freshness_*``
+    metric the online plane EMITS (a literal first argument of a registry
+    ``inc``/``gauge_set``/``observe`` call, directly or through
+    ``labeled(...)``) — across every module of ``lightctr_tpu/online/`` —
+    must be declared in ``online.ONLINE_SERIES``, and every declared
+    series must actually be emitted.  A freshness gauge or swap counter
+    can therefore never ship unregistered or go stale."""
+    from lightctr_tpu import online
+
+    emitted = set()
+    for path in sorted((LIB_ROOT / "online").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("inc", "gauge_set", "observe")
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Call) and arg.args and (
+                    (isinstance(arg.func, ast.Name)
+                     and arg.func.id == "labeled")
+                    or (isinstance(arg.func, ast.Attribute)
+                        and arg.func.attr == "labeled")):
+                arg = arg.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and (arg.value.startswith("online_")
+                         or arg.value.startswith("serve_freshness_")):
+                emitted.add(arg.value)
+
+    declared = set(online.ONLINE_SERIES)
+    assert emitted, "no online emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "online series emitted but missing from ONLINE_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "ONLINE_SERIES declares series the plane never emits "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(online.ONLINE_SERIES) == len(declared), \
+        "duplicate names in ONLINE_SERIES"
+
+
+def test_metrics_report_online_section(tmp_path, capsys):
+    """--online parses the freshness / swap / trainer series out of a
+    registry snapshot: deltas applied vs dropped-to-full-refresh (by
+    reason), apply-age percentiles, swap attempts/refusals, trainer
+    step+export counters — the golden shape the online dashboards read."""
+    import tools.metrics_report as metrics_report
+
+    reg = obs.MetricsRegistry()
+    reg.inc("serve_freshness_polls_total", 20)
+    reg.inc("serve_freshness_deltas_applied_total", 12)
+    reg.inc("serve_freshness_rows_dropped_total", 34)
+    reg.inc(obs.labeled("serve_freshness_full_refresh_total",
+                        reason="floor"), 2)
+    reg.inc(obs.labeled("serve_freshness_full_refresh_total",
+                        reason="down"), 1)
+    reg.gauge_set("serve_freshness_age_seconds", 0.25)
+    for age in (0.01, 0.02, 0.4):
+        reg.observe("serve_freshness_apply_age_seconds", age)
+    reg.inc("online_swap_attempts_total", 3)
+    reg.inc("online_swap_accepted_total", 1)
+    reg.inc(obs.labeled("online_swap_refused_total", reason="parity"), 1)
+    reg.inc(obs.labeled("online_swap_refused_total", reason="load"), 1)
+    reg.gauge_set("online_swap_shadow_diff", 0.8)
+    reg.inc("online_steps_total", 100)
+    reg.inc("online_examples_total", 6400)
+    reg.inc("online_exports_total", 5)
+    reg.gauge_set("online_loss", 0.31)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(reg.snapshot()))
+    assert metrics_report.main(["--online", str(path)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    fresh = report["freshness"]
+    assert fresh["polls"] == 20
+    assert fresh["deltas_applied"] == 12
+    assert fresh["rows_dropped"] == 34
+    assert fresh["full_refreshes"] == {
+        "total": 3, "by_reason": {"floor": 2, "down": 1}}
+    assert fresh["age_s"] == 0.25
+    assert fresh["apply_age"]["count"] == 3
+    assert fresh["apply_age"]["p99_ms"] > fresh["apply_age"]["p50_ms"]
+    swap = report["swap"]
+    assert swap["attempts"] == 3 and swap["accepted"] == 1
+    assert swap["refused"] == {
+        "total": 2, "by_reason": {"parity": 1, "load": 1}}
+    assert swap["last_shadow_diff"] == 0.8
+    trainer = report["trainer"]
+    assert trainer["steps"] == 100 and trainer["examples"] == 6400
+    assert trainer["exports"] == 5 and trainer["last_loss"] == 0.31
+
+    # a trainer-only snapshot (no freshness/swap series at all) must
+    # omit those sections entirely, not render them zeroed
+    reg2 = obs.MetricsRegistry()
+    reg2.inc("online_steps_total", 3)
+    path2 = tmp_path / "snap2.json"
+    path2.write_text(json.dumps(reg2.snapshot()))
+    assert metrics_report.main(["--online", str(path2)]) == 0
+    report2 = json.loads(capsys.readouterr().out)
+    assert "freshness" not in report2 and "swap" not in report2
+    assert report2["trainer"]["steps"] == 3

@@ -1,0 +1,666 @@
+"""Online learning plane (ISSUE 11): write-log subscription wire op,
+push-based freshness into the serving cache, the shadow-gated dense-model
+hot-swap, the continuous trainer, and the tier-1 multi-process
+train-and-serve acceptance with a freshness SLO."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from lightctr_tpu import obs, online, serve
+from lightctr_tpu.data.streaming import iter_libffm_batches
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.models import fm, widedeep
+from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.ops.activations import sigmoid
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+F, K = 256, 8
+ROW_DIM = 1 + K
+
+
+def _fm_forward(params, batch):
+    import jax.numpy as jnp
+
+    b = {
+        "fids": jnp.asarray(batch["fids"]),
+        "vals": jnp.asarray(batch["vals"]),
+        "mask": jnp.ones_like(jnp.asarray(batch["vals"])),
+    }
+    return np.asarray(sigmoid(fm.logits(params, b)))
+
+
+def _batch(rng, n=4, nnz=4):
+    return {
+        "fids": rng.integers(1, F, size=(n, nnz)).astype(np.int32),
+        "vals": np.ones((n, nnz), np.float32),
+    }
+
+
+def _write_fm_stream(path, rng, rows=512, nnz=4):
+    """A learnable synthetic libFFM stream: labels follow a logistic in a
+    fixed per-fid weight, so PS-trained rows provably move."""
+    w_true = rng.normal(size=F)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            fids = rng.integers(1, F, size=nnz)
+            z = w_true[fids].sum()
+            y = int(1.0 / (1.0 + np.exp(-z)) > rng.random())
+            f.write(f"{y} " + " ".join(f"0:{d}:1.0" for d in fids) + "\n")
+
+
+def _wait(cond, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- the wire op -------------------------------------------------------------
+
+
+def test_subscribe_long_polls_and_returns_stamped_deltas():
+    """MSG_SUBSCRIBE blocks until write_version moves, then returns the
+    log entries past the subscriber's version — uids AND the server-side
+    write wall time (the freshness measurement's clock)."""
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    cli = PSClient(svc.address, ROW_DIM, timeout=10.0)
+    try:
+        rep = cli.subscribe_deltas(1 << 62, timeout_ms=0)  # arm: no wait
+        assert rep["covered"] and rep["entries"] == []
+        since = rep["write_version"]
+        t_before = time.time()
+        cli.push_arrays(0, np.array([7, 9], np.int64),
+                        np.ones((2, ROW_DIM), np.float32), worker_epoch=0)
+        rep = cli.subscribe_deltas(since, timeout_ms=2000)
+        assert rep["covered"]
+        (ver, uids, ts), = [e for e in rep["entries"] if e[0] > since]
+        assert uids == [7, 9]
+        assert t_before - 1.0 <= ts <= time.time() + 1.0
+        assert rep["write_version"] == ver == since + 1
+
+        # an idle long-poll times out server-side and reports no news
+        t0 = time.monotonic()
+        rep = cli.subscribe_deltas(rep["write_version"], timeout_ms=200)
+        assert rep["entries"] == []
+        assert time.monotonic() - t0 >= 0.15
+    finally:
+        cli.close()
+        svc.close()
+
+
+def test_subscribe_floor_overflow_reports_uncovered():
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    store.WRITE_LOG_MAX_ENTRIES = 1
+    svc = ParamServerService(store)
+    cli = PSClient(svc.address, ROW_DIM, timeout=10.0)
+    try:
+        since = cli.subscribe_deltas(1 << 62, timeout_ms=0)["write_version"]
+        for i in range(3):
+            cli.push_arrays(0, np.array([i + 1], np.int64),
+                            np.ones((1, ROW_DIM), np.float32),
+                            worker_epoch=0)
+        rep = cli.subscribe_deltas(since, timeout_ms=1000)
+        assert not rep["covered"]  # floor advanced past the observation
+        assert rep["floor"] > since and rep["entries"] == []
+    finally:
+        cli.close()
+        svc.close()
+
+
+# -- the freshness subscriber ------------------------------------------------
+
+
+def _ps_backed_server(svc):
+    return serve.PredictionServer(
+        serve.ServingModel("fm", {},
+                           row_leaves=serve.fm_ps_row_leaves(K),
+                           row_dim=ROW_DIM),
+        ps=PSClient(svc.address, ROW_DIM), max_batch=16, max_wait_us=100,
+        queue_cap=64, deadline_ms=5000, cache_capacity=F,
+    )
+
+
+def test_subscriber_drives_per_key_invalidation_and_feeds_slo(rng):
+    """The push path: one trained key costs exactly one cached row (no
+    version polling configured at all), and every round feeds the
+    FreshnessSLODetector on the server's monitor."""
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = _ps_backed_server(svc)
+    assert srv.version_poll_s == 0.0
+    sub = online.FreshnessSubscriber(
+        srv, [svc.address], ROW_DIM, slo_s=30.0, poll_ms=300,
+    ).start()
+    cli = None
+    try:
+        _wait(lambda: sub.stats()["versions"][0] >= 0, 5, "subscriber arm")
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=4)
+        cli.predict(b)
+        n0 = len(srv.cache)
+        assert n0 > 1
+        victim = int(np.unique(b["fids"])[0])
+        admin.push_arrays(0, np.array([victim], np.int64),
+                          np.zeros((1, ROW_DIM), np.float32),
+                          worker_epoch=0)
+        _wait(lambda: len(srv.cache) == n0 - 1, 5, "push-based delta drop")
+        st = sub.stats()
+        assert st["applied_entries"] == 1 and st["dropped_rows"] == 1
+        assert st["full_refreshes"] == 0
+        assert srv.cache.stats()["invalidations"] == 0
+        # the freshness measurement reached the health plane
+        det = srv.health.verdict()["detectors"]["freshness_slo"]
+        assert det["checks"] > 0 and det["status"] == health_mod.OK
+        assert sub.age_s() is not None
+        counters = srv.registry.snapshot()["counters"]
+        assert counters["serve_freshness_deltas_applied_total"] == 1
+        assert counters["serve_freshness_rows_dropped_total"] == 1
+
+        # floor overflow: the subscriber falls off the log -> FULL drop,
+        # counted under reason="floor" — degrade preserved, never staleness
+        cli.predict(b)
+        store.WRITE_LOG_MAX_ENTRIES = 0
+        store.WRITE_LOG_MAX_UIDS = 0
+        admin.push_arrays(0, np.array([victim], np.int64),
+                          np.zeros((1, ROW_DIM), np.float32),
+                          worker_epoch=0)
+        _wait(lambda: sub.stats()["full_refreshes"] == 1, 5,
+              "floor-overflow full refresh")
+        assert len(srv.cache) == 0
+        counters = srv.registry.snapshot()["counters"]
+        assert counters[obs.labeled("serve_freshness_full_refresh_total",
+                                    reason="floor")] == 1
+    finally:
+        if cli is not None:
+            cli.close()
+        sub.stop()
+        srv.close()
+        admin.close()
+        svc.close()
+
+
+def test_subscriber_degrades_to_stats_polling_without_the_surface(rng):
+    """A store without ``wait_write_delta`` (today's tiered store)
+    answers the protocol-error byte: the subscriber must flip that shard
+    to MSG_STATS polling and keep invalidating off the same write_delta
+    record — freshness degrades to poll cadence, correctness holds."""
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    store.wait_write_delta = None  # shadow the surface away
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = _ps_backed_server(svc)
+    sub = online.FreshnessSubscriber(
+        srv, [svc.address], ROW_DIM, slo_s=30.0, poll_ms=300,
+        degraded_poll_s=0.05,
+    ).start()
+    cli = None
+    try:
+        _wait(lambda: sub.stats()["modes"][0] == "stats_poll", 5,
+              "degrade to stats polling")
+        _wait(lambda: sub.stats()["versions"][0] >= 0, 5, "poll-mode arm")
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=4)
+        cli.predict(b)
+        n0 = len(srv.cache)
+        victim = int(np.unique(b["fids"])[0])
+        admin.push_arrays(0, np.array([victim], np.int64),
+                          np.zeros((1, ROW_DIM), np.float32),
+                          worker_epoch=0)
+        _wait(lambda: len(srv.cache) == n0 - 1, 5, "poll-mode delta drop")
+        assert sub.stats()["applied_entries"] >= 1
+
+        # the poll fallback must ALSO honor the log floor: a burst past
+        # the bounded log between polls would otherwise silently lose
+        # invalidations (stale rows forever) — it must full-drop instead
+        cli.predict(b)
+        assert len(srv.cache) > 0
+        store.WRITE_LOG_MAX_ENTRIES = 0
+        store.WRITE_LOG_MAX_UIDS = 0
+        admin.push_arrays(0, np.array([victim], np.int64),
+                          np.zeros((1, ROW_DIM), np.float32),
+                          worker_epoch=0)
+        _wait(lambda: sub.stats()["full_refreshes"] >= 1, 5,
+              "poll-mode floor-overrun full refresh")
+        assert len(srv.cache) == 0
+    finally:
+        if cli is not None:
+            cli.close()
+        sub.stop()
+        srv.close()
+        admin.close()
+        svc.close()
+
+
+# -- the swap gate -----------------------------------------------------------
+
+
+def _wd_replay(rng, n=2):
+    return [{
+        "fids": rng.integers(1, F, size=(4, 3)).astype(np.int32),
+        "vals": np.ones((4, 3), np.float32),
+        "rep_fids": rng.integers(1, F, size=(4, 3)).astype(np.int32),
+        "rep_mask": np.ones((4, 3), np.float32),
+    } for _ in range(n)]
+
+
+def test_swapper_accepts_parity_and_refuses_corruption(tmp_path, rng):
+    """The shadow-scoring gate: an export of the live weights (through
+    the lossy int8 codec) swaps in and the model version advances; a
+    corrupted export — wrong scores, NaN weights, torn file, wrong
+    kind — is refused with the reason counted and the live model
+    untouched."""
+    params = widedeep.init(jax.random.PRNGKey(7), F, field_cnt=3,
+                           factor_dim=4)
+    model = serve.ServingModel("widedeep", params)
+    replay = _wd_replay(rng)
+    before = [model.score(r) for r in replay]
+    reg = obs.MetricsRegistry()
+    sw = online.ModelSwapper(model, replay, tolerance=5e-3, registry=reg)
+    d = str(tmp_path)
+    np_params = {k: (np.asarray(v) if not isinstance(v, dict)
+                     else {kk: np.asarray(vv) for kk, vv in v.items()})
+                 for k, v in params.items()}
+
+    good = online.publish_export(d, np_params, model="widedeep", step=1)
+    assert sw.offer(good) is True
+    assert model.version == 1
+    for r, s in zip(replay, before):
+        np.testing.assert_allclose(model.score(r), s, atol=5e-3)
+
+    bad = dict(np_params)
+    bad["fc1"] = {"w": np_params["fc1"]["w"] + 3.0,
+                  "b": np_params["fc1"]["b"]}
+    assert sw.offer(online.publish_export(d, bad, model="widedeep",
+                                          step=2)) is False
+    nan = dict(np_params)
+    nan["fc2"] = {"w": np.full_like(np_params["fc2"]["w"], np.nan),
+                  "b": np_params["fc2"]["b"]}
+    assert sw.offer(online.publish_export(d, nan, model="widedeep",
+                                          step=3, codec="fp32")) is False
+    torn = os.path.join(d, "torn.npz")
+    with open(torn, "wb") as f:
+        f.write(b"\x00" * 64)
+    assert sw.offer(torn) is False
+    wrong = online.publish_export(d, {"w": np.zeros(4, np.float32)},
+                                  model="fm", step=4)
+    assert sw.offer(wrong) is False
+
+    st = sw.stats()
+    assert st["attempts"] == 5 and st["accepted"] == 1
+    assert st["refusals"] == {"parity": 1, "nonfinite": 1, "load": 1,
+                              "kind": 1}
+    assert model.version == 1  # nothing after the good swap landed
+    counters = reg.snapshot()["counters"]
+    assert counters["online_swap_attempts_total"] == 5
+    assert counters["online_swap_accepted_total"] == 1
+    assert counters[obs.labeled("online_swap_refused_total",
+                                reason="parity")] == 1
+
+
+def test_swap_params_is_structural_and_bumps_version():
+    params = fm.init(jax.random.PRNGKey(0), F, K)
+    model = serve.ServingModel("fm", params)
+    with pytest.raises(ValueError, match="structural"):
+        model.swap_params({"w": np.zeros(F, np.float32)})
+    v = model.swap_params({"w": np.zeros(F, np.float32),
+                           "v": np.asarray(params["v"])})
+    assert v == model.version == 1
+
+
+# -- the continuous trainer --------------------------------------------------
+
+
+def test_online_trainer_fm_learns_the_live_rows(tmp_path, rng):
+    """The stream->pull->grad->push loop against a live socket PS: loss
+    falls, the PS rows move, and the loop-mode stream wraps epochs
+    without intervention."""
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows0 = serve.fused_fm_rows(params)
+    admin.preload_arrays(keys, rows0)
+    p = str(tmp_path / "train.ffm")
+    _write_fm_stream(p, rng, rows=512)
+    reg = obs.MetricsRegistry()
+    tr = online.OnlineTrainer(admin, "fm", K, worker_id=0, registry=reg)
+    losses = []
+    try:
+        stream = iter_libffm_batches(p, 64, 4, loop=True)
+        for mb in stream:
+            losses.append(tr.step(mb))
+            if tr.steps >= 24:  # 3 wrapped epochs
+                break
+        assert tr.steps == 24
+        assert np.mean(losses[-4:]) < np.mean(losses[:4]) - 0.05
+        _, rows1 = admin.snapshot_arrays()
+        assert np.abs(rows1 - rows0).max() > 1e-3
+        counters = reg.snapshot()["counters"]
+        assert counters["online_steps_total"] == 24
+        assert counters["online_examples_total"] == 24 * 64
+    finally:
+        admin.close()
+        svc.close()
+
+
+def test_online_trainer_widedeep_exports_and_watcher_swaps(tmp_path, rng):
+    """The full dense hand-off: the widedeep trainer exports its local
+    MLP every N steps through the atomic LATEST pointer; a watcher-driven
+    swapper on a serving model picks the artifact up and (within a
+    drift-sized tolerance) flips it in."""
+    FL = 4
+    wparams = widedeep.init(jax.random.PRNGKey(3), F, FL, K, hidden=16)
+    keys, rows = serve.fused_fm_rows(
+        {"w": wparams["w"], "v": wparams["embed"]})
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    p = str(tmp_path / "wd.ffm")
+    with open(p, "w") as f:
+        for i in range(256):
+            fids = rng.integers(1, F, size=FL)
+            f.write(f"{i % 2} " + " ".join(
+                f"{j}:{d}:1.0" for j, d in enumerate(fids)) + "\n")
+    export_dir = str(tmp_path / "exports")
+    dense0 = {k: {kk: np.asarray(vv) for kk, vv in v.items()}
+              for k, v in wparams.items() if k in ("fc1", "fc2")}
+    tr = online.OnlineTrainer(
+        admin, "widedeep", K, field_cnt=FL, dense_params=dense0,
+        dense_lr=0.01, export_dir=export_dir, export_every=5,
+        export_codec="fp32", registry=obs.MetricsRegistry(),
+    )
+    try:
+        tr.run(iter_libffm_batches(p, 32, FL, loop=True), max_steps=11)
+        assert tr.exports == 2
+        latest = online.read_latest(export_dir)
+        assert latest.endswith("model_0000000010.npz")
+
+        # the deployment shape: dense leaves local (the swap's subject),
+        # sparse leaves PS-row-backed off the SAME live rows the trainer
+        # just trained — the replay slice captures its rows once
+        model = serve.ServingModel(
+            "widedeep",
+            {k: tr.dense[k] for k in ("fc1", "fc2")},
+            row_leaves={"w": (0, 1, True), "embed": (1, ROW_DIM, False)},
+            row_dim=ROW_DIM,
+        )
+        replay = [{
+            "fids": rng.integers(1, F, size=(4, FL)).astype(np.int32),
+            "vals": np.ones((4, FL), np.float32),
+            "rep_fids": rng.integers(1, F, size=(4, FL)).astype(np.int32),
+            "rep_mask": np.ones((4, FL), np.float32),
+        }]
+        sw = online.ModelSwapper(
+            model, replay, tolerance=0.5,
+            pull_rows=lambda uids: admin.pull_arrays(
+                uids, worker_epoch=0, worker_id=None, create=False)[1],
+            registry=obs.MetricsRegistry())
+        sw.watch(export_dir, poll_s=0.05)
+        try:
+            _wait(lambda: sw.stats()["attempts"] >= 1, 10,
+                  "watcher pickup")
+            assert sw.stats()["accepted"] == 1 and model.version == 1
+        finally:
+            sw.stop_watch()
+    finally:
+        admin.close()
+        svc.close()
+
+
+def test_online_trainer_validates_config():
+    with pytest.raises(ValueError, match="field_cnt"):
+        online.OnlineTrainer(None, "widedeep", 8)
+    with pytest.raises(ValueError, match="dense"):
+        online.OnlineTrainer(None, "fm", 8, export_every=5)
+    with pytest.raises(ValueError, match="kind"):
+        online.OnlineTrainer(None, "gbm", 8)
+
+
+# -- acceptance: continuous train-and-serve across processes -----------------
+
+
+SERVER_SCRIPT = """
+import sys
+sys.path.insert(0, %(root)r)
+import numpy as np, jax
+from lightctr_tpu import online, serve
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.models import fm, widedeep
+from lightctr_tpu.obs import exporter
+
+F, K, ROW_DIM = %(F)d, %(K)d, %(ROW_DIM)d
+params = fm.init(jax.random.PRNGKey(5), F, K)
+keys, rows = serve.fused_fm_rows(params)
+store = AsyncParamServer(dim=ROW_DIM, n_workers=4, seed=0,
+                         staleness_threshold=1000000)
+svc = ParamServerService(store)
+admin = PSClient(svc.address, ROW_DIM)
+admin.preload_arrays(keys, rows)
+
+# the train-and-serve pair: PS-row-backed scoring off the SAME live rows
+srv = serve.PredictionServer(
+    serve.ServingModel("fm", {}, row_leaves=serve.fm_ps_row_leaves(K),
+                       row_dim=ROW_DIM),
+    ps=PSClient(svc.address, ROW_DIM), max_batch=16, max_wait_us=100,
+    queue_cap=256, deadline_ms=5000, cache_capacity=4096)
+sub = online.FreshnessSubscriber(
+    srv, [svc.address], ROW_DIM, slo_s=%(slo)f, hard_slo_factor=2.0,
+    poll_ms=400).start()
+
+# the dense hot-swap surface: a local widedeep server whose swapper
+# watches the export dir (counters land in ITS registry -> its stats op)
+wparams = widedeep.init(jax.random.PRNGKey(7), F, field_cnt=3,
+                        factor_dim=4)
+wd_model = serve.ServingModel("widedeep", wparams)
+wd_srv = serve.PredictionServer(wd_model, max_batch=16, max_wait_us=100,
+                                queue_cap=256, deadline_ms=5000)
+rrng = np.random.default_rng(1)
+replay = [{
+    "fids": rrng.integers(1, F, size=(4, 3)).astype(np.int32),
+    "vals": np.ones((4, 3), np.float32),
+    "rep_fids": rrng.integers(1, F, size=(4, 3)).astype(np.int32),
+    "rep_mask": np.ones((4, 3), np.float32),
+} for _ in range(2)]
+swapper = online.ModelSwapper(wd_model, replay, tolerance=5e-3,
+                              registry=wd_srv.registry)
+swapper.watch(%(export_dir)r, poll_s=0.1)
+
+ops = exporter.install(0)
+print("ADDR", svc.address[1], srv.address[1], wd_srv.address[1],
+      ops.address[1], flush=True)
+sys.stdin.read()
+swapper.stop_watch(); sub.stop()
+srv.close(); wd_srv.close(); admin.close(); svc.close()
+"""
+
+TRAINER_SCRIPT = """
+import sys
+sys.path.insert(0, %(root)r)
+import numpy as np
+from lightctr_tpu import online
+from lightctr_tpu.data.streaming import iter_libffm_batches
+from lightctr_tpu.dist.ps_server import PSClient
+
+ps = PSClient(("127.0.0.1", %(ps_port)d), %(ROW_DIM)d)
+tr = online.OnlineTrainer(ps, "fm", %(K)d, worker_id=0)
+print("READY", flush=True)
+tr.run(iter_libffm_batches(%(train)r, 64, 4, loop=True))
+"""
+
+
+def test_two_process_online_acceptance(tmp_path, rng):
+    """ISSUE 11 tier-1 acceptance: a trainer PROCESS churns hot keys
+    through the PS while a serving process scores from the same live rows
+    and this process drives the assertions —
+
+      1. served scores pick up the trained rows within the freshness
+         budget (after SIGSTOPping the trainer, the served scores equal
+         the forward computed from rows pulled straight off the PS);
+      2. ``/healthz`` DEGRADES while the trainer stays stopped (the
+         freshness age blows the SLO) and RECOVERS after SIGCONT;
+      3. a deliberately corrupted dense export is REFUSED by the
+         shadow-scoring gate while a faithful one swaps in.
+    """
+    export_dir = str(tmp_path / "exports")
+    os.makedirs(export_dir)
+    train = str(tmp_path / "train.ffm")
+    _write_fm_stream(rng=np.random.default_rng(2), path=train, rows=2048)
+    slo_s = 1.5
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    server = subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(SERVER_SCRIPT) % {
+            "root": REPO_ROOT, "F": F, "K": K, "ROW_DIM": ROW_DIM,
+            "slo": slo_s, "export_dir": export_dir,
+        }],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env,
+    )
+    trainer = None
+    cli = wd_cli = admin = None
+    try:
+        line = server.stdout.readline().split()
+        assert line and line[0] == "ADDR", line
+        ps_port, serve_port, wd_port, ops_port = map(int, line[1:5])
+
+        trainer = subprocess.Popen(
+            [sys.executable, "-c", textwrap.dedent(TRAINER_SCRIPT) % {
+                "root": REPO_ROOT, "ps_port": ps_port, "K": K,
+                "ROW_DIM": ROW_DIM, "train": train,
+            }],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        assert trainer.stdout.readline().split() == ["READY"]
+
+        cli = serve.PredictClient(("127.0.0.1", serve_port))
+        probe = _batch(np.random.default_rng(3), n=4)
+        s0 = cli.predict(probe)
+
+        # training is live: the served scores move off the preload
+        _wait(lambda: np.abs(cli.predict(probe) - s0).max() > 1e-3,
+              60, "served scores to reflect training")
+
+        # 1) freeze the trainer; the PS rows are now fixed — the served
+        # scores must converge onto the forward computed from the LIVE
+        # rows within the freshness budget (push-based deltas drop the
+        # stale cached rows, the re-pull serves the trained ones)
+        os.kill(trainer.pid, signal.SIGSTOP)
+        time.sleep(0.3)  # drain writes already on the wire
+        admin = PSClient(("127.0.0.1", ps_port), ROW_DIM)
+        uids = np.unique(probe["fids"].reshape(-1).astype(np.int64))
+        _, live_rows = admin.pull_arrays(uids, worker_epoch=0,
+                                         worker_id=None, create=False)
+        trained = {"w": np.zeros(F, np.float32),
+                   "v": np.zeros((F, K), np.float32)}
+        trained["w"][uids] = live_rows[:, 0]
+        trained["v"][uids] = live_rows[:, 1:]
+        expected = _fm_forward(trained, probe)
+        deadline = time.monotonic() + slo_s + 3.0
+        got = None
+        while time.monotonic() < deadline:
+            got = cli.predict(probe)
+            if np.abs(got - expected).max() < 2e-3:
+                break
+            time.sleep(0.1)
+        np.testing.assert_allclose(got, expected, atol=2e-3, err_msg=(
+            "served scores did not pick up the trained rows within the "
+            "freshness budget"))
+
+        # 2) the freshness SLO: with the trainer stopped the newest
+        # applied update only ages — /healthz must degrade ...
+        def healthz():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ops_port}/healthz", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        def fresh_status():
+            comps = healthz()["components"]
+            serve_comp = comps.get(f"serve_{serve_port}") or {}
+            det = (serve_comp.get("detectors") or {}).get("freshness_slo")
+            return (det or {}).get("status")
+
+        _wait(lambda: fresh_status() in (health_mod.DEGRADED,
+                                         health_mod.UNHEALTHY),
+              slo_s * 4 + 15, "/healthz to degrade on freshness")
+        # ... and recover once training resumes (fresh updates arrive)
+        os.kill(trainer.pid, signal.SIGCONT)
+        _wait(lambda: fresh_status() == health_mod.OK,
+              30, "/healthz to recover after SIGCONT")
+
+        # 3) the swap gate, across the process boundary: a corrupted
+        # dense export is refused, a faithful one lands
+        wparams = widedeep.init(jax.random.PRNGKey(7), F, field_cnt=3,
+                                factor_dim=4)
+        np_params = {k: (np.asarray(v) if not isinstance(v, dict)
+                         else {kk: np.asarray(vv)
+                               for kk, vv in v.items()})
+                     for k, v in wparams.items()}
+        corrupt = dict(np_params)
+        corrupt["fc1"] = {"w": np_params["fc1"]["w"] + 3.0,
+                          "b": np_params["fc1"]["b"]}
+        online.publish_export(export_dir, corrupt, model="widedeep",
+                              step=1, codec="fp32")
+        wd_cli = serve.PredictClient(("127.0.0.1", wd_port))
+
+        def swap_counters():
+            c = wd_cli.stats()["telemetry"]["counters"]
+            return (c.get("online_swap_attempts_total", 0),
+                    c.get("online_swap_accepted_total", 0),
+                    c.get(obs.labeled("online_swap_refused_total",
+                                      reason="parity"), 0))
+
+        _wait(lambda: swap_counters()[2] >= 1, 20,
+              "corrupted export refused by the shadow gate")
+        assert swap_counters()[1] == 0
+        online.publish_export(export_dir, np_params, model="widedeep",
+                              step=2, codec="fp32")
+        _wait(lambda: swap_counters()[1] == 1, 20, "faithful export swap")
+        # the server still serves sane widedeep scores after the flip
+        scores = wd_cli.predict({
+            "fids": rng.integers(1, F, size=(2, 3)).astype(np.int32),
+            "vals": np.ones((2, 3), np.float32),
+            "rep_fids": rng.integers(1, F, size=(2, 3)).astype(np.int32),
+            "rep_mask": np.ones((2, 3), np.float32),
+        })
+        assert np.isfinite(scores).all() and scores.shape == (2,)
+    finally:
+        for c in (cli, wd_cli, admin):
+            if c is not None:
+                c.close()
+        if trainer is not None:
+            try:
+                os.kill(trainer.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            trainer.kill()
+            trainer.wait(timeout=10)
+        if server.poll() is None:
+            try:
+                server.stdin.close()
+                server.wait(timeout=15)
+            except (OSError, subprocess.TimeoutExpired):
+                server.kill()
+                server.wait(timeout=10)

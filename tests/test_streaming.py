@@ -223,6 +223,101 @@ def test_strided_workers_yield_equal_batch_counts(tmp_path):
     assert counts == [1, 1], counts
 
 
+def _write_rows(path, n, start=0):
+    with open(path, "w" if start == 0 else "a") as f:
+        for i in range(start, start + n):
+            f.write(f"{i % 2} 0:{i % 97}:1.0 1:{(i * 7) % 97}:2.0\n")
+
+
+def test_loop_mode_wraps_exactly_at_the_epoch_boundary(tmp_path):
+    """ISSUE 11 satellite: ``loop=True`` re-streams the file forever —
+    2 epochs of the loop equal 2 back-to-back finite streams, including
+    across the wrap boundary (no dropped/duplicated batch where epoch N
+    ends and N+1 begins), and ``drop_remainder`` applies per epoch."""
+    p = tmp_path / "loop.ffm"
+    _write_rows(p, 21)  # B=4 -> 5 full batches + dropped tail, per epoch
+    finite = list(iter_libffm_batches(str(p), 4, 4))
+    assert len(finite) == 5
+    it = iter_libffm_batches(str(p), 4, 4, loop=True)
+    looped = [next(it) for _ in range(2 * len(finite))]
+    for got, want in zip(looped, finite + finite):
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+def test_loop_mode_reshuffles_deterministically_per_epoch(tmp_path):
+    """The per-epoch shuffle is seeded ``(seed, epoch)``: the sequence is
+    reproducible run-to-run, epochs 0 and 1 order their batches
+    differently, and each epoch is a permutation of the finite stream
+    (no batch lost or duplicated by the shuffle buffer)."""
+    p = tmp_path / "shuf.ffm"
+    _write_rows(p, 24)  # 6 batches of 4
+    n = 6
+
+    def epoch_keys(count):
+        it = iter_libffm_batches(str(p), 4, 4, loop=True,
+                                 shuffle_batches=4, seed=3)
+        return [int(next(it)["fids"][0, 0]) for _ in range(count)]
+
+    a, b = epoch_keys(2 * n), epoch_keys(2 * n)
+    assert a == b, "shuffled loop must be deterministic for one seed"
+    base = [int(x["fids"][0, 0]) for x in iter_libffm_batches(str(p), 4, 4)]
+    assert sorted(a[:n]) == sorted(base) == sorted(a[n:])
+    assert a[:n] != a[n:], "epochs must reshuffle, not repeat"
+    c = iter_libffm_batches(str(p), 4, 4, loop=True, shuffle_batches=4,
+                            seed=4)
+    assert [int(next(c)["fids"][0, 0]) for _ in range(n)] != a[:n]
+
+
+def test_loop_mode_stop_predicate_ends_the_stream(tmp_path):
+    p = tmp_path / "stop.ffm"
+    _write_rows(p, 8)
+    seen = []
+    stream = iter_libffm_batches(str(p), 2, 4, loop=True,
+                                 stop=lambda: len(seen) >= 7)
+    for b in stream:
+        seen.append(b)
+    assert len(seen) == 7  # mid-second-epoch: the predicate ended it
+
+
+def test_follow_mode_tails_and_withholds_partial_lines(tmp_path):
+    """ISSUE 11 satellite: ``follow=True`` tails a growing file.  A
+    trailing PARTIAL line (writer mid-append, no newline yet) is never
+    parsed — it would misread half a row or raise on a torn token — and
+    is stitched whole once its newline lands."""
+    import threading
+
+    p = tmp_path / "tail.ffm"
+    with open(p, "w") as f:
+        f.write("0 0:1:1.0 1:2:1.0\n1 0:3:1.0\n")
+        f.write("1 0:")  # torn mid-token: parsing it would raise
+    ev = threading.Event()
+    it = iter_libffm_batches(str(p), 2, 4, follow=True, stop=ev,
+                             poll_s=0.01)
+    b1 = next(it)  # the two COMPLETE lines; the torn tail waits
+    assert int(b1["fids"][0, 0]) == 1 and int(b1["fids"][1, 0]) == 3
+    assert b1["row_mask"].sum() == 2
+    with open(p, "a") as f:
+        f.write("5:2.5\n0 0:7:1.0\n")  # completes the torn line + one row
+    b2 = next(it)
+    assert int(b2["fids"][0, 0]) == 5  # the stitched line parsed as ONE row
+    np.testing.assert_allclose(b2["vals"][0, 0], 2.5)
+    assert int(b2["fids"][1, 0]) == 7
+    ev.set()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_follow_and_loop_validate_args(tmp_path):
+    p = tmp_path / "v.ffm"
+    _write_rows(p, 4)
+    with pytest.raises(ValueError, match="exclusive"):
+        next(iter_libffm_batches(str(p), 2, 4, follow=True, loop=True))
+    with pytest.raises(ValueError, match="shard"):
+        next(iter_libffm_batches(str(p), 2, 4, follow=True,
+                                 process_index=0, process_count=2))
+
+
 def test_scan_level_shard_validates_rows_at_their_owner(tmp_path):
     """The native strided scan line-skips other workers' rows WITHOUT
     tokenizing them (the whole point: the fleet parses each row once).

@@ -33,6 +33,11 @@ turns either into something readable:
       #    (trainer_kernel_path_total{phase,impl} from a registry
       #    snapshot or stats() dump): per-phase dispatch counts for
       #    pallas / interpret / xla — measured, not assumed
+  python -m tools.metrics_report --online SNAPSHOT_JSON
+      # -> online learning plane (docs/ONLINE.md): freshness age +
+      #    per-entry apply-age percentiles, deltas applied vs
+      #    degraded-to-full-refresh by reason, model hot-swap
+      #    attempts/refusals, continuous-trainer step/export counters
 """
 
 from __future__ import annotations
@@ -399,6 +404,81 @@ def summarize_exchange(doc) -> dict:
     return report
 
 
+def summarize_online(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> online-plane report (docs/ONLINE.md): freshness —
+    the newest-applied-update age gauge plus per-entry apply-age
+    percentiles, deltas applied vs degraded-to-full-refresh (by reason);
+    the dense hot-swap gate — attempts / accepted / refusals by reason
+    and the last shadow divergence; and the continuous trainer — steps,
+    examples, exports, push failures, last loss.  Every series here is
+    declared in ``lightctr_tpu.online.ONLINE_SERIES`` (lint-enforced)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+
+    def _by_label(prefix, label):
+        out = {}
+        p = prefix + "{" + label + '="'
+        for name, val in counters.items():
+            if name.startswith(p):
+                out[name[len(p):].rstrip('"}')] = val
+        return out
+
+    report: dict = {}
+    full = _by_label("serve_freshness_full_refresh_total", "reason")
+    freshness = {
+        "polls": counters.get("serve_freshness_polls_total", 0),
+        "deltas_applied": counters.get(
+            "serve_freshness_deltas_applied_total", 0),
+        "rows_dropped": counters.get(
+            "serve_freshness_rows_dropped_total", 0),
+        "full_refreshes": {"total": sum(full.values()), "by_reason": full},
+    }
+    if "serve_freshness_age_seconds" in gauges:
+        freshness["age_s"] = round(gauges["serve_freshness_age_seconds"], 6)
+    if "serve_freshness_apply_age_seconds" in hists:
+        freshness["apply_age"] = _hist_summary(
+            hists["serve_freshness_apply_age_seconds"])
+    # gate on real activity (full_refreshes is a dict and always truthy):
+    # a snapshot with no freshness series must omit the section, like
+    # the swap/trainer sections do
+    if (freshness["polls"] or freshness["deltas_applied"]
+            or freshness["rows_dropped"]
+            or freshness["full_refreshes"]["total"]
+            or "age_s" in freshness or "apply_age" in freshness):
+        report["freshness"] = freshness
+    refused = _by_label("online_swap_refused_total", "reason")
+    attempts = counters.get("online_swap_attempts_total", 0)
+    if attempts:
+        swap = {
+            "attempts": attempts,
+            "accepted": counters.get("online_swap_accepted_total", 0),
+            "refused": {"total": sum(refused.values()),
+                        "by_reason": refused},
+        }
+        if "online_swap_shadow_diff" in gauges:
+            swap["last_shadow_diff"] = gauges["online_swap_shadow_diff"]
+        report["swap"] = swap
+    steps = counters.get("online_steps_total", 0)
+    if steps:
+        trainer = {
+            "steps": steps,
+            "examples": counters.get("online_examples_total", 0),
+            "exports": counters.get("online_exports_total", 0),
+            "push_failures": counters.get(
+                "online_push_failures_total", 0),
+        }
+        if "online_loss" in gauges:
+            trainer["last_loss"] = gauges["online_loss"]
+        if "online_export_seconds" in hists:
+            trainer["export_time"] = _hist_summary(
+                hists["online_export_seconds"])
+        report["trainer"] = trainer
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -422,6 +502,11 @@ def main(argv=None):
                     help="summarize sparse-kernel dispatch counts "
                          "(trainer_kernel_path_total{phase,impl}) from a "
                          "registry snapshot or stats() dump")
+    ap.add_argument("--online", metavar="SNAPSHOT_JSON",
+                    help="summarize the online learning plane (freshness "
+                         "age + deltas applied vs full refreshes, swap "
+                         "attempts/refusals, continuous-trainer counters) "
+                         "from a registry snapshot or stats() dump")
     ap.add_argument("--exchange", metavar="SNAPSHOT_JSON",
                     help="summarize gradient-exchange decisions and bytes "
                          "(trainer_exchange_*/trainer_hier_* series, the "
@@ -462,6 +547,15 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.online:
+        with open(args.online) as f:
+            doc = json.load(f)
+        report = summarize_online(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if args.exchange:
         with open(args.exchange) as f:
             doc = json.load(f)
@@ -483,7 +577,8 @@ def main(argv=None):
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
-                 "or --kernels SNAPSHOT_JSON")
+                 "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, or "
+                 "--online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

@@ -16,6 +16,15 @@ docs/SERVING.md):
      replies while the p99 of the ANSWERED requests stays bounded —
      the knee the admission control exists to create.
 
+  4. **Churn cells** (docs/ONLINE.md) — steady-state QPS + window hit
+     rate while a training loop churns ``--churn-pct-per-min`` of the
+     hot keys per minute, measured three ways: no churn (baseline),
+     push-based freshness (``MSG_SUBSCRIBE`` per-key deltas, with
+     freshness-age p50/p99 from the server-stamped write times), and
+     the polling counterfactual (write log disabled, every poll a full
+     cache drop).  The online plane's bar: push hit rate within 10% of
+     the baseline, p99 freshness age under the SLO.
+
 Emits ``SERVE_BENCH.json`` (stdout + file).  Synthetic model/traffic:
 no dataset needed, runs in any checkout.
 
@@ -192,6 +201,15 @@ def main(argv=None):
     ap.add_argument("--duration", type=float, default=2.0,
                     help="seconds per measurement cell")
     ap.add_argument("--rows-per-req", type=int, default=8)
+    ap.add_argument("--churn-pct-per-min", type=float, default=10.0,
+                    help="churn cells: %% of the hot key set trained "
+                         "(pushed through the PS) per minute")
+    ap.add_argument("--poll-s", type=float, default=0.5,
+                    help="version poll cadence of the polling "
+                         "counterfactual churn cell")
+    ap.add_argument("--freshness-slo", type=float, default=2.0,
+                    help="freshness-age SLO (seconds) the push churn "
+                         "cell's p99 is judged against")
     ap.add_argument("--out", default="SERVE_BENCH.json")
     args = ap.parse_args(argv)
 
@@ -367,6 +385,150 @@ def main(argv=None):
     admin.close()
     svc.close()
 
+    # ---- cell 5: ONLINE churn cells (docs/ONLINE.md acceptance).  A
+    # training loop churns ``--churn-pct-per-min`` of the HOT keys per
+    # minute (real adagrad pushes through the PS wire, each bumping the
+    # write log) while the same closed-loop replay scores.  Three cells:
+    #   no_churn        — the hit-rate baseline;
+    #   push            — MSG_SUBSCRIBE-driven per-key deltas
+    #                     (FreshnessSubscriber), freshness age measured
+    #                     from the server-stamped write times;
+    #   poll_full_drop  — the polling COUNTERFACTUAL: the store's write
+    #                     log is disabled, so every version poll that
+    #                     sees a move must drop the whole cache (the
+    #                     pre-PR-10 behavior the push path replaces).
+    # The acceptance bar: push hit rate within 10% of no_churn, p99
+    # freshness age under the SLO. -----------------------------------------
+    _log("churn cells: push-based deltas vs polling counterfactual ...")
+    from lightctr_tpu.obs.registry import histogram_quantile
+    from lightctr_tpu.online import FreshnessSubscriber
+
+    churn_duration = max(2 * args.duration, 4.0)
+    # hot set = the head the cache actually serves: key frequency over
+    # the replay stream, top cache-capacity keys
+    freq = {}
+    for r in reqs:
+        for u in np.unique(r["fids"]):
+            freq[int(u)] = freq.get(int(u), 0) + 1
+    hot_keys = np.array(sorted(freq, key=freq.get, reverse=True)
+                        [: VOCAB // 8], np.int64)
+    churn_keys_per_s = (len(hot_keys) * args.churn_pct_per_min
+                        / 100.0 / 60.0)
+
+    def churn_cell(mode):
+        c_store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+        if mode == "poll_full_drop":
+            # no write log -> the floor advances past every bump -> the
+            # version poll can never cover a move: full drop each time
+            c_store.WRITE_LOG_MAX_ENTRIES = 0
+            c_store.WRITE_LOG_MAX_UIDS = 0
+        c_svc = ParamServerService(c_store)
+        c_admin = PSClient(c_svc.address, ROW_DIM)
+        c_admin.preload_arrays(keys, rows)
+        c_srv = serve.PredictionServer(
+            ps_model, ps=PSClient(c_svc.address, ROW_DIM), max_batch=256,
+            max_wait_us=1000, queue_cap=2048,
+            deadline_ms=max(250.0, 5 * args.budget_ms),
+            cache_capacity=VOCAB // 8,
+            version_poll_s=(args.poll_s if mode == "poll_full_drop"
+                            else 0.0),
+        )
+        sub = None
+        if mode == "push":
+            sub = FreshnessSubscriber(
+                c_srv, [c_svc.address], ROW_DIM, slo_s=args.freshness_slo,
+            ).start()
+        # identical warm phase for every cell
+        warm_cli = serve.PredictClient(c_srv.address)
+        for r in reqs[:256]:
+            warm_cli.predict(r)
+        warm_cli.close()
+        st0 = c_srv.cache.stats()
+        stop_churn = threading.Event()
+        churned = [0]
+
+        def churn_loop():
+            crng = np.random.default_rng(42)
+            interval = 1.0 / max(churn_keys_per_s, 1e-9)
+            while not stop_churn.is_set():
+                k = np.sort(crng.choice(hot_keys, size=1, replace=False))
+                g = crng.normal(
+                    scale=0.1, size=(len(k), ROW_DIM)).astype(np.float32)
+                try:
+                    c_admin.push_arrays(0, k.astype(np.int64), g,
+                                        worker_epoch=0)
+                except (ConnectionError, OSError):
+                    return
+                churned[0] += len(k)
+                stop_churn.wait(interval)
+
+        churner = None
+        if mode != "no_churn":
+            churner = threading.Thread(target=churn_loop, daemon=True)
+            churner.start()
+        qps, lats, ok, shed = _closed_loop(
+            c_srv.address, reqs, 2, churn_duration)
+        stop_churn.set()
+        if churner is not None:
+            churner.join(timeout=5)
+        st1 = c_srv.cache.stats()
+        d_hits = st1["hits"] - st0["hits"]
+        d_miss = st1["misses"] - st0["misses"]
+        cell = {
+            "row_qps": round(qps, 1),
+            "p99_ms": round(_pctl(lats, 99) * 1e3, 3),
+            "churned_keys": churned[0],
+            "window_hit_rate": round(d_hits / (d_hits + d_miss), 5)
+            if d_hits + d_miss else 0.0,
+            "cache_invalidations": st1["invalidations"]
+            - st0["invalidations"],
+            "cache_delta_invalidations": st1["delta_invalidations"]
+            - st0["delta_invalidations"],
+        }
+        if sub is not None:
+            h = c_srv.registry.snapshot()["histograms"].get(
+                "serve_freshness_apply_age_seconds")
+            if h and h["count"]:
+                cell["freshness_age_p50_s"] = round(
+                    histogram_quantile(h, 0.5), 4)
+                cell["freshness_age_p99_s"] = round(
+                    histogram_quantile(h, 0.99), 4)
+                cell["freshness_updates"] = h["count"]
+            sub.stop()
+        c_srv.close()
+        c_admin.close()
+        c_svc.close()
+        _log(f"churn[{mode}]: {cell}")
+        return cell
+
+    cells = {m: churn_cell(m)
+             for m in ("no_churn", "push", "poll_full_drop")}
+    base_hr = cells["no_churn"]["window_hit_rate"]
+    push_hr = cells["push"]["window_hit_rate"]
+    poll_hr = cells["poll_full_drop"]["window_hit_rate"]
+    churn_ok = bool(
+        base_hr > 0
+        and push_hr >= base_hr * 0.9
+        and cells["push"].get("freshness_age_p99_s", 1e9)
+        <= args.freshness_slo
+    )
+    report["churn"] = {
+        "config": {
+            "churn_pct_per_min": args.churn_pct_per_min,
+            "hot_keys": len(hot_keys),
+            "churn_keys_per_s": round(churn_keys_per_s, 3),
+            "duration_s": churn_duration,
+            "version_poll_s": args.poll_s,
+            "freshness_slo_s": args.freshness_slo,
+        },
+        "cells": cells,
+        "push_hit_rate_vs_baseline": round(push_hr / base_hr, 4)
+        if base_hr else 0.0,
+        "poll_hit_rate_vs_baseline": round(poll_hr / base_hr, 4)
+        if base_hr else 0.0,
+        "ok": churn_ok,
+    }
+
     sat = open_points[-1]
     report["ok"] = bool(
         report["qps_at_p99_budget"]["row_qps"] > 0
@@ -374,6 +536,7 @@ def main(argv=None):
         and sat["p99_ms"] <= 3 * args.budget_ms
         and report["cache_hit_rate"] > 0.3
         and report["warmup"]["cold_start_hit_rate_delta"] > 0
+        and churn_ok
     )
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
