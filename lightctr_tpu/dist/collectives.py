@@ -909,8 +909,8 @@ def _ag_exchange_rows(
     payloads under a FIXED ``compress_range`` (requires ``uids``): the
     carried remainder is compensated into this step's encode and the fresh
     clip+quantization error is scattered back at the rows' slots — the
-    compensate/encode/decode/error chain runs as ONE fused
-    ``quantize_pack_ef`` pass through the kernel registry."""
+    compensate/encode/decode/error/carry-scatter chain runs as ONE fused
+    ``quantize_pack_ef_update`` pass through the kernel registry."""
     use_ef = residual is not None
     if compress_bits is None:
         if use_ef:
@@ -938,13 +938,13 @@ def _ag_exchange_rows(
     # every VALID slot (non-pad) compensates — including ids whose
     # gradient is zero this step, so a carried clip remainder drains on
     # the id's next appearance rather than waiting for a nonzero gradient.
+    # The compensate/encode/decode/fresh-error/CARRY-SCATTER chain is ONE
+    # fused kernel pass (quantize_pack_ef_update): the residual update no
+    # longer runs as a separate gather + scatter HLO pair.
     mask = _ef_valid_mask(uids, rows)
-    carried = jnp.take(residual, uids, axis=0)
-    codes, delta = sparse_kernels.quantize_pack_ef(table, rows, carried, mask)
-    # fresh error (clip + quantization) back at the row's slot: an .add
-    # of the masked DELTA, so padded id-0 repeats and zero-row entries
-    # are no-ops on the carry
-    new_residual = residual.at[uids].add(delta)
+    codes, new_residual, _ = sparse_kernels.quantize_pack_ef_update(
+        table, rows, uids, residual, mask
+    )
     all_rows = quantize.extract(
         table, jax.lax.all_gather(codes, axis_name, tiled=True)
     )
@@ -1141,13 +1141,18 @@ def rs_owner_partition(uids: jax.Array, n: int, bucket_cap: int):
 
 def rs_scatter_rows(
     rows: jax.Array, dest: jax.Array, order: jax.Array, n: int,
-    bucket_cap: int,
+    bucket_cap: int, fill=None,
 ) -> jax.Array:
     """Scatter a [K, ...] row payload into [n, bucket_cap, ...] destination
     buckets through an :func:`rs_owner_partition` plan (empty slots zero —
-    the no-op-add convention)."""
+    the no-op-add convention).  ``fill`` overrides the empty-slot value:
+    the folded-EF path scatters CODES and fills with the code of 0.0, so
+    the wire bytes equal what encoding zero-filled value buckets
+    produced."""
     flat = jnp.take(rows, order, axis=0)
-    out = jnp.zeros((n * bucket_cap,) + rows.shape[1:], rows.dtype)
+    shape = (n * bucket_cap,) + rows.shape[1:]
+    out = (jnp.zeros(shape, rows.dtype) if fill is None
+           else jnp.full(shape, fill, rows.dtype))
     out = out.at[dest].set(flat, mode="drop")
     return out.reshape((n, bucket_cap) + rows.shape[1:])
 
@@ -1260,24 +1265,33 @@ def _rs_gather_rows(
         if uids is None:
             raise ValueError("sparse error feedback needs uids")
         mask = _ef_valid_mask(uids, rows)
-        carried = jnp.take(residual, uids, axis=0)
-        val = rows + carried * mask
-        bucket_rows = rs_scatter_rows(val, dest, order, n, bucket_cap)
-        codes = sparse_kernels.quantize_pack(table, bucket_rows)
-        # decoded view of each ORIGINAL slot: invert the partition plan
-        # (dest[j] is permuted entry j's flat bucket slot; n*bucket_cap =
-        # dropped — pads AND overflow victims decode to 0, so a dropped
-        # entry's full value rides the carry into the next step)
-        flat_dec = quantize.extract(table, codes).reshape(
-            (n * bucket_cap,) + rows.shape[1:]
+        # folded EF pack (PR 9 follow-up): compensate / encode / decode /
+        # carry-scatter run as ONE kernel pass over the ORIGINAL [K, ...]
+        # rows, BEFORE the bucket scatter — codes are slot-invariant, so
+        # scattering codes ships byte-identical buckets to the old
+        # scatter-then-encode order (empty slots carry the code of 0.0,
+        # exactly what encoding a zero-filled bucket produced)
+        codes_rows, new_residual, dec_rows = \
+            sparse_kernels.quantize_pack_ef_update(
+                table, rows, uids, residual, mask
+            )
+        # an entry dropped by bucket overflow must carry its FULL value
+        # (its receiver-side reconstruction is 0, not dec): add the
+        # kernel's decoded view back at dropped slots — a cheap
+        # correction that is exact zero whenever rs_fits held
+        kept_flags = jnp.concatenate([
+            jnp.ones((n * bucket_cap,), rows.dtype),
+            jnp.zeros((1,), rows.dtype),
+        ])
+        kept = jnp.zeros((uids.shape[0],), rows.dtype).at[order].set(
+            jnp.take(kept_flags, dest)
         )
-        padded_dec = jnp.concatenate(
-            [flat_dec, jnp.zeros((1,) + rows.shape[1:], rows.dtype)], axis=0
+        dropped = (1.0 - kept).reshape((-1,) + (1,) * (rows.ndim - 1))
+        new_residual = new_residual.at[uids].add(dec_rows * dropped * mask)
+        zero_code = quantize.compress(table, jnp.zeros((), rows.dtype))
+        codes = rs_scatter_rows(
+            codes_rows, dest, order, n, bucket_cap, fill=zero_code
         )
-        dec_rows = jnp.zeros_like(rows).at[order].set(
-            jnp.take(padded_dec, dest, axis=0)
-        )
-        new_residual = residual.at[uids].add((val - dec_rows - carried) * mask)
         all_rows = quantize.extract(
             table, _rs_ring_exchange(codes, axis_name, n)
         )
@@ -1298,15 +1312,15 @@ def _rs_gather_rows(
         merged = merged / n
     if use_owner_ef:
         # stage-2 EF: compensate the owner's merged-shard encode with the
-        # previous step's owner carry, scatter the fresh clip+quantization
-        # error back at the owned rows' slots (the fused EF pack pass) —
-        # the all-gathered codes decode identically on every member
+        # previous step's owner carry; encode, decode, fresh error AND the
+        # carry scatter at the owned rows' slots run as the one folded
+        # kernel pass — the all-gathered codes decode identically on
+        # every member
         mask_o = _ef_valid_mask(owner_uids, merged)
-        carried_o = jnp.take(owner_residual, owner_uids, axis=0)
-        codes_o, delta_o = sparse_kernels.quantize_pack_ef(
-            table, merged, carried_o, mask_o
-        )
-        new_owner_residual = owner_residual.at[owner_uids].add(delta_o)
+        codes_o, new_owner_residual, _ = \
+            sparse_kernels.quantize_pack_ef_update(
+                table, merged, owner_uids, owner_residual, mask_o
+            )
         gathered = quantize.extract(
             table, jax.lax.all_gather(codes_o, axis_name, tiled=True)
         )

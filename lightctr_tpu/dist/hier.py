@@ -37,7 +37,27 @@ not storage: every branch of the trainer's exchange stays dense-psum-exact,
 and a codec belongs behind an explicit knob exactly like ``compress_bits``
 on the in-jit paths.  ``codec="f16"`` ships ``wire.pack_rows`` instead (the
 PS hot-path fp16 policy, half the value bytes, the reference's training
-numerics).  Both forms are self-describing per the existing wire contracts.
+numerics).  ``codec="q8_ef"`` puts the quantile-coded ERROR-FEEDBACK wire
+on the rendezvous (ISSUE 13 — SparCML's sparse quantized streams,
+arXiv:1802.08021, on the slowest link per arXiv:2205.05243): pushes ship
+``wire.pack_rows_coded`` frames (tagged id stream + 1-byte quantile codes
+over a per-frame dynamic range) with a MEMBER-side sparse EF carry — the
+encode compensates from last step's carried quantization error, the
+socket-wire twin of the trainer's ``sres`` opt-state — and the shard
+answers merged-round pulls through an OWNER-side carry (the stage-2
+sum-mode rs EF of PR 10, carried across rounds), encoding each round
+exactly once so every host decodes identical bytes.  Dynamic ranges never
+clip, so both carries stay sub-bucket (tested).  The dense+loss
+pseudo-table always rides exact fp32 (``push(..., exact=True)``) — the
+loss readout must not wobble with the codec.
+
+Wire-level shared id streams: tables listing the identical batch-field
+tuple produce the identical merged id union, so :meth:`HierExchangeClient.
+push_group` / :meth:`~HierExchangeClient.pull_group` ship ONE tagged id
+stream per (host, field group) with per-table value sections referencing
+it by position — the socket-wire twin of PR 5's in-jit shared streams.
+All forms are self-describing; old fp32/f16 frames are bit-identical to
+the PR 10 wire (tested in test_wire_codec.py / test_hier_exchange.py).
 """
 
 from __future__ import annotations
@@ -65,13 +85,87 @@ from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import MetricsRegistry, labeled
 
-#: push/pull header codec flag: bit 0 set = exact fp32 payload (pack_keys ++
-#: raw fp32 rows); clear = the fp16 ``wire.pack_rows`` frame
+#: push/pull header codec flags (a varint bitfield, so old peers that only
+#: know bit 0 read an unknown bit as a codec they cannot parse and fail
+#: LOUD on the payload, never silently misparse it):
+#:   bit 0 — exact fp32 payload (pack_keys ++ raw fp32 rows)
+#:   bit 1 — quantile-coded payload (the tagged ``wire.pack_rows_coded``
+#:           frame / ``pack_codes_section`` group sections)
+#:   bit 2 — GROUP frame: one shared id stream + per-table value sections
 FLAG_F32 = 1
+FLAG_CODED = 2
+FLAG_GROUP = 4
+
+#: code width of the ``q8_ef`` wire codec (<= 8 — one byte per value)
+CODED_BITS = 8
 
 
-def _encode_payload(uids: np.ndarray, rows: np.ndarray, f32: bool) -> bytes:
-    if f32:
+class _EFCarry:
+    """Sparse table-keyed error-feedback carry: the socket-wire twin of
+    the trainer's dense ``[vocab, dim]`` ``sres`` opt-state, keyed only by
+    the rows actually seen so neither endpoint needs to know the vocab.
+    ``get`` returns zeros for unseen ids; ``set`` overwrites the carried
+    rows (the EF recipe carries ``val - dec``, a full replacement, not an
+    accumulation)."""
+
+    __slots__ = ("dim", "keys", "rows")
+
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+        self.keys = np.zeros(0, np.int64)
+        self.rows = np.zeros((0, self.dim), np.float32)
+
+    def get(self, uids: np.ndarray) -> np.ndarray:
+        out = np.zeros((len(uids), self.dim), np.float32)
+        if self.keys.size and len(uids):
+            pos = np.searchsorted(self.keys, uids)
+            pos_c = np.minimum(pos, self.keys.size - 1)
+            hit = self.keys[pos_c] == uids
+            out[hit] = self.rows[pos_c[hit]]
+        return out
+
+    def set(self, uids: np.ndarray, rows: np.ndarray) -> None:
+        """Already-carried ids update IN PLACE (the steady state once the
+        hot working set has been seen — O(step ids), no rebuild); only
+        genuinely new ids pay the union merge.  Memory converges to the
+        touched-id footprint — the same [vocab, dim]-bounded trade the
+        trainer's dense ``sres`` carry documents, here shrunk to rows
+        actually seen."""
+        if not len(uids):
+            return
+        uids = np.ascontiguousarray(uids, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
+        if not self.keys.size:
+            self.keys = uids.copy()
+            self.rows = rows.copy()
+            return
+        pos = np.searchsorted(self.keys, uids)
+        pos_c = np.minimum(pos, self.keys.size - 1)
+        hit = self.keys[pos_c] == uids
+        self.rows[pos_c[hit]] = rows[hit]
+        if hit.all():
+            return
+        fresh = ~hit
+        union = np.union1d(self.keys, uids[fresh])
+        merged = np.zeros((union.size, self.dim), np.float32)
+        merged[np.searchsorted(union, self.keys)] = self.rows
+        merged[np.searchsorted(union, uids[fresh])] = rows[fresh]
+        self.keys, self.rows = union, merged
+
+    def mass(self) -> float:
+        """Sum |carry| — the undelivered residual mass telemetry."""
+        return float(np.abs(self.rows).sum())
+
+    def max_abs(self) -> float:
+        return float(np.abs(self.rows).max()) if self.rows.size else 0.0
+
+
+def _encode_payload(uids: np.ndarray, rows: np.ndarray, flags: int) -> bytes:
+    """Non-coded payload encodes (the PR 10 wire, byte-identical): exact
+    fp32 or the PS fp16 ``pack_rows`` frame.  Coded frames are built at
+    the call sites (the encoder needs the decoded view for its EF
+    carry)."""
+    if flags & FLAG_F32:
         return wire.pack_keys(uids) + np.ascontiguousarray(
             rows, np.float32
         ).tobytes()
@@ -79,9 +173,17 @@ def _encode_payload(uids: np.ndarray, rows: np.ndarray, f32: bool) -> bytes:
 
 
 def _decode_payload(
-    payload: bytes, dim: int, f32: bool
+    payload: bytes, dim: int, flags: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    if f32:
+    if flags & FLAG_CODED:
+        keys, rows, consumed = wire.unpack_rows_coded(payload, dim)
+        if consumed != len(payload):
+            raise ValueError(
+                f"coded reduce payload length mismatch: consumed "
+                f"{consumed} of {len(payload)} bytes"
+            )
+        return keys, rows
+    if flags & FLAG_F32:
         keys, consumed = wire.split_keys(payload)
         rows = np.frombuffer(payload[consumed:], np.float32)
         if rows.size != len(keys) * dim:
@@ -99,18 +201,47 @@ def _decode_payload(
     return keys, rows
 
 
+def _decode_section(buf: bytes, n: int, dim: int, flags: int
+                    ) -> Tuple[np.ndarray, int]:
+    """One GROUP value section -> ([n, dim] fp32 rows, bytes consumed),
+    by the frame's codec flags."""
+    if flags & FLAG_CODED:
+        return wire.unpack_codes_section(buf, n, dim)
+    if flags & FLAG_F32:
+        need = 4 * n * dim
+        if len(buf) < need:
+            raise ValueError(
+                f"f32 group section carries {len(buf)} bytes for "
+                f"{n}x{dim} rows"
+            )
+        return (np.frombuffer(buf[:need], np.float32)
+                .reshape(n, dim).copy(), need)
+    need = 2 * n * dim
+    if len(buf) < need:
+        raise ValueError(
+            f"f16 group section carries {len(buf)} bytes for {n}x{dim} rows"
+        )
+    return wire.unpack_values(buf[:need], (n, dim)), need
+
+
 class _Round:
     """One (epoch, table) reduction round: contributions keyed by host,
     merged lazily on the first complete pull, garbage-collected once every
-    host pulled it back."""
+    host pulled it back.  ``coded_section`` caches the ONE owner-side
+    EF-compensated encode of the merged rows (every host must decode
+    identical bytes and the owner carry must advance exactly once per
+    round); ``ids_bytes`` caches the tagged id stream beside it."""
 
-    __slots__ = ("contrib", "merged", "pulled", "dim")
+    __slots__ = ("contrib", "merged", "pulled", "dim", "coded_section",
+                 "ids_bytes")
 
     def __init__(self, dim: int):
         self.contrib: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
         self.merged: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.pulled: set = set()
         self.dim = dim
+        self.coded_section: Optional[bytes] = None
+        self.ids_bytes: Optional[bytes] = None
 
 
 class SparseReduceShard:
@@ -137,8 +268,14 @@ class SparseReduceShard:
         self._lock = threading.Lock()
         self._rounds: Dict[Tuple[int, int], _Round] = {}
         self._max_epoch = -(1 << 62)
+        # owner-side EF carries, one sparse table-keyed carry per table:
+        # the stage-2 sum-mode rs EF of the in-jit exchange, across the
+        # DCN — each merged round's encode compensates from the previous
+        # round's quantization error (docs/SPARSE_EXCHANGE.md)
+        self._owner_carry: Dict[int, _EFCarry] = {}
         self._counts = {"pushes": 0, "pulls": 0, "withheld": 0,
-                        "rounds_merged": 0, "protocol_errors": 0}
+                        "rounds_merged": 0, "protocol_errors": 0,
+                        "coded_rounds": 0}
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._stop = threading.Event()
@@ -195,8 +332,17 @@ class SparseReduceShard:
             rd.contrib[host_id] = (keys, rows)
             self._gc_locked()
 
-    def _pull(self, host_id: int, epoch: int, table: int
-              ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    def _pull(self, host_id: int, epoch: int, table: int,
+              coded: bool = False):
+        """One host's pull of a round.  Returns None while withheld;
+        else the merged ``(uids, rows)`` — or, with ``coded``, the
+        round's ``(ids_bytes, coded_section)`` wire bytes.  The coded
+        encode happens HERE, under the same lock hold that found the
+        round: the owner EF carry advances exactly once per round and
+        every host receives byte-identical codes — a GC racing between
+        the lookup and the encode (a straggler host vs the epoch-lag
+        reaper) can no longer re-encode through an already-advanced
+        carry."""
         bar = self._bar(epoch)
         with self._lock:
             rd = self._rounds.get((epoch, table))
@@ -215,8 +361,24 @@ class SparseReduceShard:
                 rd.merged = (uniq, merged)
                 rd.contrib.clear()
                 self._counts["rounds_merged"] += 1
+            if coded and rd.coded_section is None:
+                uniq, merged = rd.merged
+                carry = self._owner_carry.get(table)
+                if carry is None or carry.dim != merged.shape[1]:
+                    carry = self._owner_carry[table] = _EFCarry(
+                        merged.shape[1]
+                    )
+                carried = carry.get(uniq)
+                val = merged + carried
+                rd.coded_section, dec = wire.pack_codes_section(
+                    val, CODED_BITS
+                )
+                carry.set(uniq, val - dec)
+                rd.ids_bytes = wire.pack_ids(uniq)
+                self._counts["coded_rounds"] += 1
             self._counts["pulls"] += 1
-            out = rd.merged
+            out = ((rd.ids_bytes, rd.coded_section) if coded
+                   else rd.merged)
             rd.pulled.add(host_id)
             # REAL rounds are retained until the epoch-lag GC even after
             # every host pulled: a pull whose REPLY was lost to a
@@ -234,8 +396,99 @@ class SparseReduceShard:
             out = dict(self._counts)
             out["rounds_open"] = len(self._rounds)
             out["n_hosts"] = self.n_hosts
+            # undelivered owner-side EF mass per table: with the dynamic
+            # per-round range this stays sub-bucket noise (tested) — a
+            # growing number here means the codec is eating gradient
+            out["owner_ef_mass"] = {
+                str(t): round(c.mass(), 6)
+                for t, c in self._owner_carry.items()
+            }
         out["telemetry"] = self.registry.snapshot()
         return out
+
+    # -- grouped shared-id frames (ISSUE 13) --------------------------------
+
+    @staticmethod
+    def _split_group_header(buf: bytes):
+        """varint [G] + tables[G] + dims[G] -> (tables, dims, consumed)."""
+        g_hdr, used = wire.split_varint(buf, 1)
+        g = int(g_hdr[0])
+        if not 1 <= g <= 4096:
+            raise ValueError(f"group frame claims {g} tables")
+        tables, used2 = wire.split_varint(buf[used:], g)
+        dims, used3 = wire.split_varint(buf[used + used2:], g)
+        if (dims <= 0).any():
+            raise ValueError(f"group frame dims must be positive: {dims}")
+        return ([int(t) for t in tables], [int(d) for d in dims],
+                used + used2 + used3)
+
+    def _group_push(self, host_id: int, epoch: int, flags: int,
+                    buf: bytes) -> None:
+        """One grouped push: a shared tagged id stream + per-table value
+        sections — the ids of a (host, field group) ride the wire ONCE
+        and land as one contribution per table's round.  The WHOLE frame
+        decodes and validates (sections, trailing bytes) BEFORE the
+        first round mutates, matching the single-frame path's
+        reject-loudly-never-half-parse invariant — a malformed frame
+        must not count its host toward any round's bar."""
+        tables, dims, pos = self._split_group_header(buf)
+        keys, used = wire.split_ids(buf[pos:])
+        pos += used
+        if len(keys) > 1 and not (np.diff(keys) > 0).all():
+            raise ValueError("reduce push keys must be sorted unique")
+        sections = []
+        for table, dim in zip(tables, dims):
+            rows, used = _decode_section(buf[pos:], len(keys), dim, flags)
+            pos += used
+            sections.append((table, dim, rows))
+        if pos != len(buf):
+            raise ValueError(
+                f"group push frame length mismatch: consumed {pos} of "
+                f"{len(buf)} bytes"
+            )
+        for table, dim, rows in sections:
+            self._push(host_id, epoch, table, keys, rows, dim)
+
+    def _group_pull_reply(self, host_id: int, epoch: int, flags: int,
+                          buf: bytes) -> Optional[bytes]:
+        """One grouped pull: every listed table's round must be complete
+        (else WITHHELD — the client retries the whole group), the merged
+        unions must coincide (tables sharing a field group contribute
+        identical id streams by construction — anything else is a
+        protocol error, not a silent id/value misalignment), and the
+        reply ships the union ONCE with per-table value sections."""
+        tables, dims, _ = self._split_group_header(buf)
+        coded = bool(flags & FLAG_CODED)
+        outs = []
+        for table in tables:
+            out = self._pull(host_id, epoch, table, coded=coded)
+            if out is None:
+                return None
+            outs.append(out)
+        # tables of one field group contribute identical id streams by
+        # construction — anything else is a protocol error, not a silent
+        # id/value misalignment (coded rounds compare the cached id
+        # section bytes, which encode the union bijectively)
+        base = outs[0][0]
+        for table, out in zip(tables[1:], outs[1:]):
+            same = (out[0] == base if coded
+                    else np.array_equal(base, out[0]))
+            if not same:
+                raise ValueError(
+                    f"group pull unions diverge (table {table}): grouped "
+                    "tables must share one id stream"
+                )
+        if coded:
+            parts = [base] + [out[1] for out in outs]
+        else:
+            parts = [wire.pack_ids(base)]
+            for out in outs:
+                if flags & FLAG_F32:
+                    parts.append(np.ascontiguousarray(
+                        out[1], np.float32).tobytes())
+                else:
+                    parts.append(wire.pack_values(out[1])[0])
+        return b"".join(parts)
 
     # -- socket plumbing (the ps_server shape) ------------------------------
 
@@ -279,25 +532,46 @@ class SparseReduceShard:
                             host_id, epoch, table, dim, flags = (
                                 int(x) for x in hdr
                             )
-                            keys, rows = _decode_payload(
-                                payload[used:], dim, bool(flags & FLAG_F32)
-                            )
-                            if len(keys) > 1 and not \
-                                    (np.diff(keys) > 0).all():
-                                raise ValueError(
-                                    "reduce push keys must be sorted unique"
+                            if flags & FLAG_GROUP:
+                                self._group_push(host_id, epoch, flags,
+                                                 payload[used:])
+                            else:
+                                keys, rows = _decode_payload(
+                                    payload[used:], dim, flags
                                 )
-                            self._push(host_id, epoch, table, keys, rows,
-                                       dim)
+                                if len(keys) > 1 and not \
+                                        (np.diff(keys) > 0).all():
+                                    raise ValueError(
+                                        "reduce push keys must be sorted "
+                                        "unique"
+                                    )
+                                self._push(host_id, epoch, table, keys,
+                                           rows, dim)
                             conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                             sent = 6
                         elif msg_type == MSG_PULL:
-                            hdr, _ = wire.split_varint(payload, 5)
+                            hdr, used = wire.split_varint(payload, 5)
                             host_id, epoch, table, dim, flags = (
                                 int(x) for x in hdr
                             )
-                            out = self._pull(host_id, epoch, table)
-                            if out is None:
+                            if flags & FLAG_GROUP:
+                                body = self._group_pull_reply(
+                                    host_id, epoch, flags, payload[used:]
+                                )
+                            else:
+                                coded = bool(flags & FLAG_CODED)
+                                out = self._pull(host_id, epoch, table,
+                                                 coded=coded)
+                                if out is None:
+                                    body = None
+                                elif coded:
+                                    body = (bytes([wire.CODED_MAGIC])
+                                            + out[0] + out[1])
+                                else:
+                                    body = _encode_payload(
+                                        out[0], out[1], flags
+                                    )
+                            if body is None:
                                 # round incomplete: the SSP withheld byte,
                                 # the client retries with backoff
                                 conn.sendall(
@@ -305,9 +579,6 @@ class SparseReduceShard:
                                 )
                                 sent = 6
                             else:
-                                body = _encode_payload(
-                                    out[0], out[1], bool(flags & FLAG_F32)
-                                )
                                 conn.sendall(
                                     struct.pack("<IB", 1 + len(body), 0)
                                     + b"\x00" + body
@@ -380,9 +651,13 @@ class HierExchangeClient:
     shard that owns it without re-hashing.
 
     ``codec``: ``"f32"`` (default — exact, the dense-psum-exact branch
-    contract) or ``"f16"`` (the PS hot-path ``pack_rows`` frame, half the
-    value bytes).  ``pull_timeout_s`` bounds the withheld-retry loop — a
-    peer host that died mid-step must surface as an error, not a hang.
+    contract), ``"f16"`` (the PS hot-path ``pack_rows`` frame, half the
+    value bytes), or ``"q8_ef"`` (the quantile-coded error-feedback wire:
+    1-byte codes over a per-frame dynamic range, a member-side sparse EF
+    carry per table on the push side and the shard's owner-side carry on
+    pulls — module docstring).  ``pull_timeout_s`` bounds the
+    withheld-retry loop — a peer host that died mid-step must surface as
+    an error, not a hang.
     """
 
     #: withheld-pull backoff: start fast (the peer host is usually mid
@@ -395,7 +670,7 @@ class HierExchangeClient:
                  timeout: Optional[float] = None):
         if not addresses:
             raise ValueError("need at least one reduce shard address")
-        if codec not in ("f32", "f16"):
+        if codec not in ("f32", "f16", "q8_ef"):
             raise ValueError(f"unknown wire codec {codec!r}")
         self.addresses = [tuple(a) for a in addresses]
         self.n_shards = len(self.addresses)
@@ -403,6 +678,14 @@ class HierExchangeClient:
         self.n_hosts = int(n_hosts)
         self.codec = codec
         self.pull_timeout_s = float(pull_timeout_s)
+        # member-side EF carries, one sparse table-keyed carry per table
+        # (q8_ef only): last step's quantization error re-enters this
+        # step's encode, so coded mass is delivered late, never lost
+        self._carry: Dict[int, _EFCarry] = {}
+        # wire-level shared-id accounting: bytes the grouped frames did
+        # NOT ship because tables shared one id stream ((G-1) x the id
+        # section, push and pull alike) — metrics_report's dedup ratio
+        self.shared_id_saved_bytes = 0
         # PSClient as pure transport: dim is per-call in this protocol
         # (rides the header), so the stub's own dim is never consulted
         self.clients = [PSClient(a, dim=1, timeout=timeout)
@@ -418,21 +701,59 @@ class HierExchangeClient:
     def bytes_received(self) -> int:
         return sum(c.bytes_received for c in self.clients)
 
-    def _hdr(self, epoch: int, table: int, dim: int) -> bytes:
-        flags = FLAG_F32 if self.codec == "f32" else 0
+    def carry_mass(self) -> float:
+        """Total member-side undelivered EF mass (sum |carry| over
+        tables) — sub-bucket noise under the dynamic-range codec."""
+        return sum(c.mass() for c in self._carry.values())
+
+    def _carry_for(self, table: int, dim: int) -> _EFCarry:
+        carry = self._carry.get(table)
+        if carry is None or carry.dim != dim:
+            carry = self._carry[table] = _EFCarry(dim)
+        return carry
+
+    def _flags(self, exact: bool = False, group: bool = False) -> int:
+        if exact or self.codec == "f32":
+            flags = FLAG_F32
+        elif self.codec == "q8_ef":
+            flags = FLAG_CODED
+        else:
+            flags = 0
+        return flags | (FLAG_GROUP if group else 0)
+
+    def _hdr(self, epoch: int, table: int, dim: int, flags: int) -> bytes:
         return wire.pack_varint(np.array(
             [self.host_id, epoch, table, dim, flags], np.int64
         ))
 
     # -- the exchange -------------------------------------------------------
 
+    def _shard_of(self, uids: np.ndarray) -> np.ndarray:
+        return ((uids % self.n_shards).astype(np.int64) if len(uids)
+                else np.zeros(0, np.int64))
+
+    def _coded_body(self, table: int, uids: np.ndarray, rows: np.ndarray
+                    ) -> bytes:
+        """One shard partition's coded push frame: compensate from the
+        member carry, encode, carry the fresh quantization error — the
+        push-side EF recipe (shard partitions touch disjoint uid sets, so
+        per-partition encodes share one table-keyed carry safely)."""
+        carry = self._carry_for(table, rows.shape[1])
+        val = rows + carry.get(uids)
+        body, dec = wire.pack_rows_coded(uids, val, CODED_BITS)
+        carry.set(uids, val - dec)
+        return body
+
     def push(self, table: int, uids: np.ndarray, rows: np.ndarray,
-             epoch: int) -> None:
+             epoch: int, exact: bool = False) -> None:
         """Ship this host's merged (sorted-unique uids [n], rows [n, dim])
         contribution for round ``(epoch, table)``, owner-partitioned
         across the shards.  Every shard receives a frame (possibly empty —
         the round bar counts HOSTS, so a host whose batch touched no ids
-        owned by a shard must still check in there)."""
+        owned by a shard must still check in there).  ``exact=True``
+        forces the fp32 frame regardless of codec (the dense+loss
+        pseudo-table: the loss readout must not wobble with the wire
+        codec)."""
         uids = np.ascontiguousarray(uids, np.int64)
         rows = np.asarray(rows, np.float32)
         if rows.ndim != 2 or rows.shape[0] != len(uids):
@@ -443,15 +764,17 @@ class HierExchangeClient:
         dim = rows.shape[1]
         if len(uids) > 1 and not (np.diff(uids) > 0).all():
             raise ValueError("hier push uids must be sorted unique")
-        hdr = self._hdr(epoch, table, dim)
-        f32 = self.codec == "f32"
-        shard = (uids % self.n_shards).astype(np.int64) if len(uids) else \
-            np.zeros(0, np.int64)
+        flags = self._flags(exact)
+        hdr = self._hdr(epoch, table, dim, flags)
+        shard = self._shard_of(uids)
         with obs_trace.span("hier_client/push", n_keys=int(uids.size),
                             table=table, epoch=epoch):
             for s, c in enumerate(self.clients):
                 idx = np.flatnonzero(shard == s)
-                body = _encode_payload(uids[idx], rows[idx], f32)
+                if flags & FLAG_CODED:
+                    body = self._coded_body(table, uids[idx], rows[idx])
+                else:
+                    body = _encode_payload(uids[idx], rows[idx], flags)
                 reply = c._rpc(MSG_PUSH, hdr + body)
                 if reply != b"\x00":
                     raise ConnectionError(
@@ -459,44 +782,151 @@ class HierExchangeClient:
                         f"({epoch}, {table})"
                     )
 
-    def pull(self, table: int, epoch: int, dim: int
-             ) -> Tuple[np.ndarray, np.ndarray]:
-        """Fetch round ``(epoch, table)``'s cross-host merge: per shard,
-        retry withheld replies with capped backoff until the round
-        completes, then splice the shard unions into one globally sorted
-        (uids [U], rows [U, dim]) pair."""
-        hdr = self._hdr(epoch, table, dim)
-        f32 = self.codec == "f32"
-        keys_parts, rows_parts = [], []
-        with obs_trace.span("hier_client/pull", table=table, epoch=epoch):
+    def push_group(self, tables, uids: np.ndarray, rows_list,
+                   epoch: int) -> None:
+        """Grouped push for tables sharing ONE id stream (the same batch-
+        field tuple): the tagged id section rides each shard frame once
+        and every table contributes a value section referencing it by
+        position — the wire twin of the in-jit shared streams (PR 5).
+        ``rows_list[i]`` is table ``tables[i]``'s [n, dim_i] rows over the
+        SHARED sorted-unique ``uids``."""
+        tables = [int(t) for t in tables]
+        uids = np.ascontiguousarray(uids, np.int64)
+        rows_list = [np.asarray(r, np.float32) for r in rows_list]
+        if len(tables) != len(rows_list) or not tables:
+            raise ValueError("push_group needs one rows array per table")
+        for r in rows_list:
+            if r.ndim != 2 or r.shape[0] != len(uids):
+                raise ValueError(
+                    f"group rows must be [n_uids, dim], got {r.shape} "
+                    f"for {len(uids)} uids"
+                )
+        if len(uids) > 1 and not (np.diff(uids) > 0).all():
+            raise ValueError("hier push uids must be sorted unique")
+        dims = [r.shape[1] for r in rows_list]
+        flags = self._flags(group=True)
+        hdr = self._hdr(epoch, tables[0], dims[0], flags)
+        g_hdr = (wire.pack_varint(np.array([len(tables)], np.int64))
+                 + wire.pack_varint(np.array(tables, np.int64))
+                 + wire.pack_varint(np.array(dims, np.int64)))
+        shard = self._shard_of(uids)
+        with obs_trace.span("hier_client/push_group", n_keys=int(uids.size),
+                            tables=len(tables), epoch=epoch):
             for s, c in enumerate(self.clients):
-                deadline = time.monotonic() + self.pull_timeout_s
-                attempt = 0
-                while True:
-                    # a shard-side protocol error replies b"\xff", which
-                    # _rpc surfaces as ProtocolRejection (raised, never
-                    # retried here); only the WITHHELD byte b"\x01" loops
-                    reply = c._rpc(MSG_PULL, hdr)
-                    if reply[:1] == b"\x00":
-                        k, r = _decode_payload(reply[1:], dim, f32)
-                        keys_parts.append(k)
-                        rows_parts.append(r)
-                        break
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(
-                            f"reduce round ({epoch}, {table}) never "
-                            f"completed on shard {s} within "
-                            f"{self.pull_timeout_s}s (peer host down?)"
-                        )
-                    time.sleep(min(self.PULL_BACKOFF_CAP_S,
-                                   self.PULL_BACKOFF_BASE_S * (2 ** attempt)))
-                    attempt += 1
+                idx = np.flatnonzero(shard == s)
+                su = uids[idx]
+                ids_sec = wire.pack_ids(su)
+                self.shared_id_saved_bytes += \
+                    (len(tables) - 1) * len(ids_sec)
+                parts = [g_hdr, ids_sec]
+                for t, r in zip(tables, rows_list):
+                    sr = r[idx]
+                    if flags & FLAG_CODED:
+                        carry = self._carry_for(t, sr.shape[1])
+                        val = sr + carry.get(su)
+                        sec, dec = wire.pack_codes_section(val, CODED_BITS)
+                        carry.set(su, val - dec)
+                    elif flags & FLAG_F32:
+                        sec = np.ascontiguousarray(sr, np.float32).tobytes()
+                    else:
+                        sec = wire.pack_values(sr)[0]
+                    parts.append(sec)
+                reply = c._rpc(MSG_PUSH, hdr + b"".join(parts))
+                if reply != b"\x00":
+                    raise ConnectionError(
+                        f"reduce shard {s} refused group push for epoch "
+                        f"{epoch} tables {tables}"
+                    )
+
+    def _pull_one(self, c, s: int, hdr: bytes, what: str):
+        """One shard's pull with the withheld-retry loop -> reply body."""
+        deadline = time.monotonic() + self.pull_timeout_s
+        attempt = 0
+        while True:
+            # a shard-side protocol error replies b"\xff", which _rpc
+            # surfaces as ProtocolRejection (raised, never retried
+            # here); only the WITHHELD byte b"\x01" loops
+            reply = c._rpc(MSG_PULL, hdr)
+            if reply[:1] == b"\x00":
+                return reply[1:]
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"reduce round {what} never completed on shard {s} "
+                    f"within {self.pull_timeout_s}s (peer host down?)"
+                )
+            time.sleep(min(self.PULL_BACKOFF_CAP_S,
+                           self.PULL_BACKOFF_BASE_S * (2 ** attempt)))
+            attempt += 1
+
+    @staticmethod
+    def _splice(keys_parts, rows_parts, dim: int):
         keys = np.concatenate(keys_parts) if keys_parts else \
             np.zeros(0, np.int64)
         rows = np.concatenate(rows_parts) if rows_parts else \
             np.zeros((0, dim), np.float32)
         order = np.argsort(keys, kind="stable")
-        return keys[order], rows[order]
+        return keys[order], rows[order], order
+
+    def pull(self, table: int, epoch: int, dim: int, exact: bool = False
+             ) -> Tuple[np.ndarray, np.ndarray]:
+        """Fetch round ``(epoch, table)``'s cross-host merge: per shard,
+        retry withheld replies with capped backoff until the round
+        completes, then splice the shard unions into one globally sorted
+        (uids [U], rows [U, dim]) pair."""
+        flags = self._flags(exact)
+        hdr = self._hdr(epoch, table, dim, flags)
+        keys_parts, rows_parts = [], []
+        with obs_trace.span("hier_client/pull", table=table, epoch=epoch):
+            for s, c in enumerate(self.clients):
+                body = self._pull_one(c, s, hdr, f"({epoch}, {table})")
+                k, r = _decode_payload(body, dim, flags)
+                keys_parts.append(k)
+                rows_parts.append(r)
+        keys, rows, _ = self._splice(keys_parts, rows_parts, dim)
+        return keys, rows
+
+    def pull_group(self, tables, epoch: int, dims
+                   ) -> Tuple[np.ndarray, list]:
+        """Grouped pull: one request per shard fetches every listed
+        table's merged round behind ONE shared id stream -> (globally
+        sorted union uids [U], [rows_i [U, dim_i] per table]).  The
+        shard withholds until ALL the group's rounds complete."""
+        tables = [int(t) for t in tables]
+        dims = [int(d) for d in dims]
+        flags = self._flags(group=True)
+        hdr = self._hdr(epoch, tables[0], dims[0], flags)
+        req = (wire.pack_varint(np.array([len(tables)], np.int64))
+               + wire.pack_varint(np.array(tables, np.int64))
+               + wire.pack_varint(np.array(dims, np.int64)))
+        keys_parts = []
+        rows_parts = [[] for _ in tables]
+        with obs_trace.span("hier_client/pull_group", tables=len(tables),
+                            epoch=epoch):
+            for s, c in enumerate(self.clients):
+                body = self._pull_one(c, s, hdr + req,
+                                      f"({epoch}, group {tables})")
+                keys, pos = wire.split_ids(body)
+                self.shared_id_saved_bytes += (len(tables) - 1) * pos
+                keys_parts.append(keys)
+                for i, d in enumerate(dims):
+                    rows, used = _decode_section(
+                        body[pos:], len(keys), d, flags
+                    )
+                    pos += used
+                    rows_parts[i].append(rows)
+                if pos != len(body):
+                    raise ValueError(
+                        f"group pull reply length mismatch: consumed "
+                        f"{pos} of {len(body)} bytes"
+                    )
+        keys, rows0, order = self._splice(keys_parts, rows_parts[0],
+                                          dims[0])
+        out_rows = [rows0]
+        for i in range(1, len(tables)):
+            stacked = (np.concatenate(rows_parts[i]) if rows_parts[i]
+                       else np.zeros((0, dims[i]), np.float32))
+            out_rows.append(stacked[order])
+        return keys, out_rows
 
     def exchange(self, table: int, uids: np.ndarray, rows: np.ndarray,
                  epoch: int) -> Tuple[np.ndarray, np.ndarray]:
@@ -521,8 +951,11 @@ class HierExchangeClient:
         uids = np.arange(1, n + 1, dtype=np.int64) * self.n_shards  # shard 0
         rows = np.ones((n, dim), np.float32)
         c = self.clients[0]
-        flags = FLAG_F32 if self.codec == "f32" else 0
-        body = _encode_payload(uids, rows, bool(flags & FLAG_F32))
+        # the probe measures LINK speed: always the exact fp32 frame, so
+        # probe rounds never touch the EF carries and a coded config
+        # measures the same wire a flat config would
+        flags = FLAG_F32
+        body = _encode_payload(uids, rows, flags)
         ts = []
         for i in range(reps):
             hdr = wire.pack_varint(np.array(

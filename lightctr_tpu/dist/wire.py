@@ -183,6 +183,194 @@ def unpack_rows(buf: bytes, dim: int) -> Tuple[np.ndarray, np.ndarray, int]:
     return keys, rows, consumed + 2 * n_vals
 
 
+# -- quantile-coded row frames (the compressed DCN wire, ISSUE 13) -----------
+#
+# The hierarchical exchange's rendezvous rounds (dist/hier.py) shipped exact
+# fp32 over the slowest link in the topology.  The coded frame puts the
+# quantile codec of the in-jit collectives (ops/quantize — SparCML-style
+# sparse quantized streams, arXiv:1802.08021) on the socket wire:
+#
+#   ``pack_rows_coded``:  MAGIC ++ id section ++ value section
+#   value section:        u8 bits ++ f32 range ++ n*dim u8 codes
+#
+# The quantile table is the symmetric UNIFORM family parameterized by its
+# dynamic range — boundaries/values are derived deterministically on both
+# ends (:func:`coded_table`) instead of shipping 2^bits explicit edges, so
+# the per-frame table cost is 5 bytes.  Codes are one byte each (bits <= 8);
+# encode is ``searchsorted(boundaries, x, side='left')`` — the compare rule
+# of ``ops.quantize.compress`` / the fused ``quantize_pack`` kernel, here in
+# host numpy over the numpy-derived table (host peers only compare against
+# each other's bytes, so the contract that matters is that every host
+# derives the identical table from the shipped range).
+#
+# The id section carries its own 1-byte tag: delta-varint (the pack_keys
+# stream — sparse unions) or a range BITMAP (base + span + 1 bit/candidate —
+# DENSE unions, where consecutive deltas cost a full varint byte each but
+# 1/8th of that as bits; SparCML's index-bitmap switch).  The encoder picks
+# whichever is smaller, the decoder dispatches on the tag.
+#
+# Frames are TAGGED (a magic byte no old frame starts a payload with is
+# checked before any decode), so a coded frame reaching an old reader fails
+# loud rather than misparsing, and the old fp32/f16 frames are untouched —
+# the new reader parses them byte-identically (tested in
+# tests/test_wire_codec.py, the PR 3 trace-header interop discipline).
+
+#: first byte of every coded rows frame / grouped section stream
+CODED_MAGIC = 0xC3
+
+#: id-section tags
+ID_DELTA = 0    # pack_keys: n varint + zigzag delta varints
+ID_BITMAP = 1   # varint [n, base, span] + ceil(span/8) bitmap bytes (LSB0)
+
+#: dynamic-range headroom + floor, the same policy as the in-jit
+#: ``_coded_exchange`` (dist/collectives.py)
+CODED_RANGE_HEADROOM = 1.05
+CODED_RANGE_FLOOR = 1e-12
+
+
+def coded_table(rng: float, bits: int):
+    """(boundaries [2^bits - 1], values [2^bits]) of the symmetric uniform
+    quantile table over ``[-rng, rng]`` — numpy twin of
+    ``ops.quantize.build_table(-rng, rng, bits, mode='uniform')``, built
+    identically on encoder and decoder from the 4-byte range the frame
+    ships (both ends derive, neither trusts the other's arithmetic beyond
+    fp32 round-trip of ``rng`` itself)."""
+    n = 1 << int(bits)
+    edges = np.linspace(np.float32(-rng), np.float32(rng), n + 1,
+                        dtype=np.float64).astype(np.float32)
+    values = (0.5 * (edges[:-1].astype(np.float64)
+                     + edges[1:].astype(np.float64))).astype(np.float32)
+    return edges[1:-1], values
+
+
+def pack_ids(uids: np.ndarray) -> bytes:
+    """Tagged id section for a SORTED UNIQUE id stream: delta-varint or
+    range-bitmap, whichever is smaller (dense unions pack ~8x tighter as
+    bits; sparse ones as deltas)."""
+    u = np.ascontiguousarray(uids, np.int64).reshape(-1)
+    delta = pack_keys(u)
+    if u.size >= 2:
+        base = int(u[0])
+        span = int(u[-1]) - base + 1
+        n_bytes = (span + 7) // 8
+        hdr = pack_varint(np.array([u.size, base, span], np.int64))
+        if len(hdr) + n_bytes < len(delta):
+            bits = np.zeros(span, np.uint8)
+            bits[(u - base).astype(np.int64)] = 1
+            return bytes([ID_BITMAP]) + hdr + np.packbits(
+                bits, bitorder="little"
+            ).tobytes()
+    return bytes([ID_DELTA]) + delta
+
+
+def split_ids(buf: bytes) -> Tuple[np.ndarray, int]:
+    """Inverse of :func:`pack_ids` -> (sorted int64 uids, bytes consumed)."""
+    if not buf:
+        raise ValueError("empty id section")
+    tag = buf[0]
+    if tag == ID_DELTA:
+        keys, used = split_keys(buf[1:])
+        return keys, 1 + used
+    if tag == ID_BITMAP:
+        hdr, used = split_varint(buf[1:], 3)
+        n, base, span = (int(x) for x in hdr)
+        if n < 0 or span <= 0 or n > span:
+            raise ValueError(f"corrupt id bitmap header {(n, base, span)}")
+        n_bytes = (span + 7) // 8
+        body = buf[1 + used:1 + used + n_bytes]
+        if len(body) != n_bytes:
+            raise ValueError("truncated id bitmap")
+        bits = np.unpackbits(
+            np.frombuffer(body, np.uint8), count=span, bitorder="little"
+        )
+        uids = np.flatnonzero(bits).astype(np.int64) + base
+        if uids.size != n:
+            raise ValueError(
+                f"id bitmap popcount {uids.size} != declared n {n}"
+            )
+        return uids, 1 + used + n_bytes
+    raise ValueError(f"unknown id-section tag {tag:#x}")
+
+
+def pack_codes_section(vals: np.ndarray, bits: int = 8
+                       ) -> Tuple[bytes, np.ndarray]:
+    """Quantile-code one [n, dim] fp32 payload -> (section bytes, decoded
+    view).  Section: ``u8 bits ++ f32 range ++ n*dim u8 codes``.  The
+    decoded view is what every receiver will reconstruct — the caller's
+    error-feedback carry is ``vals - decoded`` (dist/hier.py).  Range is
+    dynamic per payload (max |val| with headroom + floor), so the encode
+    never clips and the EF carry stays sub-bucket."""
+    if not (1 <= int(bits) <= 8):
+        raise ValueError(f"coded wire sections carry <=8-bit codes, "
+                         f"got {bits}")
+    v = np.ascontiguousarray(vals, np.float32)
+    rng = float(max(CODED_RANGE_HEADROOM * float(np.max(np.abs(v)))
+                    if v.size else 0.0, CODED_RANGE_FLOOR))
+    rng = float(np.float32(rng))  # the frame ships fp32; derive from it
+    boundaries, values = coded_table(rng, bits)
+    codes = np.searchsorted(boundaries, v.reshape(-1),
+                            side="left").astype(np.uint8)
+    body = (bytes([int(bits)]) + np.float32(rng).tobytes()
+            + codes.tobytes())
+    return body, values[codes].reshape(v.shape).astype(np.float32)
+
+
+def unpack_codes_section(buf: bytes, n: int, dim: int
+                         ) -> Tuple[np.ndarray, int]:
+    """Inverse of :func:`pack_codes_section` -> ([n, dim] fp32 rows, bytes
+    consumed)."""
+    if len(buf) < 5:
+        raise ValueError("truncated coded value section")
+    bits = buf[0]
+    if not 1 <= bits <= 8:
+        raise ValueError(f"coded section claims {bits}-bit codes")
+    rng = float(np.frombuffer(buf[1:5], np.float32)[0])
+    if not np.isfinite(rng) or rng <= 0:
+        raise ValueError(f"coded section range {rng} is not positive finite")
+    need = int(n) * int(dim)
+    body = buf[5:5 + need]
+    if len(body) != need:
+        raise ValueError(
+            f"coded section carries {len(body)} codes for {need} values"
+        )
+    _, values = coded_table(rng, bits)
+    codes = np.frombuffer(body, np.uint8)
+    return values[codes].reshape(int(n), int(dim)).copy(), 5 + need
+
+
+def pack_rows_coded(uids: np.ndarray, vals: np.ndarray, bits: int = 8
+                    ) -> Tuple[bytes, np.ndarray]:
+    """ONE tagged coded frame for a sparse (uids, rows) payload -> (frame,
+    decoded view): MAGIC, the tagged id section, the quantile-coded value
+    section.  ``vals`` must already be EF-compensated when the caller
+    carries a residual; the decoded view is the receiver-side
+    reconstruction the fresh carry is computed against."""
+    u = np.ascontiguousarray(uids, np.int64).reshape(-1)
+    v = np.ascontiguousarray(vals, np.float32)
+    if v.ndim != 2 or v.shape[0] != u.size:
+        raise ValueError(
+            f"coded frame needs [n, dim] rows for {u.size} uids, "
+            f"got {v.shape}"
+        )
+    section, dec = pack_codes_section(v, bits)
+    return bytes([CODED_MAGIC]) + pack_ids(u) + section, dec
+
+
+def unpack_rows_coded(buf: bytes, dim: int
+                      ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Inverse of :func:`pack_rows_coded` -> (sorted int64 uids, [n, dim]
+    fp32 rows, bytes consumed).  Rejects loudly on a missing magic,
+    unknown tag, truncated id stream or short code section — a coded
+    frame must never half-parse."""
+    if not buf or buf[0] != CODED_MAGIC:
+        raise ValueError(
+            "not a coded rows frame (bad magic byte — fp32/f16 peer?)"
+        )
+    uids, used = split_ids(buf[1:])
+    rows, used2 = unpack_codes_section(buf[1 + used:], uids.size, dim)
+    return uids, rows, 1 + used + used2
+
+
 # -- prediction frames (serving plane, lightctr_tpu/serve) -------------------
 #
 # A predict request carries the CTR sparse-batch layout the models consume

@@ -45,6 +45,7 @@ from lightctr_tpu.native import bindings
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.embed.ssp import SSPGateMixin
+from lightctr_tpu.embed.write_log import WriteLogMixin
 from lightctr_tpu.obs.registry import MetricsRegistry
 
 STALENESS_THRESHOLD = 10  # kStalenessStepThreshold, paramserver.h:20
@@ -101,7 +102,7 @@ class _RowView:
                 yield k, self._arr()[slot]
 
 
-class AsyncParamServer(SSPGateMixin):
+class AsyncParamServer(SSPGateMixin, WriteLogMixin):
     """Sparse KV store with bounded-staleness async updates."""
 
     def __init__(
@@ -135,10 +136,6 @@ class AsyncParamServer(SSPGateMixin):
         self.eps = eps
         self._rng = np.random.default_rng(seed)
         self._lock = threading.Lock()
-        # write-log subscribers (the online-serving freshness plane) park
-        # on this condition until write_version moves — it shares the
-        # store lock, so a notify from _note_write is always owned
-        self._write_cond = threading.Condition(self._lock)
         # slot-contiguous storage + key->slot index
         self._slot: Dict[int, int] = {}
         # lazily-built (sorted_keys, slots) snapshot for vectorized lookup
@@ -186,87 +183,11 @@ class AsyncParamServer(SSPGateMixin):
         # moves — versioned invalidation with bounded staleness
         # (docs/SERVING.md), no per-row timestamps on the hot path
         self.write_version = 0
-        # per-key invalidation DELTAS: a bounded log of (version, touched
-        # uids) per bump, shipped in stats()["write_delta"] so the serving
-        # cache can drop ONLY the rows that actually changed instead of
-        # the whole cache.  Bounded two ways (entries and total uids);
-        # when a consumer's last-seen version predates the log's floor the
-        # delta no longer covers it and the consumer falls back to the
-        # full invalidation — correctness never rides on the log's depth.
-        self._write_log: list = []       # [(version, np.int64 uids)]
-        self._write_log_uids = 0
-        self._write_log_floor = 0        # log covers (floor, write_version]
-
-    #: write-delta log bounds: entries AND total logged uids — a stats
-    #: reply must stay a bounded control-plane payload no matter the
-    #: write pattern (overflow advances the floor; consumers whose last
-    #: observation predates the floor full-invalidate instead)
-    WRITE_LOG_MAX_ENTRIES = 128
-    WRITE_LOG_MAX_UIDS = 4096
-
-    def _note_write(self, keys: np.ndarray) -> None:
-        """Record the uids of one ``write_version`` bump (caller holds the
-        lock and has ALREADY bumped).  A superset of the truly-changed
-        keys is fine (the consumer merely drops a few extra cached rows);
-        a miss is not — every bump must either log or advance the floor.
-        Each entry carries the WALL time of the write, so a freshness
-        subscriber can report the age of the newest update it applied
-        (docs/ONLINE.md) without per-row timestamps on the hot path; and
-        every bump wakes the long-poll waiters parked in
-        :meth:`wait_write_delta`."""
-        arr = np.ascontiguousarray(keys, np.int64).reshape(-1)
-        self._write_log.append((self.write_version, arr, time.time()))
-        self._write_log_uids += int(arr.size)
-        while self._write_log and (
-                len(self._write_log) > self.WRITE_LOG_MAX_ENTRIES
-                or self._write_log_uids > self.WRITE_LOG_MAX_UIDS):
-            ver, dropped, _ts = self._write_log.pop(0)
-            self._write_log_uids -= int(dropped.size)
-            self._write_log_floor = ver
-        self._write_cond.notify_all()
-
-    def _delta_since_locked(self, since: int) -> Dict:
-        """The write-log delta one subscriber observation consumes (caller
-        holds the lock): every logged entry past ``since``, or — when the
-        floor has advanced beyond ``since`` — ``covered=False``, telling
-        the consumer its observation predates the log and only a full
-        invalidation is safe (correctness never rides on log depth)."""
-        covered = since >= self._write_log_floor
-        entries = (
-            [[int(v), u.tolist(), t] for v, u, t in self._write_log
-             if v > since]
-            if covered else []
-        )
-        return {
-            "write_version": self.write_version,
-            "floor": self._write_log_floor,
-            "covered": bool(covered),
-            "entries": entries,
-        }
-
-    def write_delta_since(self, since: int) -> Dict:
-        """Non-blocking form of :meth:`wait_write_delta`."""
-        with self._lock:
-            return self._delta_since_locked(int(since))
-
-    def wait_write_delta(self, since: int, timeout_s: float) -> Dict:
-        """LONG-POLL the write log: block until ``write_version`` moves
-        past ``since`` (or ``timeout_s`` elapses), then return the delta
-        record of :meth:`write_delta_since`.  The push-based freshness
-        primitive (docs/ONLINE.md): a serving replica parks here over
-        ``MSG_SUBSCRIBE`` and learns of a trained key one notify after
-        the push lands, instead of discovering it at the next version
-        poll.  The condition shares the store lock and the wait releases
-        it, so parked subscribers cost pushes one ``notify_all``."""
-        since = int(since)
-        deadline = time.monotonic() + max(0.0, float(timeout_s))
-        with self._write_cond:
-            while self.write_version <= since:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._write_cond.wait(remaining)
-            return self._delta_since_locked(since)
+        # per-key invalidation DELTAS (embed/write_log.py WriteLogMixin):
+        # a bounded log of (version, touched uids, write ts) per bump,
+        # shipped in stats()["write_delta"] and over MSG_SUBSCRIBE so the
+        # serving cache can drop ONLY the rows that actually changed
+        self._init_write_log(self._lock)
 
     # -- storage -----------------------------------------------------------
 
@@ -789,17 +710,11 @@ class AsyncParamServer(SSPGateMixin):
                 "evicted_keys": self.evicted_keys,
                 "write_version": self.write_version,
                 # per-key invalidation deltas (docs/SERVING.md): the
-                # bounded write log as [[version, [uids...]], ...] — a
+                # bounded write log as [[version, [uids...], ts], ...] — a
                 # consumer at version v >= floor drops only the uids of
                 # entries with version > v; below the floor it must drop
                 # everything (the log no longer covers it)
-                "write_delta": {
-                    "floor": self._write_log_floor,
-                    # [version, uids, write wall-time] triples: the ts lets
-                    # freshness consumers age the updates they apply
-                    "entries": [[int(v), u.tolist(), t]
-                                for v, u, t in self._write_log],
-                },
+                "write_delta": self._write_delta_record(),
                 "n_keys": len(self._slot),
                 # sorted-lookup snapshot health (async_ps._alloc_slots):
                 "pending_depth": len(self._pending),

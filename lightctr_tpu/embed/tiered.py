@@ -68,6 +68,7 @@ import numpy as np
 
 from lightctr_tpu.embed.ledger import FrequencyLedger
 from lightctr_tpu.embed.ssp import SSPGateMixin
+from lightctr_tpu.embed.write_log import WriteLogMixin
 from lightctr_tpu.embed.mmap_store import (
     MmapRowStore,
     sorted_delete,
@@ -236,7 +237,7 @@ TIER_SERIES = (
 )
 
 
-class TieredEmbeddingStore(SSPGateMixin):
+class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
     """Bounded-fast-tier sparse KV store with SSP async-update semantics.
 
     Drop-in for :class:`~lightctr_tpu.embed.async_ps.AsyncParamServer`
@@ -393,6 +394,11 @@ class TieredEmbeddingStore(SSPGateMixin):
         # two agree).
         self._total_keys = 0
         self.write_version = 0
+        # per-key write log (embed/write_log.py WriteLogMixin): the
+        # freshness surface MSG_SUBSCRIBE long-polls — tiered shards now
+        # serve push-based subscribers instead of rejecting them into
+        # the stats-polling degrade (the PR 11 follow-up)
+        self._init_write_log(self._lock)
         # fault-batch cache: the last miss batch's (sorted keys, payload,
         # origin, tier tickets, mutation epoch, valid mask).  A trainer's
         # push reuses the rows its own pull just read (the universal
@@ -1339,6 +1345,7 @@ class TieredEmbeddingStore(SSPGateMixin):
                     self._serve_misses(keys_arr[miss], slots[hit],
                                        grads=g[miss], admit=False)
                 self.write_version += 1
+                self._note_write(keys_arr)
             self._pushes_since_feed += 1
         return True
 
@@ -1426,6 +1433,7 @@ class TieredEmbeddingStore(SSPGateMixin):
                         rest_keys[cold_sel], payload[cold_sel]
                     )
             self.write_version += 1
+            self._note_write(keys_arr)
             self._mut_epoch += 1  # cached copies of preloaded keys stale
             self._note_occupancy(force=True)
 
@@ -1535,6 +1543,7 @@ class TieredEmbeddingStore(SSPGateMixin):
                 self.evicted_keys += n
                 self._total_keys -= n
                 self.write_version += 1
+                self._note_write(uniq[present])
                 self._mut_epoch += 1  # cached copies of evicted keys die
                 if obs_gate.enabled():
                     self.registry.inc("tiered_evicted_keys_total", n)
@@ -1609,6 +1618,10 @@ class TieredEmbeddingStore(SSPGateMixin):
                 "staleness_budget": self.staleness_threshold,
                 "evicted_keys": self.evicted_keys,
                 "write_version": self.write_version,
+                # the same bounded per-key delta record the flat store
+                # ships — the stats-polling freshness path reads it, and
+                # MSG_SUBSCRIBE long-polls the same log (WriteLogMixin)
+                "write_delta": self._write_delta_record(),
                 "n_keys": total,
                 "store": {
                     "kind": "tiered",

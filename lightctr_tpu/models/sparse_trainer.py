@@ -154,6 +154,10 @@ EXCHANGE_SERIES = (
     "trainer_dense_ring_bytes_total",
     "trainer_hier_wire_bytes_total",     # hierarchical: DCN hop, per host
     "trainer_hier_local_bytes_total",    # hierarchical: ICI merge hop
+    "trainer_hier_wire_packed_bytes_total",   # measured socket bytes/step
+    "trainer_hier_wire_fp32_bytes_total",     # fp32 equiv of same payload
+    "trainer_hier_wire_id_saved_bytes_total",  # shared-stream id savings
+    "trainer_hier_wire_ef_mass",         # gauge: member EF residual mass
     "trainer_rs_fallback_total",
     "trainer_rs_overflow_total",
 )
@@ -249,8 +253,8 @@ class SparseTableCTRTrainer(CTRTrainer):
                 )
             if compress_bits is not None:
                 raise ValueError(
-                    "hier_exchange is the exact exchange; the wire codec "
-                    "is the HierExchangeClient's knob (codec='f16'), "
+                    "hier_exchange owns its wire codec via the "
+                    "HierExchangeClient knob (codec='f16'/'q8_ef'); "
                     "compress_bits must stay None"
                 )
             self._hybrid_dp = False
@@ -296,6 +300,11 @@ class SparseTableCTRTrainer(CTRTrainer):
         self._hier_fb_local_bytes: Dict[str, int] = {}
         self._hier_last_local = False  # last step ran the ag fallback
         self._hier_wire_dense_bytes = 0
+        # per-step wire-codec honesty numbers (ISSUE 13): measured socket
+        # bytes, the fp32-equivalent of the same payload, shared-id savings
+        self._hier_wire_packed_bytes = 0
+        self._hier_wire_fp32_bytes = 0
+        self._hier_wire_id_saved = 0
         self._hier_local_j = None
         self._hier_local_ag_j = None
         self._hier_apply_j = None
@@ -1021,16 +1030,25 @@ class SparseTableCTRTrainer(CTRTrainer):
         return self._hier_local_ag_j
 
     @staticmethod
+    def _hier_strip_plan(uids: np.ndarray):
+        """The ONE copy of the wire-facing pad-strip convention ->
+        ``(real mask, sort order over the real entries)``: drop id-0
+        repeats beyond slot 0 — slot 0 survives whether id 0 is real or
+        the conventional fill (a zero row there is a no-op on both the
+        wire merge and the apply) — then sort globally (the
+        reduce-scatter local merge emits per-owner-sorted shards).
+        Tables sharing one id stream apply the same plan to each of
+        their row payloads."""
+        real = ~((uids == 0) & (np.arange(len(uids)) > 0))
+        order = np.argsort(uids[real], kind="stable")
+        return real, order
+
+    @staticmethod
     def _hier_strip_pads(uids: np.ndarray, rows: np.ndarray):
         """Collapse a dedup-convention (uids, rows) pair to its real
-        entries, globally sorted (the reduce-scatter local merge emits
-        per-owner-sorted shards): drop id-0 repeats beyond slot 0 — slot 0
-        survives whether id 0 is real or the conventional fill (a zero row
-        there is a no-op on both the wire merge and the apply)."""
-        real = ~((uids == 0) & (np.arange(len(uids)) > 0))
-        u, r = uids[real], rows[real]
-        order = np.argsort(u, kind="stable")
-        return u[order], r[order]
+        entries, globally sorted (:meth:`_hier_strip_plan`)."""
+        real, order = SparseTableCTRTrainer._hier_strip_plan(uids)
+        return uids[real][order], rows[real][order]
 
     @staticmethod
     def _hier_pad(uids: np.ndarray, rows: np.ndarray):
@@ -1051,13 +1069,20 @@ class SparseTableCTRTrainer(CTRTrainer):
         (apply the global mean).  The local reduce-scatter capacities are
         expected sizes with slack, so every batch is checked host-side
         first and a would-overflow batch runs the allgather local-merge
-        program instead — every branch stays exact."""
+        program instead — every branch stays exact.
+
+        The wire hop groups tables by batch-field tuple: tables sharing
+        one id stream produce the identical merged union, so their uids
+        ride the wire ONCE per (host, group) via the client's grouped
+        frames (push_group/pull_group) — the socket twin of the in-jit
+        shared streams.  The dense+loss pseudo-table always rides exact
+        fp32 whatever the codec (the loss readout must not wobble)."""
         from lightctr_tpu.dist.collectives import hier_wire_bytes
 
         client = self._hier_client
         n_local = self.mesh.shape["data"]
         total = n_local * client.n_hosts
-        wire_bits = None if client.codec == "f32" else 16
+        wire_bits = {"f32": None, "f16": 16, "q8_ef": 8}[client.codec]
         epoch = self._hier_epoch
         self._hier_epoch += 1
 
@@ -1071,39 +1096,77 @@ class SparseTableCTRTrainer(CTRTrainer):
             local = self._hier_local_ag()
         out_ids, out_rows, dense_flat, over = local(params, batch)
 
-        # -- the DCN hop: one merged payload per host.  All tables PUSH
+        # -- the DCN hop: one merged payload per host.  All groups PUSH
         # before any pull: each round's barrier is crossed while later
-        # tables' payloads are already in flight, so a step pays ~one
+        # groups' payloads are already in flight, so a step pays ~one
         # rendezvous round trip, not one per table --------------------------
         payload = {}
+        table_id = {k: ti for ti, k in enumerate(self._hier_tables)}
+        groups = self._field_groups(self._spec)
+        sock0 = client.bytes_sent + client.bytes_received
+        saved0 = client.shared_id_saved_bytes
+        fp32_equiv = 0
         with annotate("sparse_tables/hier_wire", tables=len(self._spec),
                       epoch=epoch):
-            pushed = {}
-            for ti, k in enumerate(self._hier_tables):
-                u = np.asarray(out_ids[k])
-                r = np.asarray(out_rows[k]).reshape(len(u), -1)
-                u, r = self._hier_strip_pads(u, r)
-                client.push(ti, u, r, epoch)
-                pushed[k] = (ti, len(u), r.shape[1])
-            # dense leaves + loss: positions as dim-1 rows on the same wire
+            pushed = []
+            for fields, keys in groups.items():
+                # one pad-strip/sort per GROUP (the stream's union is
+                # shared); per-table rows ride the same permutation
+                u = np.asarray(out_ids[keys[0]])
+                real, order = self._hier_strip_plan(u)
+                su = u[real][order]
+                rows_g = [
+                    np.asarray(out_rows[k]).reshape(len(u), -1)[real][order]
+                    for k in keys
+                ]
+                tids = [table_id[k] for k in keys]
+                dims = [r.shape[1] for r in rows_g]
+                if len(keys) == 1:
+                    client.push(tids[0], su, rows_g[0], epoch)
+                else:
+                    client.push_group(tids, su, rows_g, epoch)
+                pushed.append((keys, tids, dims, len(su)))
+            # dense leaves + loss: positions as dim-1 rows, exact fp32
             dvec = np.asarray(dense_flat, np.float32).reshape(-1, 1)
             client.push(self._HIER_DENSE_TABLE,
-                        np.arange(len(dvec), dtype=np.int64), dvec, epoch)
-            for k, (ti, k_out, dim) in pushed.items():
-                g_u, g_r = client.pull(ti, epoch, dim)
-                self.exchange_policy[k] = "hier"
-                self.exchange_bytes_per_step[k] = hier_wire_bytes(
-                    k_out, len(g_u), dim, wire_bits
-                )
-                pu, pr = self._hier_pad(
-                    g_u, g_r.reshape((len(g_u),)
-                                     + self.params[k].shape[1:]) / total
-                )
-                payload[k] = (jnp.asarray(pu), jnp.asarray(pr))
-            d_u, d_r = client.pull(self._HIER_DENSE_TABLE, epoch, 1)
+                        np.arange(len(dvec), dtype=np.int64), dvec, epoch,
+                        exact=True)
+            for keys, tids, dims, k_out in pushed:
+                if len(keys) == 1:
+                    g_u, rows_out = client.pull(tids[0], epoch, dims[0])
+                    rows_out = [rows_out]
+                else:
+                    g_u, rows_out = client.pull_group(tids, epoch, dims)
+                for i, k in enumerate(keys):
+                    self.exchange_policy[k] = "hier"
+                    # the byte model prices the coded codec at its real
+                    # wire_bits and the shared stream's ids ONCE per
+                    # group — the same accounting pick_exchange_algo uses
+                    self.exchange_bytes_per_step[k] = hier_wire_bytes(
+                        k_out, len(g_u), dims[i], wire_bits,
+                        include_ids=(i == 0),
+                    )
+                    fp32_equiv += hier_wire_bytes(k_out, len(g_u), dims[i])
+                    pu, pr = self._hier_pad(
+                        g_u, rows_out[i].reshape(
+                            (len(g_u),) + self.params[k].shape[1:]
+                        ) / total
+                    )
+                    payload[k] = (jnp.asarray(pu), jnp.asarray(pr))
+            d_u, d_r = client.pull(self._HIER_DENSE_TABLE, epoch, 1,
+                                   exact=True)
             self._hier_wire_dense_bytes = hier_wire_bytes(
-                len(dvec), len(d_u), 1, wire_bits
+                len(dvec), len(d_u), 1, None
             )
+            fp32_equiv += self._hier_wire_dense_bytes
+        # wire-codec honesty numbers for this step: measured socket bytes
+        # vs the fp32-equivalent of the same payload, the id bytes the
+        # shared streams did not ship, and the undelivered EF mass
+        self._hier_wire_packed_bytes = (
+            client.bytes_sent + client.bytes_received - sock0
+        )
+        self._hier_wire_fp32_bytes = fp32_equiv
+        self._hier_wire_id_saved = client.shared_id_saved_bytes - saved0
         dsum = d_r.reshape(-1) / total
         loss = float(dsum[-1])
         dense_mean = jnp.asarray(dsum[:-1], jnp.float32)
@@ -1383,6 +1446,19 @@ class SparseTableCTRTrainer(CTRTrainer):
             lb = (self._hier_fb_local_bytes if self._hier_last_local
                   else self.hier_local_bytes_per_step)
             reg.inc("trainer_hier_local_bytes_total", sum(lb.values()))
+            # wire-codec honesty (ISSUE 13): measured socket bytes vs the
+            # fp32-equivalent of the identical payload, the id bytes the
+            # shared streams saved, and the undelivered member-side EF
+            # mass — metrics_report --exchange renders compression and
+            # dedup ratios from exactly these
+            reg.inc("trainer_hier_wire_packed_bytes_total",
+                    self._hier_wire_packed_bytes)
+            reg.inc("trainer_hier_wire_fp32_bytes_total",
+                    self._hier_wire_fp32_bytes)
+            reg.inc("trainer_hier_wire_id_saved_bytes_total",
+                    self._hier_wire_id_saved)
+            reg.gauge_set("trainer_hier_wire_ef_mass",
+                          self._hier_client.carry_mass())
         # the pick is static post-trace: one ``exchange`` event per table
         # per PROGRAM, not one per step.  Primary and fallback decisions
         # log independently (a fallback first step must not be

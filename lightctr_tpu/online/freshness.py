@@ -10,10 +10,12 @@ push lands instead of at the next poll tick.
 
 Degrade ladder (freshness may degrade, correctness may not):
 
-  - a shard whose store lacks the write-log surface (e.g. today's tiered
-    store) answers the protocol-error byte -> the subscriber falls back
-    to ``MSG_STATS`` **polling** for that shard, consuming the same
-    ``write_delta`` record the poll path always used;
+  - a shard whose store lacks the write-log surface (both shipped stores
+    carry it since ISSUE 13 — this rung now covers only stores that
+    disabled it or pre-date the mixin) answers the protocol-error byte ->
+    the subscriber falls back to ``MSG_STATS`` **polling** for that
+    shard, consuming the same ``write_delta`` record the poll path
+    always used;
   - a reply whose log FLOOR advanced past this replica's observation
     (the subscriber fell off the bounded log) -> **full cache drop**,
     exactly as the polling path degrades;
@@ -21,11 +23,14 @@ Degrade ladder (freshness may degrade, correctness may not):
     re-arms from the shard's current version, another full drop).
 
 The subscriber also owns the freshness *measurement*: every applied
-write-log entry carries the server-stamped wall time of the write, so
-``age = now - newest applied write time`` is the number fed to the
-:class:`~lightctr_tpu.obs.health.FreshnessSLODetector` — the serving
-replica's ``/healthz`` degrades when serving lags training, whether the
-lag is a wedged subscriber or a stalled trainer.
+write-log entry carries the server-stamped wall time of the write AND
+every reply carries ``server_time`` — the server's clock at reply — so
+apply ages are computed SERVER-relative (``server_time - write ts``,
+both stamps from one clock) and cross-host wall-clock skew cancels
+instead of polluting the measurement (the PR 11 follow-up).  The number
+feeds the :class:`~lightctr_tpu.obs.health.FreshnessSLODetector` — the
+serving replica's ``/healthz`` degrades when serving lags training,
+whether the lag is a wedged subscriber or a stalled trainer.
 """
 
 from __future__ import annotations
@@ -204,6 +209,10 @@ class FreshnessSubscriber:
             "covered": "entries" in wd and (since is None
                                             or since >= floor),
             "entries": wd.get("entries", []),
+            # the server clock that stamped the entry ts values rides the
+            # write_delta record too, so the poll path ages updates
+            # server-relative exactly like the subscribe path
+            "server_time": wd.get("server_time"),
         }
 
     # -- applying deltas -----------------------------------------------------
@@ -260,22 +269,37 @@ class FreshnessSubscriber:
             uids: list = []
             applied = 0
             newest_ts = None
+            # apply ages are SERVER-relative when the reply carries the
+            # server clock (the same clock that stamped the entry ts
+            # values — cross-host wall-clock skew cancels); only an old
+            # server's reply falls back to comparing raw wall clocks
+            server_now = rep.get("server_time")
+            ref_now = float(server_now) if server_now is not None else now
             for entry in rep.get("entries", ()):
                 if int(entry[0]) <= prev:
                     continue
                 uids.extend(entry[1])
-                ts = float(entry[2]) if len(entry) > 2 else now
+                ts = float(entry[2]) if len(entry) > 2 else ref_now
                 newest_ts = ts if newest_ts is None else max(newest_ts, ts)
                 applied += 1
                 if telem:
                     self.registry.observe(
                         "serve_freshness_apply_age_seconds",
-                        max(0.0, now - ts),
+                        max(0.0, ref_now - ts),
                     )
             dropped = self.cache.apply_delta(version, uids)
             self.applied_entries += applied
             self.dropped_rows += dropped
-            self._last_update_ts = newest_ts if newest_ts is not None else now
+            # _last_update_ts lives on the LOCAL clock (age_s compares it
+            # to local time.time()): translate the newest write's
+            # server-relative age into local terms instead of storing a
+            # remote wall clock verbatim
+            if newest_ts is None:
+                self._last_update_ts = now
+            elif server_now is not None:
+                self._last_update_ts = now - max(0.0, ref_now - newest_ts)
+            else:
+                self._last_update_ts = newest_ts
         if telem:
             self.registry.inc(
                 "serve_freshness_deltas_applied_total", applied)

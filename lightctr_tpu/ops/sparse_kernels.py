@@ -608,6 +608,32 @@ def _qp_flatten(table, x):
     return bnd.reshape(1, nbp), flat, bc, nbp, dtype
 
 
+def _qp_search_kernel(bnd_ref, x_ref, codes_ref, *, nbp, nb):
+    """VMEM binary search: ``searchsorted(boundaries, x, side='left')``
+    over a +inf-padded power-of-two boundary table — log2(nbp)+1 gathers
+    per element instead of the compare-count sweep's nbp compares, which
+    is what makes 16-bit tables (65535 boundaries) worth VPU time.  The
+    branchless count-of-strictly-less form: at each static halving step
+    ``pos`` advances past the half whose last boundary is below x; the
+    +inf padding never counts, so the result is capped at ``nb`` by
+    construction."""
+    pl, _ = pallas_modules()
+    x = x_ref[...]                                         # [bp, 1]
+    bnd = bnd_ref[0, :]                                    # [nbp]
+    pos = jnp.zeros(x.shape, jnp.int32)
+    sz = nbp
+    while sz > 1:                                          # static unroll
+        half = sz // 2
+        probe = jnp.take(bnd, (pos + (half - 1)).reshape(-1),
+                         axis=0).reshape(x.shape)
+        pos = jnp.where(probe < x, pos + half, pos)
+        sz -= half
+    last = jnp.take(bnd, pos.reshape(-1), axis=0).reshape(x.shape)
+    pos = pos + (last < x).astype(jnp.int32)
+    del nb  # the +inf padding already bounds pos
+    codes_ref[...] = pos.astype(codes_ref.dtype)
+
+
 def _qp_pallas(table, x: jax.Array, *, interpret: bool):
     pl, _ = pallas_modules()
     bnd, flat, bc, nbp, dtype = _qp_flatten(table, x)
@@ -616,8 +642,22 @@ def _qp_pallas(table, x: jax.Array, *, interpret: bool):
     pp = -(-p // bp) * bp
     if pp != p:
         flat = jnp.pad(flat, ((0, pp - p), (0, 0)))
+    if table.bits > 8:
+        # wide tables: the VMEM binary-search kernel (a 2^16 boundary
+        # table is 256KB of VMEM; the compare-count sweep would pay
+        # 65535 compares per element where the search pays 17 gathers)
+        nbp2 = 1 << (int(table.boundaries.shape[0]) - 1).bit_length()
+        bnd2 = table.boundaries.astype(jnp.float32)
+        if nbp2 != bnd2.shape[0]:
+            bnd2 = jnp.pad(bnd2, (0, nbp2 - bnd2.shape[0]),
+                           constant_values=jnp.inf)
+        kernel = partial(_qp_search_kernel, nbp=nbp2,
+                         nb=int(table.boundaries.shape[0]))
+        bnd, nbp = bnd2.reshape(1, nbp2), nbp2
+    else:
+        kernel = partial(_qp_kernel, nbp=nbp, bc=bc, code_bits=table.bits)
     codes = pl.pallas_call(
-        partial(_qp_kernel, nbp=nbp, bc=bc, code_bits=table.bits),
+        kernel,
         grid=(pp // bp,),
         out_shape=jax.ShapeDtypeStruct((pp, 1), dtype),
         in_specs=[
@@ -633,13 +673,10 @@ def _qp_pallas(table, x: jax.Array, *, interpret: bool):
 def quantize_pack(table, x: jax.Array) -> jax.Array:
     """Dispatch: float payload -> quantile codes, bit-identical to
     ``ops.quantize.compress`` (the wire pack every coded collective hop
-    ships).  The Pallas variant covers codes up to 8 bits (the compare-
-    count sweep over a 16-bit table's 65535 boundaries is not worth VPU
-    time); wider codes resolve to the reference."""
-    impl = None
-    if table.bits > 8 and resolve_impl("quantize_pack") != "xla":
-        impl = "xla"
-    _, fn = _resolve("quantize_pack", impl=impl)
+    ships).  Codes up to 8 bits ride the compare-count sweep; wider
+    tables (16-bit) ride the VMEM binary-search kernel
+    (:func:`_qp_search_kernel`) instead of resolving to the reference."""
+    _, fn = _resolve("quantize_pack")
     return fn(table, x)
 
 
@@ -748,6 +785,155 @@ def quantize_pack_ef(table, rows: jax.Array, carried: jax.Array,
     return fn(table, rows, carried, mask)
 
 
+def _qp_ef_update_reference(table, rows, uids, residual, mask):
+    """The caller-side EF sequence the folded kernel replaces: gather the
+    carry, compensate, encode, decode, scatter the fresh error back at
+    the rows' slots — the ``residual.at[uids].add(delta)`` pass every EF
+    call site used to run separately.  The decoded view rides along so
+    callers needing it (the rs overflow-drop correction) pay no second
+    ``extract`` pass."""
+    from lightctr_tpu.ops import quantize
+
+    carried = jnp.take(residual, uids, axis=0)
+    val = rows + carried * mask
+    codes = quantize.compress(table, val)
+    dec = quantize.extract(table, codes)
+    new_residual = residual.at[uids].add((val - dec - carried) * mask)
+    return codes, new_residual, dec
+
+
+def _qp_ef_update_kernel(uids_ref, bnd_ref, vals_ref, rows_ref, mask_ref,
+                         res_ref, codes_ref, res_out, dec_ref, *, s, nbp,
+                         bc, nvp, vc):
+    """Folded EF pack: per grid step one payload row — the scalar-
+    prefetched uid steers the (1, dim) residual window (the merge_apply
+    gather pattern), so compensate / encode (compare-count) / decode
+    (chunked one-hot) / fresh-error / CARRY WRITE-BACK are one pass and
+    the residual scatter never runs as a separate HLO.  Padded slots
+    (mask 0) write their carry window back unchanged — an identity
+    revisit, safe under either aliasing semantics; the caller still
+    rotates original slot 0 last (the merge_apply contract) so the one
+    real write of a multiply-visited row lands unmasked."""
+    pl, _ = pallas_modules()
+    r = rows_ref[...]                                      # [1, d]
+    m = mask_ref[...]                                      # [1, 1]
+    car = res_ref[...]                                     # [1, d]
+    val = r + car * m
+
+    def cbody(c, acc):
+        bb = bnd_ref[0, pl.ds(c * bc, bc)]                 # [bc]
+        return acc + jnp.sum(
+            (val.reshape(-1, 1) > bb).astype(jnp.int32), axis=1,
+        ).reshape(val.shape)
+
+    codes = jax.lax.fori_loop(0, nbp // bc, cbody,
+                              jnp.zeros(val.shape, jnp.int32))
+
+    def dbody(c, dec):
+        vv = vals_ref[0, pl.ds(c * vc, vc)]                # [vc]
+        idx = c * vc + jax.lax.broadcasted_iota(
+            jnp.int32, (val.shape[1], vc), 1
+        )
+        sel = (codes.reshape(-1, 1) == idx).astype(jnp.float32)
+        return dec + jnp.sum(vv * sel, axis=1).reshape(val.shape)
+
+    dec = jax.lax.fori_loop(0, nvp // vc, dbody,
+                            jnp.zeros(val.shape, jnp.float32))
+    codes_ref[...] = codes.astype(codes_ref.dtype)
+    res_out[...] = car + (val - dec - car) * m
+    dec_ref[...] = dec
+    del s
+
+
+def _qp_ef_update_pallas(table, rows, uids, residual, mask,
+                         *, interpret: bool):
+    pl, pltpu = pallas_modules()
+    s = rows.shape[0]
+    d = int(np.prod(rows.shape[1:])) if rows.ndim > 1 else 1
+    vocab = residual.shape[0]
+    flat = rows.reshape(s, d).astype(jnp.float32)
+    res2 = residual.reshape(vocab, d).astype(jnp.float32)
+    msk = jnp.broadcast_to(
+        jnp.asarray(mask, jnp.float32).reshape(s, -1)[:, :1], (s, 1)
+    )
+    nb = int(table.boundaries.shape[0])
+    bc = min(256, max(8, nb))
+    nbp = -(-nb // bc) * bc
+    bnd = table.boundaries.astype(jnp.float32)
+    if nbp != nb:
+        bnd = jnp.pad(bnd, (0, nbp - nb), constant_values=jnp.inf)
+    nv = int(table.values.shape[0])
+    vc = min(256, max(8, nv))
+    nvp = -(-nv // vc) * vc
+    vals = table.values.astype(jnp.float32)
+    if nvp != nv:
+        vals = jnp.pad(vals, (0, nvp - nv))
+    # rotate original slot 0 to run LAST (see _apply_kernel): pad
+    # revisits of a shared uid-0 window must precede the one real write
+    uids_r = jnp.roll(uids.astype(jnp.int32), -1)
+    flat_r = jnp.roll(flat, -1, axis=0)
+    msk_r = jnp.roll(msk, -1, axis=0)
+    dtype = jnp.uint8 if table.bits <= 8 else jnp.uint16
+    spec_seq = pl.BlockSpec((1, d), lambda i, u: (i, 0))
+    spec_seq1 = pl.BlockSpec((1, 1), lambda i, u: (i, 0))
+    spec_bnd = pl.BlockSpec((1, nbp), lambda i, u: (0, 0))
+    spec_val = pl.BlockSpec((1, nvp), lambda i, u: (0, 0))
+    spec_row = pl.BlockSpec((1, d), lambda i, u: (u[i], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s,),
+        in_specs=[spec_bnd, spec_val, spec_seq, spec_seq1, spec_row],
+        out_specs=[spec_seq, spec_row, spec_seq],
+    )
+    codes_r, new_res, dec_r = pl.pallas_call(
+        partial(_qp_ef_update_kernel, s=s, nbp=nbp, bc=bc, nvp=nvp, vc=vc),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((s, d), dtype),
+            jax.ShapeDtypeStruct((vocab, d), jnp.float32),
+            jax.ShapeDtypeStruct((s, d), jnp.float32),
+        ),
+        input_output_aliases={5: 1},
+        interpret=interpret,
+    )(uids_r, bnd.reshape(1, nbp), vals.reshape(1, nvp), flat_r, msk_r,
+      res2)
+    codes = jnp.roll(codes_r, 1, axis=0)
+    dec = jnp.roll(dec_r, 1, axis=0)
+    return (codes.reshape(rows.shape),
+            new_res.reshape(residual.shape).astype(residual.dtype),
+            dec.reshape(rows.shape))
+
+
+def quantize_pack_ef_update(table, rows: jax.Array, uids: jax.Array,
+                            residual: jax.Array, mask: jax.Array):
+    """Dispatch: EF pack with the residual scatter FOLDED IN ->
+    ``(codes, new_residual, dec)`` — ``dec`` is the receiver-side
+    decoded view, computed inside the pass anyway and returned so
+    callers that need it (the rs overflow-drop correction) pay no
+    second ``extract``.  ``rows`` [S, ...] follow the dedup
+    convention with ``uids`` [S] naming their table slots; ``residual``
+    is the [vocab, ...] table-keyed carry and ``mask`` the validity mask
+    over slots (pads must neither read nor write the carry).  One pass
+    computes ``val = rows + residual[uids]*mask``, the codes, the decode
+    and writes ``residual[uids] += (val - dec - carried) * mask`` in
+    place — the carry update that every call site used to run as a
+    separate gather + scatter (the PR 9 follow-up).  ``uids``/``mask``
+    MUST honor the dedup convention — at most one UNMASKED slot per uid
+    (the pallas impl writes windows where the reference accumulates, so
+    duplicate unmasked slots would diverge).  8-bit-and-under codes take
+    the Pallas path; wider tables resolve to the reference (the chunked
+    one-hot decode over 2^16 values is not worth VPU time)."""
+    if rows.shape[0] == 0:
+        dtype = jnp.uint8 if table.bits <= 8 else jnp.uint16
+        return (jnp.zeros(rows.shape, dtype), residual,
+                jnp.zeros(rows.shape, jnp.float32))
+    impl = None
+    if table.bits > 8 and resolve_impl("quantize_pack_ef_update") != "xla":
+        impl = "xla"
+    _, fn = _resolve("quantize_pack_ef_update", impl=impl)
+    return fn(table, rows, uids, residual, mask)
+
+
 register_kernel("dedup_ids", phase="dedup",
                 reference=_dedup_reference, pallas=_dedup_pallas)
 register_kernel("merge_rows", phase="merge",
@@ -758,3 +944,6 @@ register_kernel("quantize_pack", phase="pack",
                 reference=_qp_reference, pallas=_qp_pallas)
 register_kernel("quantize_pack_ef", phase="pack",
                 reference=_qp_ef_reference, pallas=_qp_ef_pallas)
+register_kernel("quantize_pack_ef_update", phase="pack",
+                reference=_qp_ef_update_reference,
+                pallas=_qp_ef_update_pallas)

@@ -910,6 +910,11 @@ def test_metrics_report_exchange_section(tmp_path, capsys):
     reg.inc("trainer_hier_local_bytes_total", 12000)
     reg.inc("trainer_sparse_rs_bytes_total", 900)
     reg.inc("trainer_rs_fallback_total", 1)
+    # wire-codec honesty counters (ISSUE 13)
+    reg.inc("trainer_hier_wire_packed_bytes_total", 1000)
+    reg.inc("trainer_hier_wire_fp32_bytes_total", 4500)
+    reg.inc("trainer_hier_wire_id_saved_bytes_total", 250)
+    reg.gauge_set("trainer_hier_wire_ef_mass", 0.125)
     path = tmp_path / "snap.json"
     path.write_text(json.dumps(reg.snapshot()))
     assert metrics_report.main(["--exchange", str(path)]) == 0
@@ -923,6 +928,13 @@ def test_metrics_report_exchange_section(tmp_path, capsys):
     assert report["rs_fallback_steps"] == 1
     assert report["hier_active"] is True
     assert report["hier_local_to_wire_x"] == 4.0
+    codec = report["wire_codec"]
+    assert codec["packed_bytes"] == 1000
+    assert codec["fp32_equiv_bytes"] == 4500
+    assert codec["compression_x"] == 4.5
+    assert codec["shared_id_saved_bytes"] == 250
+    assert codec["shared_id_dedup_x"] == 1.25
+    assert codec["ef_residual_mass"] == 0.125
 
 
 # -- online plane telemetry lints + report (ISSUE 11) ------------------------

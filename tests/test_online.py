@@ -122,6 +122,125 @@ def test_subscribe_floor_overflow_reports_uncovered():
         svc.close()
 
 
+def test_tiered_store_serves_subscriptions(tmp_path, rng):
+    """The tiered store grew the write-log surface (ISSUE 13, PR 11
+    follow-up): MSG_SUBSCRIBE long-polls a tiered shard instead of being
+    rejected into the stats-polling degrade — pushes, preloads and
+    evictions all land in the delta, and a live FreshnessSubscriber stays
+    in ``subscribe`` mode against it."""
+    from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+    store = TieredEmbeddingStore(
+        dim=ROW_DIM, hot_rows=16, path=str(tmp_path / "sub" / "store"),
+        updater="adagrad", n_workers=1, seed=0,
+    )
+    svc = ParamServerService(store)
+    cli = PSClient(svc.address, ROW_DIM, timeout=10.0)
+    try:
+        rep = cli.subscribe_deltas(1 << 62, timeout_ms=0)  # arm: no wait
+        assert rep["covered"] and rep["entries"] == []
+        assert "server_time" in rep
+        since = rep["write_version"]
+        cli.push_arrays(0, np.array([7, 9], np.int64),
+                        np.ones((2, ROW_DIM), np.float32), worker_epoch=0)
+        rep = cli.subscribe_deltas(since, timeout_ms=2000)
+        assert rep["covered"]
+        (ver, uids, _ts), = [e for e in rep["entries"] if e[0] > since]
+        assert uids == [7, 9] and ver == since + 1
+        # eviction invalidates through the same log (a migrated-away key
+        # must not survive as a stale cached row)
+        store.evict_batch(np.array([7], np.int64))
+        rep = cli.subscribe_deltas(ver, timeout_ms=2000)
+        assert [7] in [e[1] for e in rep["entries"]]
+        # the stats record carries the same shape (the poll degrade path)
+        wd = cli.stats()["write_delta"]
+        assert wd["entries"] and "server_time" in wd
+
+        # a live subscriber against the tiered shard: arms, stays in
+        # subscribe mode, applies per-key deltas — no stats_poll degrade
+        params = fm.init(jax.random.PRNGKey(5), F, K)
+        keys, rows = serve.fused_fm_rows(params)
+        cli.preload_arrays(keys, rows)
+        srv = _ps_backed_server(svc)
+        sub = online.FreshnessSubscriber(
+            srv, [svc.address], ROW_DIM, slo_s=30.0, poll_ms=300,
+        ).start()
+        pc = None
+        try:
+            _wait(lambda: sub.stats()["versions"][0] >= 0, 5,
+                  "subscriber arm on tiered shard")
+            assert sub.stats()["modes"][0] == "subscribe"
+            pc = serve.PredictClient(srv.address)
+            b = _batch(rng, n=4)
+            pc.predict(b)
+            n0 = len(srv.cache)
+            assert n0 > 1
+            victim = int(np.unique(b["fids"])[0])
+            cli.push_arrays(0, np.array([victim], np.int64),
+                            np.zeros((1, ROW_DIM), np.float32),
+                            worker_epoch=1)
+            _wait(lambda: len(srv.cache) == n0 - 1, 5,
+                  "tiered push-based delta drop")
+            assert sub.stats()["modes"][0] == "subscribe"
+        finally:
+            if pc is not None:
+                pc.close()
+            sub.stop()
+            srv.close()
+    finally:
+        cli.close()
+        svc.close()
+        store.close()
+
+
+def test_apply_age_is_server_relative_under_clock_skew(rng):
+    """Cross-host clock skew must cancel out of the freshness
+    measurement (ISSUE 13, PR 11 follow-up): entry write-times and the
+    reply's ``server_time`` come from ONE clock, so a server whose wall
+    clock runs 1000s behind this host must still report ~0.25s apply
+    ages — not the 1000s a raw wall-clock comparison would."""
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = _ps_backed_server(svc)
+    sub = online.FreshnessSubscriber(
+        srv, [svc.address], ROW_DIM, slo_s=30.0,
+    )  # NOT started: replies are injected directly
+    try:
+        skew = 1000.0  # server clock BEHIND local by 1000s
+        t_srv = time.time() - skew
+        sub._apply(0, {"write_version": 5, "floor": 0, "covered": True,
+                       "entries": [], "server_time": t_srv})
+        sub._apply(0, {
+            "write_version": 6, "floor": 0, "covered": True,
+            "entries": [[6, [int(keys[0])], t_srv]],
+            "server_time": t_srv + 0.25,
+        })
+        age = sub.age_s()
+        assert age is not None and age < 5.0, (
+            f"apply age {age}s — a skew-uncorrected measurement would "
+            "read ~1000s"
+        )
+        h = srv.registry.snapshot()["histograms"].get(
+            "serve_freshness_apply_age_seconds"
+        )
+        if h:  # telemetry gate on in the test env
+            assert h["sum"] < 5.0, h
+        # an OLD server's reply (no server_time) keeps the legacy
+        # raw-wall-clock behavior rather than crashing
+        sub._apply(0, {"write_version": 7, "floor": 0, "covered": True,
+                       "entries": [[7, [int(keys[0])], time.time()]]})
+        assert sub.age_s() < 5.0
+    finally:
+        sub.stop()
+        srv.close()
+        admin.close()
+        svc.close()
+
+
 # -- the freshness subscriber ------------------------------------------------
 
 

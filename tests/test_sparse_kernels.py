@@ -217,16 +217,76 @@ def test_quantize_pack_bit_identical_to_codec(rng):
                                       err_msg=mode)
 
 
-def test_quantize_pack_16bit_resolves_to_reference(monkeypatch):
-    """The compare-count sweep is gated to <= 8-bit tables: a 16-bit
-    table dispatches the reference even when pallas is forced."""
+def test_quantize_pack_16bit_rides_vmem_binary_search(monkeypatch, rng):
+    """Wide tables no longer resolve to the reference (the PR 9
+    follow-up): a 16-bit table dispatches the VMEM binary-search kernel
+    and its codes are bit-identical to ``quantize.compress`` — clip
+    edges, exact-boundary hits and out-of-range values included."""
     monkeypatch.setenv(sk.ENV_FLAG, "interpret")
-    t = quantize.build_table(-1.0, 1.0, bits=16)
-    x = jnp.asarray(np.linspace(-1.5, 1.5, 31, dtype=np.float32))
-    got = sk.quantize_pack(t, x)
-    np.testing.assert_array_equal(np.asarray(got),
-                                  np.asarray(quantize.compress(t, x)))
-    assert got.dtype == jnp.uint16
+    for bits, mode in ((16, "uniform"), (16, "log"), (12, "uniform")):
+        t = quantize.build_table(-1.0, 1.0, bits=bits, mode=mode)
+        x = jnp.asarray(np.concatenate([
+            np.linspace(-1.5, 1.5, 31, dtype=np.float32),
+            np.asarray(t.boundaries)[:7],          # exact boundary hits
+            np.array([0.0, -0.0, 1e-9], np.float32),
+        ]))
+        got = sk.quantize_pack(t, x)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(quantize.compress(t, x)),
+            err_msg=f"bits={bits} mode={mode}",
+        )
+        assert got.dtype == jnp.uint16
+    # the dispatch records the interpret path, not an xla downgrade
+    from lightctr_tpu import obs
+
+    reg = obs.default_registry()
+    key = obs.labeled("trainer_kernel_path_total",
+                      phase="pack", impl="interpret")
+    before = reg.snapshot()["counters"].get(key, 0)
+    sk.quantize_pack(quantize.build_table(-1.0, 1.0, bits=16),
+                     jnp.zeros((8,), jnp.float32))
+    after = reg.snapshot()["counters"].get(key, 0)
+    assert after == before + 1
+
+
+def test_quantize_pack_ef_update_folds_the_residual_scatter(rng):
+    """The folded EF pack (PR 9 follow-up): codes AND the written-back
+    residual are bit-identical to the reference gather / compensate /
+    encode / decode / scatter chain — including a real id 0 at slot 0,
+    padded repeats that must leave their carry untouched, and untouched
+    rows that must keep theirs."""
+    t = quantize.build_table(-1.0, 1.0, bits=8)
+    vocab, dim, s = 96, 5, 24
+    u = np.unique(rng.integers(1, vocab, 17)).astype(np.int32)
+    uids = np.zeros(s, np.int32)
+    uids[:u.size] = u
+    rows = (0.6 * rng.normal(size=(s, dim))).astype(np.float32)
+    rows[u.size:] = 0.0
+    residual = (0.2 * rng.normal(size=(vocab, dim))).astype(np.float32)
+    for real_id0 in (False, True):
+        if real_id0:
+            # the dedup convention with a REAL id 0: sorted unique ids
+            # (0 first), pads repeat id 0 beyond the real entries
+            reals = np.sort(np.concatenate([[0], u[:12]])).astype(np.int32)
+            uu = np.zeros(s, np.int32)
+            uu[:reals.size] = reals
+            rr = rows.copy()
+            rr[reals.size:] = 0.0
+        else:
+            uu, rr = uids, rows
+        mask = (~((uu == 0) & (np.arange(s) > 0))).astype(
+            np.float32).reshape(-1, 1)
+        args = (t, jnp.asarray(rr), jnp.asarray(uu),
+                jnp.asarray(residual), jnp.asarray(mask))
+        c0, r0, d0 = sk.KERNELS["quantize_pack_ef_update"].reference(*args)
+        c1, r1, d1 = sk.KERNELS["quantize_pack_ef_update"].pallas(
+            *args, interpret=True)
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c0))
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+        untouched = np.setdiff1d(np.arange(vocab), uu)
+        np.testing.assert_array_equal(np.asarray(r1)[untouched],
+                                      residual[untouched])
 
 
 def test_quantize_pack_ef_bit_identical(rng):

@@ -268,6 +268,21 @@ def test_cost_model_hier_predicted_bytes_match_payload_shapes():
             bw=LinkBandwidth(4e9, 1e7, "env"),
         )
         assert (algo, b) == ("hier", wire_b)
+        # the CODED wire (ISSUE 13): wire_bits=8 prices one byte per
+        # value — the same payload-shape invariant, and the pick's
+        # returned bytes are exactly the coded model's
+        coded_manual = (k_out + k_in) * (4 + 1 * dim)
+        assert hier_wire_bytes(k_out, k_in, dim, wire_bits=8) == \
+            coded_manual
+        _, _, coded_wire_b = hier_exchange_bytes(
+            local_n, n // local_n, k, vocab, dim, wire_bits=8,
+        )
+        assert coded_wire_b == coded_manual
+        algo, b = pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n, wire_bits=8,
+            bw=LinkBandwidth(4e9, 1e7, "env"),
+        )
+        assert (algo, b) == ("hier", coded_manual)
 
 
 def test_cost_model_crossover_in_bandwidth_ratio():
@@ -334,6 +349,35 @@ def test_cost_model_hysteresis_never_flaps():
     # the challenger's win clears PICK_FLAP_MARGIN and the pick moves
     assert pick_at(1e7, prev=pick_at(hi)) == "hier"
     assert pick_at(1e12, prev="hier") != "hier"
+
+    # the CODED wire (ISSUE 13): an 8-bit wire moves the crossover (the
+    # hier candidate got ~4x cheaper on the DCN) but the hystereses keep
+    # it exactly as flap-free — re-run the whole boundary drill at
+    # wire_bits=8
+    def pick_coded(dcn, prev=None):
+        return pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n, wire_bits=8,
+            bw=LinkBandwidth(ici, dcn, "env"), prev=prev,
+        )[0]
+
+    lo, hi = 1e7, 1e12
+    assert pick_coded(lo) == "hier" and pick_coded(hi) != "hier"
+    for _ in range(60):
+        mid = (lo * hi) ** 0.5
+        if pick_coded(mid) == "hier":
+            lo = mid
+        else:
+            hi = mid
+    boundary_c = (lo * hi) ** 0.5
+    assert boundary_c > boundary, (
+        "the cheaper coded wire must extend hier's winning regime to "
+        "faster DCNs", boundary, boundary_c,
+    )
+    for prev in (pick_coded(lo), pick_coded(hi)):
+        for jitter in (0.9, 0.95, 1.0, 1.05, 1.1):
+            assert pick_coded(boundary_c * jitter, prev=prev) == prev, (
+                prev, jitter,
+            )
 
 
 # -- shared id streams ---------------------------------------------------

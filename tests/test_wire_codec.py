@@ -305,6 +305,126 @@ def test_mixed_old_new_client_server_pairs_interoperate():
         svc.close()
 
 
+# -- quantile-coded rows frames (the compressed DCN wire, ISSUE 13) ---------
+
+
+def test_coded_rows_frame_roundtrips_both_id_tags(rng):
+    """Every frame tag round-trips: a SPARSE union rides the delta-varint
+    id tag, a DENSE union the range bitmap (chosen by size), and the
+    decoded rows equal the encoder's returned decoded view exactly —
+    the EF carry contract (carry = val - dec) depends on both ends
+    reconstructing the identical floats."""
+    sparse_u = np.unique(rng.integers(0, 1 << 22, 300)).astype(np.int64)
+    dense_u = np.unique(rng.integers(0, 4096, 8192)).astype(np.int64)
+    for uids, want_tag in ((sparse_u, wire.ID_DELTA),
+                           (dense_u, wire.ID_BITMAP),
+                           (np.array([17], np.int64), wire.ID_DELTA),
+                           (np.zeros(0, np.int64), wire.ID_DELTA)):
+        ids_sec = wire.pack_ids(uids)
+        assert ids_sec[0] == want_tag, (uids.size, ids_sec[0])
+        got, used = wire.split_ids(ids_sec)
+        assert used == len(ids_sec)
+        np.testing.assert_array_equal(got, uids)
+        vals = (0.4 * rng.normal(size=(uids.size, 7))).astype(np.float32)
+        frame, dec = wire.pack_rows_coded(uids, vals)
+        u2, r2, consumed = wire.unpack_rows_coded(frame, 7)
+        assert consumed == len(frame)
+        np.testing.assert_array_equal(u2, uids)
+        np.testing.assert_array_equal(r2, dec)  # receiver == encoder view
+        if uids.size:
+            # dynamic range never clips: the error is sub-bucket
+            bucket = 2 * 1.05 * np.abs(vals).max() / 256
+            assert np.abs(dec - vals).max() <= bucket / 2 * 1.0001
+        # one byte per value + the tagged ids + the 6-byte section header
+        assert len(frame) == 1 + len(ids_sec) + 5 + vals.size
+    # the dense union's bitmap is far under the varint stream it replaced
+    assert len(wire.pack_ids(dense_u)) < 0.2 * len(wire.pack_keys(dense_u))
+
+
+def test_coded_frame_grouped_sections_roundtrip(rng):
+    """pack_codes_section / unpack_codes_section — the per-table value
+    sections grouped frames concatenate behind ONE shared id stream —
+    are self-delimiting and independent (each ships its own range)."""
+    n = 50
+    a = (0.2 * rng.normal(size=(n, 8))).astype(np.float32)
+    b = (30.0 * rng.normal(size=(n, 3))).astype(np.float32)  # wilder range
+    sa, da = wire.pack_codes_section(a)
+    sb, db = wire.pack_codes_section(b)
+    buf = sa + sb
+    ra, used = wire.unpack_codes_section(buf, n, 8)
+    rb, used2 = wire.unpack_codes_section(buf[used:], n, 3)
+    assert used + used2 == len(buf)
+    np.testing.assert_array_equal(ra, da)
+    np.testing.assert_array_equal(rb, db)
+    assert np.abs(rb - b).max() <= (2 * 1.05 * np.abs(b).max() / 256)
+
+
+def test_coded_frame_corruption_rejected_loudly(rng):
+    """A coded frame must never half-parse: bad magic, unknown id tag,
+    truncated id stream, truncated/short code section, corrupt bitmap
+    popcount and non-finite/non-positive ranges all raise."""
+    uids = np.unique(rng.integers(0, 4096, 600)).astype(np.int64)
+    vals = rng.normal(size=(uids.size, 4)).astype(np.float32)
+    frame, _ = wire.pack_rows_coded(uids, vals)
+    assert frame[0] == wire.CODED_MAGIC and frame[1] == wire.ID_BITMAP
+    with pytest.raises(ValueError, match="magic"):
+        wire.unpack_rows_coded(b"\x00" + frame[1:], 4)
+    with pytest.raises(ValueError):
+        wire.unpack_rows_coded(b"", 4)
+    with pytest.raises(ValueError, match="tag"):
+        wire.unpack_rows_coded(frame[:1] + b"\x7f" + frame[2:], 4)
+    with pytest.raises(ValueError):
+        wire.unpack_rows_coded(frame[: len(frame) // 3], 4)  # ids cut
+    with pytest.raises(ValueError):
+        wire.unpack_rows_coded(frame[:-5], 4)  # codes cut
+    # bitmap popcount vs declared n disagree: flip a byte INSIDE the
+    # bitmap body (after the magic, the tag and the 3-varint header)
+    _, hdr_len = wire.split_varint(frame[2:], 3)
+    bad = bytearray(frame)
+    bad[2 + hdr_len + 4] ^= 0xFF
+    with pytest.raises(ValueError, match="popcount"):
+        wire.unpack_rows_coded(bytes(bad), 4)
+    # a forged non-positive/non-finite range fails loud
+    ids_sec = wire.pack_ids(uids)
+    for forged in (np.float32(0.0), np.float32(np.nan),
+                   np.float32(-1.0), np.float32(np.inf)):
+        sec = bytes([8]) + forged.tobytes() + b"\x00" * (uids.size * 4)
+        with pytest.raises(ValueError, match="range"):
+            wire.unpack_rows_coded(
+                bytes([wire.CODED_MAGIC]) + ids_sec + sec, 4
+            )
+
+
+def test_old_hier_frames_byte_identical_and_coded_fails_old_readers(rng):
+    """Mixed-version interop (the PR 3 trace-header discipline): the
+    fp32/f16 rendezvous frames the new code emits are BYTE-IDENTICAL to
+    the PR 10 wire, the new reader parses old frames unchanged, and a
+    coded frame reaching an OLD reader (which only knows the f32/f16
+    decodes) raises instead of silently misparsing."""
+    from lightctr_tpu.dist import hier
+
+    uids = np.unique(rng.integers(1, 1 << 16, 120)).astype(np.int64)
+    rows = rng.normal(size=(uids.size, 6)).astype(np.float32)
+    # fp32 frame == the PR 10 construction, and round-trips
+    f32 = hier._encode_payload(uids, rows, hier.FLAG_F32)
+    assert f32 == wire.pack_keys(uids) + np.ascontiguousarray(
+        rows, np.float32).tobytes()
+    k, r = hier._decode_payload(f32, 6, hier.FLAG_F32)
+    np.testing.assert_array_equal(k, uids)
+    np.testing.assert_array_equal(r, rows)
+    # f16 frame == the PS pack_rows frame
+    f16 = hier._encode_payload(uids, rows, 0)
+    assert f16 == wire.pack_rows(uids, rows)
+    k, r = hier._decode_payload(f16, 6, 0)
+    np.testing.assert_array_equal(k, uids)
+    # a coded frame through the OLD readers: both legacy decodes reject
+    coded, _ = wire.pack_rows_coded(uids, rows)
+    with pytest.raises(ValueError):
+        hier._decode_payload(coded, 6, hier.FLAG_F32)  # old f32 path
+    with pytest.raises(ValueError):
+        hier._decode_payload(coded, 6, 0)              # old f16 path
+
+
 def test_rows_adagrad_native_matches_numpy_path(rng):
     """Fused one-pass server adagrad (ps_rows.cpp) == the numpy five-pass
     _apply, through the public push/pull surface, above and below the

@@ -401,6 +401,30 @@ def summarize_exchange(doc) -> dict:
         # ICI bytes were merged down to each DCN byte
         report["hier_local_to_wire_x"] = round(
             totals["hier_local"] / max(totals["hier_wire"], 1), 3)
+    # wire-codec honesty (ISSUE 13): measured socket bytes vs the fp32
+    # equivalent of the identical payload, the id bytes the shared
+    # streams never shipped, and the undelivered EF residual mass — the
+    # compression claim as measured numbers, not model assumptions
+    packed = counters.get("trainer_hier_wire_packed_bytes_total", 0)
+    fp32_eq = counters.get("trainer_hier_wire_fp32_bytes_total", 0)
+    id_saved = counters.get("trainer_hier_wire_id_saved_bytes_total", 0)
+    gauges = snap.get("gauges", {})
+    if packed or fp32_eq or id_saved:
+        codec = {
+            "packed_bytes": packed,
+            "fp32_equiv_bytes": fp32_eq,
+            "shared_id_saved_bytes": id_saved,
+        }
+        if packed:
+            codec["compression_x"] = round(fp32_eq / packed, 3)
+            # how much bigger the wire would be had every table shipped
+            # its own id stream
+            codec["shared_id_dedup_x"] = round(
+                (packed + id_saved) / packed, 3)
+        if "trainer_hier_wire_ef_mass" in gauges:
+            codec["ef_residual_mass"] = round(
+                gauges["trainer_hier_wire_ef_mass"], 6)
+        report["wire_codec"] = codec
     return report
 
 
