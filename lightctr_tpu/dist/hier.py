@@ -62,6 +62,7 @@ the PR 10 wire (tested in test_wire_codec.py / test_hier_exchange.py).
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import struct
@@ -83,7 +84,24 @@ from lightctr_tpu.dist.ps_server import (
 )
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import trace as obs_trace
-from lightctr_tpu.obs.registry import MetricsRegistry, labeled
+from lightctr_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+    labeled,
+)
+
+#: per-round straggler-attribution series (ISSUE 14) — declared like
+#: EXCHANGE_SERIES/HEALTH_SERIES and AST-linted in tests/test_obs.py so a
+#: new round metric cannot ship dark.  The shard-side histogram is keyed
+#: by HOST: a slow host shows up BY NAME in one scrape, and the cluster
+#: rollup's straggler attributor (obs/cluster.py) ranks hosts off its
+#: sum/count.
+HIER_ROUND_SERIES = (
+    "hier_round_wait_seconds",            # shard hist {host}: arrival offset
+                                          # behind the round's first push
+    "hier_round_client_seconds",          # client hist: push->pull-satisfied
+    "hier_round_withheld_retries_total",  # client counter: withheld retries
+)
 
 #: push/pull header codec flags (a varint bitfield, so old peers that only
 #: know bit 0 read an unknown bit as a codec they cannot parse and fail
@@ -230,10 +248,13 @@ class _Round:
     host pulled it back.  ``coded_section`` caches the ONE owner-side
     EF-compensated encode of the merged rows (every host must decode
     identical bytes and the owner carry must advance exactly once per
-    round); ``ids_bytes`` caches the tagged id stream beside it."""
+    round); ``ids_bytes`` caches the tagged id stream beside it.  ``t0``
+    is the perf-counter instant of the round's FIRST push and
+    ``arrivals`` the per-host offsets behind it — the straggler
+    attribution timeline (ISSUE 14)."""
 
     __slots__ = ("contrib", "merged", "pulled", "dim", "coded_section",
-                 "ids_bytes")
+                 "ids_bytes", "t0", "arrivals")
 
     def __init__(self, dim: int):
         self.contrib: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
@@ -242,6 +263,8 @@ class _Round:
         self.dim = dim
         self.coded_section: Optional[bytes] = None
         self.ids_bytes: Optional[bytes] = None
+        self.t0: Optional[float] = None
+        self.arrivals: List[Tuple[int, float]] = []
 
 
 class SparseReduceShard:
@@ -258,6 +281,11 @@ class SparseReduceShard:
     #: are dropped even if a host never pulled them (a crashed host must
     #: not pin every round in memory forever)
     ROUND_GC_LAG = 16
+
+    #: bounded per-round arrival ring served in stats(): the newest
+    #: completed rounds' per-host arrival offsets (straggler timelines a
+    #: scrape can read back verbatim, beside the histogram's aggregate)
+    ARRIVAL_RING = 64
 
     def __init__(self, n_hosts: int, host: str = "127.0.0.1", port: int = 0,
                  registry: Optional[MetricsRegistry] = None):
@@ -276,6 +304,11 @@ class SparseReduceShard:
         self._counts = {"pushes": 0, "pulls": 0, "withheld": 0,
                         "rounds_merged": 0, "protocol_errors": 0,
                         "coded_rounds": 0}
+        # newest completed rounds' arrival timelines (REAL rounds only —
+        # probe rounds have one contributor and nothing to attribute)
+        self._arrivals: collections.deque = collections.deque(
+            maxlen=self.ARRIVAL_RING
+        )
         self._listener = socket.create_server((host, port))
         self.address = self._listener.getsockname()
         self._stop = threading.Event()
@@ -315,7 +348,12 @@ class SparseReduceShard:
 
     def _push(self, host_id: int, epoch: int, table: int,
               keys: np.ndarray, rows: np.ndarray, dim: int) -> None:
+        arrival = None
         with self._lock:
+            # stamped INSIDE the lock: arrivals are ordered by the merge
+            # order the round actually sees, so offsets behind t0 can
+            # never go negative under concurrent handler threads
+            now = time.perf_counter()
             self._counts["pushes"] += 1
             self._max_epoch = max(self._max_epoch, epoch)
             rd = self._rounds.get((epoch, table))
@@ -329,8 +367,31 @@ class SparseReduceShard:
                 # a retried push after the merge (its reply was lost):
                 # at-least-once delivery, the contribution already counted
                 return
+            fresh = host_id not in rd.contrib
             rd.contrib[host_id] = (keys, rows)
+            # arrival timeline (REAL rounds, first delivery per host):
+            # offset behind the round's first push — the wait this host
+            # charged the round with.  Retried pushes re-land rows but
+            # must not double-count the arrival.
+            if epoch >= 0 and fresh:
+                if rd.t0 is None:
+                    rd.t0 = now
+                arrival = now - rd.t0
+                rd.arrivals.append((host_id, arrival))
+                if len(rd.contrib) >= self.n_hosts:
+                    # round complete: freeze its timeline into the ring
+                    self._arrivals.append({
+                        "epoch": int(epoch), "table": int(table),
+                        "arrivals": {str(h): round(off, 6)
+                                     for h, off in rd.arrivals},
+                        "wait_s": round(max(o for _, o in rd.arrivals), 6),
+                    })
             self._gc_locked()
+        if arrival is not None and obs_gate.enabled():
+            self.registry.observe(
+                labeled("hier_round_wait_seconds", host=str(host_id)),
+                arrival,
+            )
 
     def _pull(self, host_id: int, epoch: int, table: int,
               coded: bool = False):
@@ -403,6 +464,9 @@ class SparseReduceShard:
                 str(t): round(c.mass(), 6)
                 for t, c in self._owner_carry.items()
             }
+            # the bounded per-round arrival ring (newest last): who each
+            # recent round waited for, readable from one stats scrape
+            out["arrivals"] = list(self._arrivals)
         out["telemetry"] = self.registry.snapshot()
         return out
 
@@ -667,11 +731,20 @@ class HierExchangeClient:
 
     def __init__(self, addresses, host_id: int, n_hosts: int,
                  codec: str = "f32", pull_timeout_s: float = 120.0,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None):
         if not addresses:
             raise ValueError("need at least one reduce shard address")
         if codec not in ("f32", "f16", "q8_ef"):
             raise ValueError(f"unknown wire codec {codec!r}")
+        # per-round client latency telemetry (HIER_ROUND_SERIES): defaults
+        # to the process registry like the trainers
+        self.registry = registry if registry is not None else \
+            default_registry()
+        # first-push perf_counter per open round, popped when the pull is
+        # satisfied -> hier_round_client_seconds (bounded: an abandoned
+        # round — peer crash before our pull — must not pin entries)
+        self._round_t0: Dict[Tuple, float] = {}
         self.addresses = [tuple(a) for a in addresses]
         self.n_shards = len(self.addresses)
         self.host_id = int(host_id)
@@ -705,6 +778,21 @@ class HierExchangeClient:
         """Total member-side undelivered EF mass (sum |carry| over
         tables) — sub-bucket noise under the dynamic-range codec."""
         return sum(c.mass() for c in self._carry.values())
+
+    def _note_push(self, key: Tuple) -> None:
+        """Stamp a round's FIRST push (later pushes of a retried frame
+        keep the original stamp — the latency is push-to-pull-satisfied,
+        the whole wait this host saw)."""
+        if key not in self._round_t0:
+            while len(self._round_t0) >= 1024:  # abandoned-round bound
+                self._round_t0.pop(next(iter(self._round_t0)))
+            self._round_t0[key] = time.perf_counter()
+
+    def _note_pull_done(self, key: Tuple) -> None:
+        t0 = self._round_t0.pop(key, None)
+        if t0 is not None and obs_gate.enabled():
+            self.registry.observe("hier_round_client_seconds",
+                                  time.perf_counter() - t0)
 
     def _carry_for(self, table: int, dim: int) -> _EFCarry:
         carry = self._carry.get(table)
@@ -767,8 +855,9 @@ class HierExchangeClient:
         flags = self._flags(exact)
         hdr = self._hdr(epoch, table, dim, flags)
         shard = self._shard_of(uids)
+        self._note_push((epoch, int(table)))
         with obs_trace.span("hier_client/push", n_keys=int(uids.size),
-                            table=table, epoch=epoch):
+                            table=table, epoch=epoch, host=self.host_id):
             for s, c in enumerate(self.clients):
                 idx = np.flatnonzero(shard == s)
                 if flags & FLAG_CODED:
@@ -810,8 +899,10 @@ class HierExchangeClient:
                  + wire.pack_varint(np.array(tables, np.int64))
                  + wire.pack_varint(np.array(dims, np.int64)))
         shard = self._shard_of(uids)
+        self._note_push((epoch, tuple(tables)))
         with obs_trace.span("hier_client/push_group", n_keys=int(uids.size),
-                            tables=len(tables), epoch=epoch):
+                            tables=len(tables), table=tables[0],
+                            epoch=epoch, host=self.host_id):
             for s, c in enumerate(self.clients):
                 idx = np.flatnonzero(shard == s)
                 su = uids[idx]
@@ -849,6 +940,8 @@ class HierExchangeClient:
             reply = c._rpc(MSG_PULL, hdr)
             if reply[:1] == b"\x00":
                 return reply[1:]
+            if obs_gate.enabled():
+                self.registry.inc("hier_round_withheld_retries_total")
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"reduce round {what} never completed on shard {s} "
@@ -876,12 +969,14 @@ class HierExchangeClient:
         flags = self._flags(exact)
         hdr = self._hdr(epoch, table, dim, flags)
         keys_parts, rows_parts = [], []
-        with obs_trace.span("hier_client/pull", table=table, epoch=epoch):
+        with obs_trace.span("hier_client/pull", table=table, epoch=epoch,
+                            host=self.host_id):
             for s, c in enumerate(self.clients):
                 body = self._pull_one(c, s, hdr, f"({epoch}, {table})")
                 k, r = _decode_payload(body, dim, flags)
                 keys_parts.append(k)
                 rows_parts.append(r)
+        self._note_pull_done((epoch, int(table)))
         keys, rows, _ = self._splice(keys_parts, rows_parts, dim)
         return keys, rows
 
@@ -901,7 +996,8 @@ class HierExchangeClient:
         keys_parts = []
         rows_parts = [[] for _ in tables]
         with obs_trace.span("hier_client/pull_group", tables=len(tables),
-                            epoch=epoch):
+                            table=tables[0], epoch=epoch,
+                            host=self.host_id):
             for s, c in enumerate(self.clients):
                 body = self._pull_one(c, s, hdr + req,
                                       f"({epoch}, group {tables})")
@@ -919,6 +1015,7 @@ class HierExchangeClient:
                         f"group pull reply length mismatch: consumed "
                         f"{pos} of {len(body)} bytes"
                     )
+        self._note_pull_done((epoch, tuple(tables)))
         keys, rows0, order = self._splice(keys_parts, rows_parts[0],
                                           dims[0])
         out_rows = [rows0]

@@ -41,10 +41,12 @@ from lightctr_tpu.dist.ps_server import (
 )
 from lightctr_tpu.embed.async_ps import AsyncParamServer
 from lightctr_tpu.obs import emit_event
+from lightctr_tpu.obs import exporter as obs_exporter
 from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import trace as obs_trace
+from lightctr_tpu.obs.cluster import ClusterRollup, attribute_stragglers
 from lightctr_tpu.obs.registry import labeled
 
 
@@ -90,6 +92,8 @@ class MasterService:
         dim: int = 1,
         ckpt_dir: Optional[str] = None,
         grace_factor: float = 3.0,
+        scrape_period_s: Optional[float] = None,
+        scrape_targets=None,
     ):
         """``elastic=True`` turns detection into ACTION (docs/ELASTICITY.md):
         the master owns an epoch-numbered :class:`RoutingTable` (served
@@ -181,6 +185,42 @@ class MasterService:
             route_provider=self.routing_dict,
         )
         self.address = self._svc.address
+        # cluster telemetry rollup (ISSUE 14, docs/OBSERVABILITY.md):
+        # ``scrape_period_s`` arms a daemon loop that polls every
+        # member's MSG_STATS telemetry snapshot — the PS shards the
+        # master routes, plus any extra (name, address) ``scrape_targets``
+        # (rendezvous reduce shards) — into ONE member-labeled registry
+        # view.  The rollup registers with the flight recorder, so the
+        # master's ops exporter serves the whole cluster at /metrics and
+        # the straggler-attribution verdict at /stragglerz.
+        self.rollup: Optional[ClusterRollup] = None
+        self.scrape_period_s = scrape_period_s
+        self._scrape_stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+        self._scrape_clients: dict = {}
+        self._scrape_extra = [(str(n), tuple(a))
+                              for n, a in (scrape_targets or [])]
+        if scrape_period_s is not None:
+            if scrape_period_s <= 0:
+                raise ValueError("scrape_period_s must be positive")
+            self.rollup = ClusterRollup()
+            # the route and registry names are process-global: a second
+            # scrape-armed master in one process takes them over (warned
+            # — latest wins), and close() only unhooks what is still OURS
+            # so closing the old master cannot break the survivor
+            if "cluster" in obs_flight.registered_registries() \
+                    or "/stragglerz" in obs_exporter.json_routes():
+                logging.getLogger(__name__).warning(
+                    "another cluster rollup is registered in this "
+                    "process; /stragglerz and /metrics now serve THIS "
+                    "master's view"
+                )
+            obs_flight.register_registry("cluster", self.rollup)
+            obs_exporter.register_json_route("/stragglerz", self.stragglerz)
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop, name="master-scrape", daemon=True,
+            )
+            self._scrape_thread.start()
         self.monitor.start()
 
     @staticmethod
@@ -809,8 +849,81 @@ class MasterService:
     def _broadcast_readmit_wid(self, wid: int) -> None:
         self._broadcast("readmit", wid)
 
+    # -- cluster telemetry rollup (docs/OBSERVABILITY.md) --------------------
+
+    def _scrape_targets_now(self):
+        """(name, address) pairs to scrape this sweep: shard names are
+        STABLE ids (``shard_<i>``), so the rollup's member labels survive
+        elastic membership; extra targets (rendezvous shards, ...) ride
+        under their caller-given names."""
+        with self._admin_lock:
+            shards = [(f"shard_{i}", tuple(a))
+                      for i, a in enumerate(self._shard_addresses)]
+        return shards + list(self._scrape_extra)
+
+    def scrape_once(self) -> None:
+        """One rollup sweep over every member's MSG_STATS (the scrape
+        loop's body; callable directly for deterministic tests).  Scrape
+        connections are SEPARATE from the admin clients: a sweep must not
+        queue behind a rebalance episode, and a wedged member costs one
+        socket timeout, never the admin lock."""
+        if self.rollup is None:
+            return
+        for name, addr in self._scrape_targets_now():
+            c = self._scrape_clients.get(name)
+            try:
+                if c is None:
+                    c = PSClient(addr, self.dim, timeout=self._timeout)
+                    self._scrape_clients[name] = c
+                self.rollup.update(name, c.stats())
+            except (ConnectionError, OSError, RuntimeError,
+                    ValueError) as e:
+                if c is not None:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                self._scrape_clients[name] = None
+                self.rollup.mark_down(name, e)
+
+    def _scrape_loop(self) -> None:
+        while not self._scrape_stop.wait(self.scrape_period_s):
+            try:
+                self.scrape_once()
+            except Exception:
+                # the rollup must never take the control plane down
+                logging.getLogger(__name__).debug(
+                    "cluster scrape sweep failed", exc_info=True)
+
+    def stragglerz(self) -> dict:
+        """The straggler-attribution verdict over the current rollup —
+        the ``/stragglerz`` ops route's payload (obs/cluster.py)."""
+        if self.rollup is None:
+            return {"error": "cluster scrape loop not armed "
+                             "(set scrape_period_s)"}
+        return attribute_stragglers(self.rollup.members())
+
     def close(self) -> None:
         self.monitor.stop()
+        if self._scrape_thread is not None:
+            self._scrape_stop.set()
+            self._scrape_thread.join(timeout=2.0)
+            self._scrape_thread = None
+        if self.rollup is not None:
+            # unhook only OUR registrations: a newer scrape-armed master
+            # may have taken the global names over since (latest wins)
+            if obs_exporter.json_routes().get("/stragglerz") \
+                    == self.stragglerz:
+                obs_exporter.unregister_json_route("/stragglerz")
+            if obs_flight.registered_registries().get("cluster") \
+                    is self.rollup:
+                obs_flight.unregister_registry("cluster")
+        for c in self._scrape_clients.values():
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
         for c in self._shards:
             if c is not None:
                 try:

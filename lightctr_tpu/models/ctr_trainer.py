@@ -28,6 +28,7 @@ import optax
 from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import stepwatch as stepwatch_mod
 from lightctr_tpu.obs import trace as trace_mod
 from lightctr_tpu.utils.profiling import annotate
 from lightctr_tpu.core.config import TrainConfig
@@ -238,6 +239,13 @@ class CTRTrainer:
         # would force a device sync per step and stall the dispatch
         # pipeline (the <5% overhead guard measures exactly that)
         self._health_pending: list = []
+        # step stall watchdog (obs/stepwatch.py): wall time since the
+        # last COMPLETED step vs an EWMA-derived deadline — the signal a
+        # wedged exchange cannot suppress.  Armed by LIGHTCTR_STALL=1 (or
+        # arm_stepwatch()); rides the same per-step drain as the health
+        # feed and marks phases (input/exec/exchange/apply) as the step
+        # moves, so a trip names where it is stuck.
+        self.stepwatch = stepwatch_mod.maybe_from_env(self.health)
         self._steps_seen = 0
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
@@ -514,7 +522,12 @@ class CTRTrainer:
             # one extra branch — the overhead guard measures this path
             return self._train_step_traced(batch)
         t0 = time.perf_counter()
+        sw = self.stepwatch
+        if sw is not None:
+            sw.mark("input")
         dev_batch = self._put(batch)
+        if sw is not None:
+            sw.mark("exec")
         self.params, self.opt_state, loss, health = self._step(
             self.params, self.opt_state, dev_batch
         )
@@ -530,10 +543,15 @@ class CTRTrainer:
         (``sparse_tables/dedup_gather`` / ``sparse_exchange`` / ``apply``)
         appear under ``trainer/exec`` on the first (tracing) step."""
         t0 = time.perf_counter()
+        sw = self.stepwatch
         with annotate("trainer/step", step=self._steps_seen + 1):
             with annotate("trainer/input"):
+                if sw is not None:
+                    sw.mark("input")
                 dev_batch = self._put(batch)
             with annotate("trainer/exec"):
+                if sw is not None:
+                    sw.mark("exec")
                 self.params, self.opt_state, loss, health = self._step(
                     self.params, self.opt_state, dev_batch
                 )
@@ -559,6 +577,8 @@ class CTRTrainer:
             examples=n, **self._step_event_fields(),
         )
         self._feed_health(batch, health)
+        if self.stepwatch is not None:
+            self.stepwatch.step_completed(dt)
 
     #: blocking-fetch backpressure bound on the health scalar queue — a
     #: device more than this many steps behind gets synced rather than
@@ -606,6 +626,22 @@ class CTRTrainer:
             return
         for entry in pend:
             self._observe_scalars(hm, entry)
+
+    def arm_stepwatch(self, **kw) -> "stepwatch_mod.StepWatch":
+        """Arm (or return) the step stall watchdog against this trainer's
+        health monitor — the programmatic twin of ``LIGHTCTR_STALL=1``.
+        Keyword arguments forward to
+        :class:`~lightctr_tpu.obs.stepwatch.StepWatch`; passing any when
+        a watch is already armed (e.g. from the env) REPLACES it, so a
+        caller's explicit deadline/registry always wins."""
+        if self.stepwatch is not None and kw:
+            self.stepwatch.close()
+            self.stepwatch = None
+        if self.stepwatch is None:
+            self.stepwatch = stepwatch_mod.StepWatch(
+                monitor=self.health, **kw
+            )
+        return self.stepwatch
 
     def _health_signals(self, batch) -> Dict:
         """Extra health signals subclasses contribute per step (the sparse
@@ -658,6 +694,10 @@ class CTRTrainer:
                 _LOG.info("epoch %d: loss=%.5f%s", epoch, float(loss),
                           f" {ev}" if ev is not None else "")
         self.flush_health()  # the last step's pending scalars
+        if self.stepwatch is not None:
+            # training is DONE — the deadman must not read post-fit idle
+            # time as a wedge; the next train_step re-arms it
+            self.stepwatch.pause()
         history["wall_time_s"] = time.perf_counter() - t0
         return history
 

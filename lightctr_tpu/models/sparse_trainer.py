@@ -1106,6 +1106,11 @@ class SparseTableCTRTrainer(CTRTrainer):
         sock0 = client.bytes_sent + client.bytes_received
         saved0 = client.shared_id_saved_bytes
         fp32_equiv = 0
+        sw = self.stepwatch
+        if sw is not None:
+            # the phase a stalled rendezvous wedges in: a stepwatch trip
+            # while a pull is withheld names "exchange" by construction
+            sw.mark("exchange")
         with annotate("sparse_tables/hier_wire", tables=len(self._spec),
                       epoch=epoch):
             pushed = []
@@ -1171,6 +1176,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         loss = float(dsum[-1])
         dense_mean = jnp.asarray(dsum[:-1], jnp.float32)
 
+        if sw is not None:
+            sw.mark("apply")
         new_params, new_state, loss_out, health = self._hier_apply_j(
             params, opt_state, payload, dense_mean,
             jnp.float32(loss), jnp.asarray(over),

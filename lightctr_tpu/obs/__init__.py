@@ -33,7 +33,15 @@ Entry points
     state machine; ``LIGHTCTR_HEALTH=0`` disables.
 ``exporter`` (submodule)
     HTTP ops endpoints — ``LIGHTCTR_OPS_PORT=<port>`` serves
-    ``/metrics`` ``/varz`` ``/healthz`` ``/tracez`` ``/flightz``.
+    ``/metrics`` ``/varz`` ``/healthz`` ``/tracez`` ``/flightz`` (plus
+    pluggable JSON routes like the master's ``/stragglerz``).
+``stepwatch`` (submodule)
+    step stall watchdog — wall time since the last completed step vs an
+    EWMA deadline; ``LIGHTCTR_STALL=1`` arms it in every trainer
+    (``LIGHTCTR_STALL_FACTOR``/``LIGHTCTR_STALL_MIN_S`` tune it).
+``cluster`` (submodule)
+    cluster-wide telemetry rollup + straggler attribution — member-
+    labeled merged ``/metrics`` and the ``/stragglerz`` verdict.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -60,6 +68,8 @@ from lightctr_tpu.obs import trace  # noqa: F401  (obs.trace.span / export)
 from lightctr_tpu.obs import flight  # noqa: F401  (crash flight recorder)
 from lightctr_tpu.obs import health  # noqa: F401  (health monitors)
 from lightctr_tpu.obs import exporter  # noqa: F401  (HTTP ops endpoints)
+from lightctr_tpu.obs import stepwatch  # noqa: F401  (stall watchdog)
+from lightctr_tpu.obs import cluster  # noqa: F401  (cluster rollup)
 
 # LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
 # inherits the variable — the multi-process PS run's postmortem switch

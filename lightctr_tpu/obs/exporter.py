@@ -17,6 +17,10 @@ and ``master`` processes alike:
     POST /flightz    trigger an on-demand flight bundle; replies with the
                      bundle path
 
+Services can add JSON routes of their own with :func:`register_json_route`
+(the master's cluster rollup serves ``/stragglerz`` this way — the
+straggler-attribution verdict, docs/OBSERVABILITY.md).
+
 Arming: ``LIGHTCTR_OPS_PORT=<port>`` starts the server at obs import in
 every process that inherits the variable (port ``0`` auto-assigns — the
 multi-process-per-host and test case; a taken fixed port falls back to
@@ -35,7 +39,7 @@ import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
 from lightctr_tpu.obs import flight as flight_mod
@@ -52,6 +56,39 @@ _LOG = logging.getLogger(__name__)
 
 #: default Prometheus metric prefix on /metrics
 PROM_PREFIX = "lightctr_"
+
+
+# -- pluggable JSON routes ---------------------------------------------------
+
+_routes_lock = threading.Lock()
+_json_routes: Dict[str, Callable[[], Dict]] = {}
+
+#: paths the handler owns; a pluggable route may not shadow them
+_BUILTIN_ROUTES = ("/", "/metrics", "/varz", "/healthz", "/tracez",
+                   "/flightz")
+
+
+def register_json_route(path: str, provider: Callable[[], Dict]) -> None:
+    """Serve ``provider()`` as JSON at ``path`` on every ops server in
+    this process (the cluster rollup registers ``/stragglerz``).  The
+    provider runs per request; raising yields a 500 the scraper can
+    see.  Re-registering a path replaces its provider."""
+    path = "/" + str(path).strip("/")
+    if path in _BUILTIN_ROUTES:
+        raise ValueError(f"{path!r} is a built-in ops route")
+    with _routes_lock:
+        _json_routes[path] = provider
+
+
+def unregister_json_route(path: str) -> None:
+    path = "/" + str(path).strip("/")
+    with _routes_lock:
+        _json_routes.pop(path, None)
+
+
+def json_routes() -> Dict[str, Callable[[], Dict]]:
+    with _routes_lock:
+        return dict(_json_routes)
 
 
 # -- payload builders (module-level: tools/tests reuse them) -----------------
@@ -163,7 +200,12 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/flightz":
                 self._reply_json(405, {"error": "POST triggers a dump"})
             else:
-                self._reply_json(404, {"error": f"no route {path!r}"})
+                with _routes_lock:
+                    provider = _json_routes.get(path)
+                if provider is not None:
+                    self._reply_json(200, provider())
+                else:
+                    self._reply_json(404, {"error": f"no route {path!r}"})
         except Exception:
             # the ops plane must never kill its own connection thread
             # with a traceback — degrade to a 500 the scraper can see

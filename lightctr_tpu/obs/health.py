@@ -407,6 +407,39 @@ class FreshnessSLODetector(Detector):
         return OK, detail
 
 
+class StallDetector(Detector):
+    """Step stall: the watchdog (obs/stepwatch.py) feeds wall time since
+    the last COMPLETED step against its EWMA-derived deadline — the one
+    signal a wedged rendezvous cannot suppress, because it needs no step
+    to fire.  Past the deadline the verdict DEGRADES; past
+    ``hard_factor`` times it the process is UNHEALTHY (503 — the cluster
+    is wedged, not slow).  The wait signal already carries the time
+    hysteresis (it must GROW past a deadline derived from history), so
+    the detector trips and recovers in one observation — the watchdog
+    observes ``stalled=False`` the moment a step completes."""
+
+    name = "stall"
+    signals = ("stall",)
+    trip_after = 1
+    recover_after = 1
+
+    def __init__(self, hard_factor: float = 2.0):
+        self.hard_factor = float(hard_factor)
+
+    def check(self, signals):
+        s = signals["stall"]
+        if not s.get("stalled"):
+            return OK, {}
+        detail = {
+            "phase": s.get("phase"),
+            "wait_s": round(float(s.get("wait_s", 0.0)), 3),
+            "deadline_s": round(float(s.get("deadline_s", 0.0)), 3),
+        }
+        if float(s.get("ratio", 0.0)) >= self.hard_factor:
+            return UNHEALTHY, detail
+        return DEGRADED, detail
+
+
 class TierThrashDetector(Detector):
     """Tiered-store thrash: the hot tier cycling rows in and out faster
     than it serves them means the working set no longer fits the fast
@@ -456,6 +489,7 @@ KNOWN_DETECTORS = {
         NaNLossDetector, LossSpikeDetector, GradNormDetector,
         TableSkewDetector, StalenessDetector, HeartbeatGapDetector,
         LatencySLODetector, TierThrashDetector, FreshnessSLODetector,
+        StallDetector,
     )
 }
 

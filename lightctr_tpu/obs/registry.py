@@ -41,13 +41,28 @@ DEFAULT_TIME_BUCKETS_S: tuple = (
 )
 
 
+def escape_label_value(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, double
+    quote, and newline must be escaped or the scrape line is corrupt.
+    Applied where values are BAKED into series names (:func:`labeled`),
+    so snapshot keys stay parseable and :func:`render_prometheus` can
+    emit them verbatim — member addresses like ``127.0.0.1:5555`` and
+    error strings flow into labels via the cluster rollup
+    (obs/cluster.py)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def labeled(name: str, **labels) -> str:
     """Bake Prometheus labels into a series name:
     ``labeled("x_total", op="pull")`` -> ``x_total{op="pull"}``.
-    Labels are sorted so the same label set always yields the same key."""
+    Labels are sorted so the same label set always yields the same key;
+    values are exposition-escaped (:func:`escape_label_value`)."""
     if not labels:
         return name
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(
+        f'{k}="{escape_label_value(labels[k])}"' for k in sorted(labels)
+    )
     return f"{name}{{{inner}}}"
 
 

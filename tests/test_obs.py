@@ -430,7 +430,12 @@ def test_trainer_telemetry_overhead_under_5_percent():
     monitor with the full standard trainer detector set (NaN loss, loss
     spike, grad norm), so the per-step [loss, grad_norm] device fetch
     and the detector checks are inside the <5% budget — and the feed is
-    asserted to have actually run (no passing by silently skipping)."""
+    asserted to have actually run (no passing by silently skipping).
+
+    ISSUE 14 extends it again to the STEP STALL WATCHDOG: the timed path
+    runs with an armed StepWatch (poll thread live, per-step
+    step_completed feed), so the watchdog's hot-path cost — one lock +
+    EWMA fold per step — is inside the same budget."""
     from lightctr_tpu import TrainConfig
     from lightctr_tpu.models.ctr_trainer import CTRTrainer
     from lightctr_tpu.obs import health as health_mod
@@ -449,6 +454,10 @@ def test_trainer_telemetry_overhead_under_5_percent():
                                   registry=obs.MetricsRegistry())
     health_mod.ensure_trainer_detectors(hm)
     tr.health = hm
+    # the stall watchdog ARMED on the timed path (deadline far beyond
+    # any sane step so it never trips into the measurement)
+    sw = tr.arm_stepwatch(min_s=120.0, factor=1000.0,
+                          registry=obs.MetricsRegistry())
     obs.configure_event_log()  # fresh in-memory ring (no disk writes)
     try:
         with trace_mod.override_rate(0.0):  # the documented default
@@ -470,7 +479,11 @@ def test_trainer_telemetry_overhead_under_5_percent():
             # drain lags a bounded number of steps, never all of them)
             assert hm.observations - obs_before >= 4 * 60 - tr._HEALTH_MAX_LAG
             assert hm.status() == "ok"
+            # ...and so was the armed watchdog, without ever tripping
+            wst = sw.check()
+            assert wst["steps"] >= 4 * 60 and not wst["stalled"]
     finally:
+        sw.close()
         obs.configure_event_log()
         hm.close()
     # small absolute slack keeps the guard robust to scheduler noise while
@@ -891,6 +904,57 @@ def test_every_exchange_series_is_declared_and_emitted():
     )
     assert len(sparse_trainer.EXCHANGE_SERIES) == len(declared), \
         "duplicate names in EXCHANGE_SERIES"
+
+
+def test_every_round_cluster_stall_series_is_declared_and_emitted():
+    """The ISSUE-14 observability planes follow the same no-dark-series
+    contract as EXCHANGE_SERIES/HEALTH_SERIES: every ``hier_round_*``
+    series dist/hier.py emits must be declared in ``HIER_ROUND_SERIES``,
+    every ``cluster_*`` in obs/cluster.py in ``CLUSTER_SERIES``, every
+    ``stall_*`` in obs/stepwatch.py in ``STALL_SERIES`` — and every
+    declaration must be emitted (both directions, no duplicates)."""
+    from lightctr_tpu.dist import hier
+    from lightctr_tpu.obs import cluster as cluster_mod
+    from lightctr_tpu.obs import stepwatch as stepwatch_mod
+
+    cases = [
+        (LIB_ROOT / "dist" / "hier.py", "hier_round_",
+         hier.HIER_ROUND_SERIES, "HIER_ROUND_SERIES"),
+        (LIB_ROOT / "obs" / "cluster.py", "cluster_",
+         cluster_mod.CLUSTER_SERIES, "CLUSTER_SERIES"),
+        (LIB_ROOT / "obs" / "stepwatch.py", "stall_",
+         stepwatch_mod.STALL_SERIES, "STALL_SERIES"),
+    ]
+    for path, prefix, series, decl_name in cases:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        emitted = set()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("inc", "gauge_set", "observe")
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Call) and arg.args and (
+                    (isinstance(arg.func, ast.Name)
+                     and arg.func.id == "labeled")
+                    or (isinstance(arg.func, ast.Attribute)
+                        and arg.func.attr == "labeled")):
+                arg = arg.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value.startswith(prefix):
+                emitted.add(arg.value)
+        declared = set(series)
+        assert emitted, f"no {prefix}* emissions in {path.name} " \
+                        "(lint is miswired)"
+        assert emitted == declared, (
+            f"{path.name} {prefix}* emissions != {decl_name}: "
+            f"dark={sorted(emitted - declared)} "
+            f"stale={sorted(declared - emitted)}"
+        )
+        assert len(series) == len(declared), \
+            f"duplicate names in {decl_name}"
 
 
 def test_metrics_report_exchange_section(tmp_path, capsys):

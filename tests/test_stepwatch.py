@@ -1,0 +1,286 @@
+"""Step stall watchdog (ISSUE 14): the deadline math of
+``obs/stepwatch.py`` — EWMA warm-up (no trips before N completed steps),
+trip and recovery in one observation each, the DEGRADED -> UNHEALTHY
+escalation past ``hard_factor`` x the deadline, the at-stall-time flight
+dump, and the trainer wiring (``LIGHTCTR_STALL`` / ``arm_stepwatch``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig, obs
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.obs import flight as flight_mod
+from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import stepwatch as stepwatch_mod
+from lightctr_tpu.obs.registry import MetricsRegistry
+from lightctr_tpu.obs.stepwatch import StepWatch
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _watch(**kw):
+    """A thread-less StepWatch on a fake clock + its own monitor/registry
+    (no process-global state)."""
+    clk = FakeClock()
+    reg = MetricsRegistry()
+    hm = health_mod.HealthMonitor(component=f"sw_{id(clk)}", registry=reg)
+    kw.setdefault("factor", 4.0)
+    kw.setdefault("min_s", 1.0)
+    kw.setdefault("warmup", 3)
+    kw.setdefault("hard_factor", 2.0)
+    sw = StepWatch(monitor=hm, registry=reg, clock=clk, start=False, **kw)
+    return sw, hm, reg, clk
+
+
+def test_no_trip_during_ewma_warmup():
+    """Before ``warmup`` completed steps there is no baseline — the first
+    step carries jit compilation — so even an enormous wait must not
+    trip."""
+    sw, hm, reg, clk = _watch()
+    try:
+        sw.step_completed(10.0)  # the compile step: huge, absorbed
+        sw.step_completed(0.05)
+        clk.t += 1e6
+        st = sw.check()
+        assert st["armed"] is False and st["stalled"] is False
+        assert hm.status() == health_mod.OK
+        assert "stall_trips_total" not in reg.snapshot()["counters"]
+    finally:
+        hm.close()
+
+
+def test_trip_degrades_escalates_and_recovers_in_one_observation():
+    sw, hm, reg, clk = _watch()
+    try:
+        for _ in range(3):
+            sw.step_completed(0.1)
+        # deadline = max(1.0, 4 * ewma~0.1) = 1.0s
+        assert sw.deadline() == pytest.approx(1.0)
+        clk.t += 0.5
+        assert sw.check()["stalled"] is False
+        assert hm.status() == health_mod.OK
+
+        sw.mark("exchange")
+        clk.t += 1.0  # wait 1.5s > deadline -> trip, ratio < hard_factor
+        st = sw.check()
+        assert st["stalled"] is True and st["phase"] == "exchange"
+        assert hm.status() == health_mod.DEGRADED  # one observation
+        det = hm.verdict()["detectors"]["stall"]
+        assert det["detail"]["phase"] == "exchange"
+        snap = reg.snapshot()
+        assert snap["counters"]["stall_trips_total"] == 1
+        assert snap["gauges"]["stall_current"] == 1
+
+        clk.t += 1.0  # wait 2.5s -> ratio 2.5 >= hard_factor 2 -> 503
+        sw.check()
+        assert hm.status() == health_mod.UNHEALTHY
+
+        # a later poll while still wedged does not re-trip (one episode)
+        clk.t += 0.3
+        sw.check()
+        assert reg.snapshot()["counters"]["stall_trips_total"] == 1
+
+        # one completed step recovers the verdict in ONE observation and
+        # records the episode duration
+        clk.t += 0.2
+        sw.step_completed(0.1)
+        assert hm.status() == health_mod.OK
+        snap = reg.snapshot()
+        assert snap["gauges"]["stall_current"] == 0
+        h = snap["histograms"]["stall_seconds"]
+        assert h["count"] == 1
+        # the wedge began when the last step finished: 3.0s of fake time
+        assert h["sum"] == pytest.approx(3.0, abs=1e-6)
+    finally:
+        hm.close()
+
+
+def test_stall_event_and_flight_bundle_at_stall_time(tmp_path):
+    """The trip emits a ``stall`` event with the live phase and captures
+    the flight bundle WHILE wedged (rate-limited on repeat trips)."""
+    sw, hm, reg, clk = _watch()
+    obs.configure_event_log()  # fresh in-memory ring
+    flight_mod.install(str(tmp_path), catch_signals=False)
+    try:
+        for _ in range(3):
+            sw.step_completed(0.05)
+        sw.mark("exchange")
+        clk.t += 5.0
+        sw.check()
+        events = [r for r in obs.get_event_log().records()
+                  if r.get("kind") == "stall"]
+        assert events and events[-1]["action"] == "stall"
+        assert events[-1]["phase"] == "exchange"
+        assert events[-1]["wait_s"] >= events[-1]["deadline_s"]
+        def stall_bundles():
+            out = []
+            for p in tmp_path.glob("flight-*.jsonl"):
+                recs = obs.read_jsonl(str(p))
+                if recs and recs[0].get("reason", "").startswith("stall:"):
+                    out.append(recs[0]["reason"])
+            return out
+
+        # the watchdog's own at-trip bundle (the monitor may add its
+        # anomaly bundle beside it once the verdict reaches UNHEALTHY —
+        # both are rate-limited independently)
+        assert stall_bundles() == ["stall:sw_trainerless:exchange"] \
+            or len(stall_bundles()) == 1
+        assert reg.snapshot()["counters"]["stall_flight_dumps_total"] == 1
+
+        # recover, re-trip inside the flight rate limit: event yes,
+        # second stall bundle no
+        clk.t += 0.1
+        sw.step_completed(0.05)
+        clk.t += 5.0
+        sw.check()
+        assert reg.snapshot()["counters"]["stall_trips_total"] == 2
+        assert len(stall_bundles()) == 1
+        assert reg.snapshot()["counters"]["stall_flight_dumps_total"] == 1
+    finally:
+        flight_mod.uninstall()
+        obs.configure_event_log()
+        hm.close()
+
+
+def test_env_knobs_and_arming(monkeypatch):
+    monkeypatch.setenv("LIGHTCTR_STALL_FACTOR", "7")
+    monkeypatch.setenv("LIGHTCTR_STALL_MIN_S", "0.25")
+    sw, hm, _, _ = _watch(factor=None, min_s=None)
+    try:
+        assert sw.factor == 7.0 and sw.min_s == 0.25
+    finally:
+        hm.close()
+    # LIGHTCTR_STALL gates maybe_from_env (the trainer-ctor hook)
+    reg = MetricsRegistry()
+    hm = health_mod.HealthMonitor(component="sw_env", registry=reg)
+    try:
+        monkeypatch.delenv("LIGHTCTR_STALL", raising=False)
+        assert stepwatch_mod.maybe_from_env(hm) is None
+        monkeypatch.setenv("LIGHTCTR_STALL", "1")
+        sw = stepwatch_mod.maybe_from_env(hm)
+        assert isinstance(sw, StepWatch)
+        assert hm.detector("stall") is not None
+        sw.close()
+        with health_mod.override(False):
+            assert stepwatch_mod.maybe_from_env(hm) is None
+    finally:
+        hm.close()
+
+
+def test_trainer_wiring_marks_phases_and_feeds_steps():
+    """``arm_stepwatch`` binds a watch to the trainer's monitor; every
+    recorded step feeds it (the same drain as the health feed) and the
+    phase marks move through input/exec back to idle."""
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.normal(size=(64, 8)).astype(np.float32),
+        "labels": (rng.random(64) > 0.5).astype(np.float32),
+    }
+    params = {"w": np.zeros((8,), np.float32)}
+    tr = CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
+                    TrainConfig(learning_rate=0.1))
+    hm = health_mod.HealthMonitor(component="sw_trainer",
+                                  registry=MetricsRegistry())
+    tr.health = hm
+    assert tr.stepwatch is None  # LIGHTCTR_STALL unset in the suite
+    sw = tr.arm_stepwatch(min_s=60.0, factor=100.0, start=False,
+                          registry=MetricsRegistry())
+    assert tr.arm_stepwatch() is sw  # idempotent
+    try:
+        for _ in range(4):
+            tr.train_step(batch)
+        st = sw.check()
+        assert st["steps"] == 4 and st["phase"] == "idle"
+        assert st["armed"] and not st["stalled"]
+        assert sw.deadline() == 60.0  # min_s dominates sane step times
+        # the disabled plane never feeds the watch (no overhead there)
+        with obs.override(False):
+            tr.train_step(batch)
+        assert sw.check()["steps"] == 4
+    finally:
+        sw.close()
+        hm.close()
+
+
+def test_pause_stands_the_deadman_down_until_the_next_step():
+    """A trainer that FINISHED (fit returned) is deliberately idle —
+    pause() must keep the watchdog from reading that as a wedge, and the
+    next completed step must re-arm it without ceremony."""
+    sw, hm, reg, clk = _watch()
+    try:
+        for _ in range(3):
+            sw.step_completed(0.1)
+        sw.pause()
+        clk.t += 1e6
+        st = sw.check()
+        assert st["armed"] is False and st["stalled"] is False
+        assert hm.status() == health_mod.OK
+        assert "stall_trips_total" not in reg.snapshot()["counters"]
+        # one step resumes the watch with its EWMA intact
+        sw.step_completed(0.1)
+        clk.t += 5.0
+        assert sw.check()["stalled"] is True
+        # pausing WHILE wedged recovers the verdict first (a pause is a
+        # statement about the future, not an amnesty bookkeeping hole)
+        sw.pause()
+        assert hm.status() == health_mod.OK
+        assert reg.snapshot()["gauges"]["stall_current"] == 0
+    finally:
+        hm.close()
+
+
+def test_trainer_fit_pauses_the_watchdog():
+    rng = np.random.default_rng(0)
+    arrays = {
+        "x": rng.normal(size=(32, 4)).astype(np.float32),
+        "labels": (rng.random(32) > 0.5).astype(np.float32),
+    }
+    tr = CTRTrainer({"w": np.zeros((4,), np.float32)},
+                    lambda p, b: b["x"] @ p["w"],
+                    TrainConfig(learning_rate=0.1, epochs=2))
+    hm = health_mod.HealthMonitor(component="sw_fit",
+                                  registry=MetricsRegistry())
+    tr.health = hm
+    sw = tr.arm_stepwatch(min_s=60.0, start=False,
+                          registry=MetricsRegistry())
+    try:
+        tr.fit(arrays, batch_size=8)
+        assert sw._paused is True  # fit stood the deadman down
+        # explicit kwargs on a re-arm REPLACE the env/default watch
+        # (the caller's deadline must win, never be silently ignored)
+        sw2 = tr.arm_stepwatch(min_s=30.0, start=False,
+                               registry=MetricsRegistry())
+        assert sw2 is not sw and sw2.min_s == 30.0
+        assert tr.arm_stepwatch() is sw2  # kwarg-less call returns it
+        sw2.close()
+    finally:
+        sw.close()
+        hm.close()
+
+
+def test_watch_thread_trips_without_a_poke():
+    """The real poll thread (no fake clock): a watch armed with a tiny
+    deadline trips on its own while no step completes."""
+    reg = MetricsRegistry()
+    hm = health_mod.HealthMonitor(component="sw_thread", registry=reg)
+    sw = StepWatch(monitor=hm, registry=reg, min_s=0.2, factor=1.0,
+                   warmup=1, poll_s=0.05)
+    try:
+        sw.step_completed(0.01)
+        deadline = time.monotonic() + 5.0
+        while hm.status() == health_mod.OK and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hm.status() in (health_mod.DEGRADED, health_mod.UNHEALTHY)
+        sw.step_completed(0.01)
+        assert hm.status() == health_mod.OK
+    finally:
+        sw.close()
+        hm.close()

@@ -102,6 +102,35 @@ def test_merge_apply_compiled_matches_reference():
                                   np.asarray(table)[untouched])
 
 
+def test_quantize_pack16_search_kernel_compiled_bit_identical():
+    """The 16-bit wire pack's VMEM binary search (``_qp_search_kernel``,
+    ISSUE 13): log2(N)+1 VECTOR GATHERS over a +inf-padded power-of-two
+    boundary table — exactly the construct where compiled Mosaic's
+    gather lowering can diverge from the interpreter, so the real-chip
+    gate pins the codes bit-identical to ``quantize.compress``'s binary
+    search, exact boundary hits and out-of-range clips included (the
+    ROADMAP PR-13 follow-up)."""
+    _require_tpu()
+    from lightctr_tpu.ops import quantize
+    from lightctr_tpu.ops import sparse_kernels as sk
+
+    r = np.random.default_rng(3)
+    for bits, mode in ((16, "uniform"), (16, "log"), (12, "uniform")):
+        t = quantize.build_table(-1.0, 1.0, bits=bits, mode=mode)
+        bnd = np.asarray(t.boundaries)
+        x = jnp.asarray(np.concatenate([
+            (2.0 * r.normal(size=4096)).astype(np.float32),
+            bnd[r.integers(0, bnd.shape[0], size=512)],  # boundary hits
+            np.array([-1.5, 1.5, 0.0, -0.0, 1e-9], np.float32),
+        ]).reshape(-1, 1))
+        got = sk.KERNELS["quantize_pack"].pallas(t, x, interpret=False)
+        want = quantize.compress(t, x)
+        assert np.asarray(got).dtype == np.asarray(want).dtype
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"bits={bits} mode={mode}")
+
+
 def test_quantize_pack_compiled_bit_identical():
     _require_tpu()
     from lightctr_tpu.ops import quantize

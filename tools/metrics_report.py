@@ -38,6 +38,13 @@ turns either into something readable:
       #    per-entry apply-age percentiles, deltas applied vs
       #    degraded-to-full-refresh by reason, model hot-swap
       #    attempts/refusals, continuous-trainer step/export counters
+  python -m tools.metrics_report --cluster MEMBERS_JSON
+      # -> cluster straggler report (docs/OBSERVABILITY.md "Cluster
+      #    rollup"): hosts ranked by rendezvous round-wait contribution
+      #    (hier_round_wait_seconds{host=...}), members by step-time
+      #    skew, scrape-down members listed — from a ClusterRollup
+      #    members() dump, a {member: stats-or-snapshot} map, or a
+      #    ShardedPSClient.stats() list
 """
 
 from __future__ import annotations
@@ -503,6 +510,45 @@ def summarize_online(doc) -> dict:
     return report
 
 
+def summarize_cluster(doc) -> dict:
+    """Cluster rollup dump -> straggler/rollup report.  Accepts the
+    :meth:`~lightctr_tpu.obs.cluster.ClusterRollup.members` dict, a bare
+    ``{member: stats-or-snapshot}`` map, or the list
+    ``ShardedPSClient.stats()`` returns (down shards become
+    ``scrape_down`` members — the same never-vanish rule)."""
+    from lightctr_tpu.obs.cluster import attribute_stragglers
+
+    members: dict = {}
+
+    def _entry(name, st):
+        if isinstance(st, dict) and (st.get("down") or st.get("scrape_down")):
+            return {"member": name, "scrape_down": True,
+                    "error": st.get("error"), "snapshot": {}}
+        if isinstance(st, dict) and "snapshot" in st:
+            e = dict(st)
+            e.setdefault("member", name)
+            e.setdefault("scrape_down", False)
+            return e
+        snap = {}
+        if isinstance(st, dict):
+            snap = st.get("telemetry", st if "counters" in st
+                          or "histograms" in st or "gauges" in st else {})
+        return {"member": name, "scrape_down": False,
+                "snapshot": snap or {}}
+
+    if isinstance(doc, list):
+        for i, st in enumerate(doc):
+            name = (str(st.get("shard", i)) if isinstance(st, dict)
+                    else str(i))
+            members[f"shard_{name}"] = _entry(f"shard_{name}", st)
+    elif isinstance(doc, dict):
+        for name, st in doc.items():
+            members[str(name)] = _entry(str(name), st)
+    report = attribute_stragglers(members)
+    report["members_total"] = len(members)
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", nargs="?", help="event-log path (JSONL)")
@@ -536,6 +582,10 @@ def main(argv=None):
                          "(trainer_exchange_*/trainer_hier_* series, the "
                          "hierarchical per-hop local/wire split included) "
                          "from a registry snapshot or stats() dump")
+    ap.add_argument("--cluster", metavar="MEMBERS_JSON",
+                    help="cluster straggler report from a ClusterRollup "
+                         "members() dump, {member: stats} map, or "
+                         "ShardedPSClient.stats() list")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -598,11 +648,20 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.cluster:
+        with open(args.cluster) as f:
+            doc = json.load(f)
+        report = summarize_cluster(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
-                 "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, or "
-                 "--online SNAPSHOT_JSON")
+                 "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, "
+                 "--cluster MEMBERS_JSON, or --online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

@@ -16,6 +16,13 @@ view:
   python -m tools.trace_report --flight BUNDLE.jsonl
       # -> flight-bundle postmortem: reason, registry snapshots, span
       #    ring and event ring summaries
+  python -m tools.trace_report DIR --rounds [--epoch N]
+      # -> hierarchical-exchange round timelines: for each (epoch,
+      #    table) rendezvous round, every host's push arrival offset
+      #    behind the first, pull-satisfied offsets, the straggler by
+      #    name, and the round's critical path (first push -> last push
+      #    -> last pull satisfied) stitched from the hier client/shard
+      #    spans that share trace context over the wire
 
 A directory argument expands to every ``trace-*.jsonl`` inside it (the
 per-process files one run leaves behind).  Reads are tolerant of torn
@@ -134,6 +141,89 @@ def summarize_spans(spans: List[Dict], top: int = 10) -> Dict:
     return report
 
 
+def summarize_rounds(spans: List[Dict], epoch=None) -> Dict:
+    """Hier client spans -> per-(epoch, table) round timelines.  Every
+    ``hier_client/push*`` span carries ``epoch``/``table``/``host``
+    attrs (dist/hier.py), so one merged span set from all hosts yields,
+    per round: each host's push arrival offset behind the round's FIRST
+    push (the wait it charged the round with), its pull-satisfied
+    offset, the straggler by name, and the critical path.  Shard-side
+    ``hier/push|pull`` spans stitch under these via the wire trace
+    context (counted here as ``shard_spans``)."""
+    rounds: Dict = {}
+    shard_spans = 0
+    for s in spans:
+        name = s.get("name", "")
+        if name in ("hier/push", "hier/pull"):
+            shard_spans += 1
+            continue
+        if name not in ("hier_client/push", "hier_client/push_group",
+                        "hier_client/pull", "hier_client/pull_group"):
+            continue
+        attrs = s.get("attrs") or {}
+        ep = attrs.get("epoch")
+        if ep is None or (epoch is not None and int(ep) != int(epoch)):
+            continue
+        key = (int(ep), attrs.get("table", "group"))
+        r = rounds.setdefault(key, {"hosts": {}})
+        host = str(attrs.get("host", s.get("pid", "?")))
+        h = r["hosts"].setdefault(host, {})
+        if name.startswith("hier_client/push"):
+            # first push per host wins (a retried frame keeps the
+            # original arrival)
+            h.setdefault("push_ts", float(s.get("ts", 0.0)))
+        else:
+            h["pull_done_ts"] = (float(s.get("ts", 0.0))
+                                 + float(s.get("dur_s", 0.0)))
+    out = []
+    for (ep, table) in sorted(rounds, key=lambda k: (k[0], str(k[1]))):
+        r = rounds[(ep, table)]
+        pushes = {h: v["push_ts"] for h, v in r["hosts"].items()
+                  if "push_ts" in v}
+        if not pushes:
+            continue
+        t0 = min(pushes.values())
+        first = min(pushes, key=pushes.get)
+        straggler = max(pushes, key=pushes.get)
+        spread = pushes[straggler] - t0
+        hosts = {}
+        for h, v in sorted(r["hosts"].items()):
+            e: Dict = {}
+            if "push_ts" in v:
+                e["push_offset_s"] = round(v["push_ts"] - t0, 6)
+            if "pull_done_ts" in v:
+                e["pull_done_offset_s"] = round(v["pull_done_ts"] - t0, 6)
+            hosts[h] = e
+        entry: Dict = {
+            "epoch": ep, "table": table, "hosts": hosts,
+            "straggler": straggler,
+            "arrival_spread_s": round(spread, 6),
+        }
+        pulls = [v["pull_done_ts"] for v in r["hosts"].values()
+                 if "pull_done_ts" in v]
+        if pulls:
+            done = max(pulls) - t0
+            entry["round_done_offset_s"] = round(done, 6)
+            entry["critical_path"] = [
+                {"event": "first_push", "host": first, "offset_s": 0.0},
+                {"event": "last_push", "host": straggler,
+                 "offset_s": round(spread, 6)},
+                {"event": "last_pull_satisfied",
+                 "offset_s": round(done, 6)},
+            ]
+        out.append(entry)
+    report: Dict = {"rounds": out, "count": len(out),
+                    "shard_spans": shard_spans}
+    if out:
+        worst = max(out, key=lambda r: r["arrival_spread_s"])
+        report["worst_round"] = {
+            "epoch": worst["epoch"], "table": worst["table"],
+            "straggler": worst["straggler"],
+            "arrival_spread_s": worst["arrival_spread_s"],
+        }
+    return report
+
+
 def summarize_flight(path: str) -> Dict:
     """Flight bundle -> postmortem report."""
     recs = read_jsonl(path)
@@ -207,6 +297,11 @@ def main(argv=None) -> int:
                     help="also write a Chrome trace-event / Perfetto JSON")
     ap.add_argument("--flight", metavar="BUNDLE",
                     help="summarize a flight-recorder bundle instead")
+    ap.add_argument("--rounds", action="store_true",
+                    help="per-round hierarchical-exchange timelines: host "
+                         "arrival offsets, straggler, critical path")
+    ap.add_argument("--epoch", type=int, default=None,
+                    help="with --rounds: only this rendezvous epoch")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest-span table length (default 10)")
     ap.add_argument("--out", help="write the report JSON here too")
@@ -214,6 +309,10 @@ def main(argv=None) -> int:
 
     if args.flight:
         report = summarize_flight(args.flight)
+    elif args.rounds:
+        if not args.paths:
+            ap.error("--rounds needs span JSONL paths/directories")
+        report = summarize_rounds(load_spans(args.paths), epoch=args.epoch)
     else:
         if not args.paths:
             ap.error("give span JSONL paths/directories, or --flight BUNDLE")
