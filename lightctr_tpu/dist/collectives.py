@@ -403,6 +403,18 @@ def _wire_value_bytes(compress_bits: int | None) -> int:
     return 4 if compress_bits is None else (1 if compress_bits <= 8 else 2)
 
 
+def _wire_row_bytes(dim: int, compress_bits: int | None) -> int:
+    """Wire bytes of ONE row of ``dim`` values under the codec: fp32
+    (None), 2-byte codes (9..16 bits), 1-byte codes (5..8 bits), or the
+    BIT-PACKED sub-byte codes (<= 4 bits: two codes per byte, odd dim
+    rounds up — ``ops.quantize.pack_nibbles``)."""
+    if compress_bits is None:
+        return int(dim) * 4
+    if compress_bits <= 4:
+        return (int(dim) + 1) // 2
+    return int(dim) * _wire_value_bytes(compress_bits)
+
+
 def sparse_exchange_bytes(
     n: int, k_padded: int, dim: int, compress_bits: int | None = None,
     include_ids: bool = True,
@@ -416,7 +428,7 @@ def sparse_exchange_bytes(
     only the first table in the group pays the id bytes)."""
     idb = 4 if include_ids else 0
     return int((n - 1) * int(k_padded)
-               * (idb + int(dim) * _wire_value_bytes(compress_bits)))
+               * (idb + _wire_row_bytes(dim, compress_bits)))
 
 
 def dense_ring_bytes(
@@ -426,8 +438,8 @@ def dense_ring_bytes(
     gradient: reduce-scatter + all-gather each move (n-1) segments of
     vocab*dim/n values (ring_all_reduce's schedule; psum lowers to the
     same ring)."""
-    return int(2 * (n - 1) * int(vocab) * int(dim)
-               * _wire_value_bytes(compress_bits) // n)
+    return int(2 * (n - 1) * int(vocab)
+               * _wire_row_bytes(dim, compress_bits) // n)
 
 
 def prefer_sparse_exchange(
@@ -512,9 +524,8 @@ def sparse_rs_bytes(
     plus n-1 merged-shard segments in the all-gather phase, each entry an
     int32 id + dim coded/fp32 values.  ``include_ids=False`` prices a
     table riding a shared id stream (ids exchanged once per group)."""
-    vb = _wire_value_bytes(compress_bits)
     idb = 4 if include_ids else 0
-    per_entry = idb + int(dim) * vb
+    per_entry = idb + _wire_row_bytes(dim, compress_bits)
     return int((n - 1) * (int(bucket_cap) + int(shard_cap)) * per_entry)
 
 
@@ -660,10 +671,15 @@ def hier_wire_bytes(
     table: push its ``k_out`` locally-merged entries + pull the
     ``k_in``-entry cross-host union, each entry an id plus ``dim`` values
     (``wire_bits`` None = the exact fp32 wire codec, 16 = the PS fp16
-    codec, <=8 = 1-byte codes).  Flat in local replica count by
-    construction — the replicas merged before the wire."""
+    codec, 5..8 = 1-byte codes, <=4 = bit-packed nibble codes at two per
+    byte — ``ops.quantize.pack_nibbles``).  Note ``wire_bits=4`` prices
+    the ``quantize_pack_packed`` nibble codec, which exists at the
+    kernel layer; ``HierExchangeClient`` ships None/16/8-bit frames
+    today, so pass 4 only when pricing a 4-bit wire you actually run
+    (client wiring is a ROADMAP follow-up).  Flat in local replica count
+    by construction — the replicas merged before the wire."""
     idb = 4 if include_ids else 0
-    per = idb + int(dim) * _wire_value_bytes(wire_bits)
+    per = idb + _wire_row_bytes(dim, wire_bits)
     return int((int(k_out) + int(k_in)) * per)
 
 
@@ -757,7 +773,8 @@ def pick_exchange_algo(
     probe noise.  For the hier branch the returned bytes are the DCN WIRE
     bytes per host (the scarce resource the pick is protecting);
     ``wire_bits`` prices the wire codec (None = exact fp32, 16 = the PS
-    fp16 codec)."""
+    fp16 codec, 8 = the q8_ef coded frame, 4 = the bit-packed nibble
+    codec — kernel-layer only today, see :func:`hier_wire_bytes`)."""
     dense_b = dense_ring_bytes(vocab, dim, n, dense_bits)
     ag_b = sparse_exchange_bytes(n, k_padded, dim, sparse_bits)
     bucket, shard = rs_default_caps(n, k_padded, vocab, slack)

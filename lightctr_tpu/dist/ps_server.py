@@ -266,6 +266,7 @@ class ParamServerService:
         on_farewell=None,
         health=None,
         route_provider=None,
+        fault_prefetch_echo: bool = True,
     ):
         """``monitor``: optional HeartbeatMonitor; when given, MSG_BEAT
         frames drive it (workers heartbeat over their PS connection, the
@@ -279,8 +280,21 @@ class ParamServerService:
         this shard with an SSP-staleness detector wired to the store.
         ``route_provider``: zero-arg callable returning the current
         routing-table dict — the MASTER role passes its cluster map so
-        clients can poll ``MSG_ROUTE``; plain shards leave it None."""
+        clients can poll ``MSG_ROUTE``; plain shards leave it None.
+        ``fault_prefetch_echo``: when the hosted store runs the fault
+        prefetch pipeline (:class:`~lightctr_tpu.embed.tiered.
+        TieredEmbeddingStore` — docs/TIERED_STORE.md "Device-resident
+        hot tier"), every landed MSG_PUSH echoes its key cover into
+        ``dispatch_prefetch``: the hosted trainer's next pull repeats
+        most of the working set (skewed CTR streams), so the push's
+        admission-rejected warm/cold rows are staged while the worker
+        computes its next batch — the wire analogue of the in-process
+        dispatch/commit pair, with no lookahead protocol needed.  The
+        stage is best-effort: a wrong guess costs one wasted copy, and
+        the store's plan guards keep the landed bytes identical."""
         self.ps = ps
+        self._pf_echo = getattr(ps, "dispatch_prefetch", None) \
+            if fault_prefetch_echo else None
         self.monitor = monitor
         self.on_farewell = on_farewell
         self.route_provider = route_provider
@@ -407,6 +421,13 @@ class ParamServerService:
                                 struct.pack("<IB", 1, 0)
                                 + (b"\x00" if ok else b"\x01")
                             )
+                            if ok and self._pf_echo is not None:
+                                # push-echo fault prefetch: stage this
+                                # cover's non-resident rows behind the
+                                # worker's next compute window (reply
+                                # already on the wire — the echo never
+                                # adds push latency)
+                                self._pf_echo(keys)
                         elif msg_type == MSG_PRELOAD:
                             keys, rows = _keys_and_rows(
                                 payload, dim, np.float32

@@ -234,6 +234,20 @@ TIER_SERIES = (
     "tiered_cold_rows",             # gauge: cold-resident rows
     "tiered_bytes_resident",        # gauge: fast-tier (hot+warm) bytes
     "tiered_fault_seconds",         # histogram: fault-path latency
+    # -- fault prefetch pipeline (device-resident hot tier, PR 15) --------
+    "tiered_fault_prefetch_batches_total",  # counter: dispatch tickets staged
+    "tiered_fault_prefetch_rows_total",     # counter: miss rows staged ahead
+    "tiered_fault_overlap_rows_total",  # counter: fault rows served from a
+                                        # stage (read overlapped the step)
+    "tiered_fault_sync_rows_total",     # counter: fault rows read in-line
+    "tiered_fault_prefetch_stale_total",  # counter: staged rows invalidated
+                                          # by an interleaved write pre-use
+    "tiered_fault_overlap_ratio",   # gauge: overlap / (overlap + sync)
+    "tiered_pull_plan_commits_total",  # counter: pulls served off a
+                                       # dispatched plan (fast commit)
+    "tiered_pull_plan_fallbacks_total",  # counter: plans invalidated by an
+                                         # interleaved mutation (sync path)
+    "tiered_dev_syncs_total",       # counter, {dir}: device block exports
 )
 
 
@@ -279,6 +293,8 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         ledger: Optional[FrequencyLedger] = None,
         health_feed_every: int = 16,
         cold_compact_factor: int = 4,
+        device_hot: Optional[bool] = None,
+        prefetch: Optional[bool] = None,
     ):
         if updater not in ("sgd", "adagrad"):
             raise ValueError(
@@ -309,9 +325,37 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         self._lock = threading.Lock()
 
         # -- hot tier: slot-recycled resident block --------------------------
+        #
+        # Two representations behind ONE accessor family (_payload /
+        # _hot_rows_of / _hot_land / _apply_slots):
+        #   numpy mode (the committed host buffer — the CPU default, and
+        #     what JAX_PLATFORMS=cpu parity tests exercise unchanged):
+        #     _W/_acc host ndarrays, fancy-indexed;
+        #   device mode (device_hot=True; the TPU default): ONE pinned
+        #     jax.Array [hot_rows, 2*dim] = [rows ‖ accums] that the
+        #     jitted gather/apply programs alias in place (donated), so
+        #     the pull → gather → apply chain for hot-resident uids never
+        #     leaves the device.  The updater expression is IDENTICAL to
+        #     the numpy form (w - lr*g/sqrt(acc+eps), fp32 end to end), so
+        #     flat/tiered trajectory parity holds bit-for-bit either way.
+        # Demotion write-back, snapshots and migration all read the
+        # authoritative rows through _payload/_read_values — the accessor
+        # syncs (gathers from) the device block, never a stale mirror.
         cap = self.hot_rows
-        self._W = np.zeros((cap, dim), np.float32)
-        self._acc = np.zeros((cap, dim), np.float32)
+        self.device_hot = self._resolve_device_hot(device_hot)
+        if self.device_hot:
+            # the pinned pair: rows and accums as separate device arrays
+            # so the trainer fast path's fused merge_apply can alias each
+            # in place (adopt_device_tables is a reference swap, no copy)
+            self._W = None
+            self._acc = None
+            self._devW = self._dev_zeros(cap, dim)
+            self._devA = self._dev_zeros(cap, dim)
+        else:
+            self._W = np.zeros((cap, dim), np.float32)
+            self._acc = np.zeros((cap, dim), np.float32)
+            self._devW = None
+            self._devA = None
         self._slot_keys = np.full(cap, -1, np.int64)
         # free-slot LIFO as an array stack (top = _n_free; pops take slot
         # 0 first) — a python list's per-slot pop showed on the fault path
@@ -410,6 +454,13 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         # preload/migration -> ``_mut_epoch`` bump, always flush-first)
         # invalidates it.
         self._fault_cache: Optional[tuple] = None
+        self._cache_serial = 0  # bumps on every cache INSTALL (plan guard)
+        # cache installed by a DISPATCH (speculative serve): the pull
+        # side probes it (the rows were read off the critical path — the
+        # probe is how partial-cover dispatches, e.g. the hosted push
+        # echo, still overlap) and counts its hits as overlap rows
+        self._cache_speculative = False
+        self._cache_hits_speculative = 0
         self._mut_epoch = 0
         # whether the cache may hold PENDING creates (origin
         # _ORIGIN_PENDING): rows that consumed the rng stream but are not
@@ -435,8 +486,477 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         self._flow_bypass = 0
         self._pushes_since_feed = 0
         self._occupancy_skips = 0
+        # -- fault prefetch pipeline (docs/TIERED_STORE.md "Device-resident
+        # hot tier"): dispatch_prefetch(next_keys) stages the NEXT batch's
+        # miss payloads on a worker thread while the current step computes;
+        # the committing pull serves staged rows without touching the slow
+        # tiers.  Double-buffered: one stage in flight, one queued.  Every
+        # write that could stale a staged row invalidates it surgically
+        # (_pf_invalidate) or wholesale (_mut_epoch) — overlap NEVER
+        # changes which bytes land (the overlap-vs-sync equivalence test).
+        if prefetch is None:
+            env = os.environ.get("LIGHTCTR_TIERED_PREFETCH", "").strip()
+            prefetch = env not in ("0", "off", "false")
+        self._prefetch_enabled = bool(prefetch)
+        self._pf_thread = None
+        self._pf_queue = None
+        self._pf_cond = threading.Condition()
+        self._pf_ticket = 0
+        self._pf_completed = 0
+        self._pf_stage: Optional[tuple] = None
+        self._pf_plan: Optional[dict] = None
+        self._stage_hits_last = 0
+        self._pf_overlap_rows = 0
+        self._pf_sync_rows = 0
+        self._closed = False
         if obs_gate.enabled():
             self.registry.gauge_set("tiered_hot_row_budget", self.hot_rows)
+
+    # -- device-resident hot block --------------------------------------------
+
+    @staticmethod
+    def _resolve_device_hot(flag: Optional[bool]) -> bool:
+        """Explicit flag > ``LIGHTCTR_DEVICE_HOT`` env > backend default
+        (pinned on TPU, committed host buffer on CPU — where donation is
+        not honored and a jit round trip per batch would only add copies)."""
+        if flag is not None:
+            return bool(flag)
+        env = os.environ.get("LIGHTCTR_DEVICE_HOT", "").strip().lower()
+        if env in ("1", "on", "true"):
+            return True
+        if env in ("0", "off", "false"):
+            return False
+        try:
+            import jax
+
+            return jax.default_backend() == "tpu"
+        except Exception:  # jax absent/broken: host mode keeps working
+            return False
+
+    @staticmethod
+    def _dev_zeros(rows: int, width: int):
+        import jax.numpy as jnp
+
+        return jnp.zeros((rows, width), jnp.float32)
+
+    def _dev_gather(self, arr, slots: np.ndarray) -> np.ndarray:
+        from lightctr_tpu.ops.sparse_kernels import next_pow2
+
+        n = len(slots)
+        if n == 0:
+            return np.zeros((0, int(arr.shape[1])), np.float32)
+        # pad to the shared pow2 ladder: hit counts differ nearly every
+        # batch, and an unpadded length would recompile the gather per
+        # distinct count
+        sp = np.zeros(next_pow2(n), np.int32)
+        sp[:n] = slots
+        return np.asarray(self._dev_fns()["gather"](arr, sp))[:n]
+
+    @staticmethod
+    def _pad_scatter(slots: np.ndarray, rows: np.ndarray):
+        """(padded slots, padded rows) for the device scatter: lengths
+        land on the shared pow2 ladder (bounded jit cache) and the pad
+        entries DUPLICATE the last real (slot, row) pair, so every
+        repeat of that slot set-writes identical bytes — the scatter's
+        undefined duplicate order cannot matter."""
+        from lightctr_tpu.ops.sparse_kernels import next_pow2
+
+        n = len(slots)
+        up = next_pow2(n)
+        sp = np.full(up, slots[n - 1], np.int32)
+        sp[:n] = slots
+        rp = np.empty((up, rows.shape[1]), np.float32)
+        rp[:n] = rows
+        rp[n:] = rows[n - 1]
+        return sp, rp
+
+    # The device hot-tier ops run EAGER, op by op, ON PURPOSE: each XLA
+    # elementwise op is correctly rounded, so the updater (acc' = acc +
+    # g*g ; w' = w - lr*g / sqrt(acc' + eps)) is BIT-IDENTICAL to the
+    # numpy committed-buffer path and the flat store — fusing the
+    # expression under jit lets LLVM contract mul+add into FMA and the
+    # algebraic simplifier turn /sqrt into *rsqrt, which is exactly the
+    # single-ulp drift the flat/tiered parity contract forbids
+    # (measured; see test_tiered.py device-parity tests).  The rows
+    # still never leave the device: the cost of eager here is per-op
+    # dispatch, not host↔HBM row traffic.  The FUSED donated chain
+    # (gather → merge_apply aliasing the pair in place) lives in the
+    # trainer fast path (models/sparse_trainer.py TieredDeviceEmbedding),
+    # whose parity oracle is merge_apply itself.
+    _DEV_FNS: Optional[dict] = None
+
+    @classmethod
+    def _dev_fns(cls) -> dict:
+        if cls._DEV_FNS is None:
+            import jax
+            import jax.numpy as jnp
+
+            from lightctr_tpu.ops import sparse_kernels
+
+            def gather(arr, slots):
+                return sparse_kernels.gather_rows(arr, jnp.asarray(slots))
+
+            def scatter(arr, slots, rows):
+                return arr.at[slots].set(rows)
+
+            # The scatter is pure data movement — no arithmetic, so the
+            # eager bit-parity contract above is untouched — and jitted
+            # with donation so landing rows updates the pinned block in
+            # place instead of copying all [hot_rows, dim] per write
+            # (donation is a no-op copy where the backend declines it).
+            cls._DEV_FNS = {
+                "gather": gather,
+                "scatter": jax.jit(scatter, donate_argnums=(0,)),
+            }
+        return cls._DEV_FNS
+
+    def _note_dev_sync(self, direction: str) -> None:
+        if obs_gate.enabled():
+            self.registry.inc(
+                labeled("tiered_dev_syncs_total", dir=direction)
+            )
+
+    def device_tables(self):
+        """The hot tier as a ``(rows, accums)`` pair of ``jax.Array``s
+        ``[hot_rows, dim]`` each.  Device mode: THE pinned arrays
+        themselves — the trainer fast path gathers from them in-jit and
+        hands the fused ``merge_apply``'s aliased outputs back through
+        :meth:`adopt_device_tables` (a reference swap, no copy).  Treat
+        as read-only; the store owns mutation.  Numpy mode: a committed-
+        buffer export (one copy) for callers wanting the API uniformly."""
+        with self._lock:
+            if self.device_hot:
+                return self._devW, self._devA
+            import jax.numpy as jnp
+
+            self._note_dev_sync("export")
+            return jnp.asarray(self._W), jnp.asarray(self._acc)
+
+    def device_block(self):
+        """The hot tier as ONE ``[hot_rows, 2*dim]`` ``[rows ‖ accums]``
+        export (a concat copy in either mode) — the serving-side block
+        form.  Mutating consumers want :meth:`device_tables`."""
+        import jax.numpy as jnp
+
+        w, a = self.device_tables()
+        if self.device_hot:  # numpy mode: device_tables counted the export
+            self._note_dev_sync("export")
+        return jnp.concatenate([w, a], axis=1)
+
+    def adopt_device_tables(
+        self, rows, accums, touched_slots: Optional[np.ndarray] = None,
+        expect_res_epoch: Optional[int] = None,
+    ) -> None:
+        """Install the externally-updated device pair (the trainer fast
+        path's post-step donation hand-back).  Device mode only; shapes
+        must match — the caller got the pair from :meth:`device_tables`
+        and ran the registry's fused merge_apply aliasing it in place.
+        ``touched_slots`` marks exactly those slots dirty (all occupied
+        slots otherwise); ``expect_res_epoch`` fails loud when residency
+        moved between the caller's gather and this adopt (its slot
+        tickets were stale — the update must be retried on fresh
+        tickets, never silently written through dead slots)."""
+        if not self.device_hot:
+            raise ValueError("adopt_device_tables needs device_hot mode")
+        want = (self.hot_rows, self.dim)
+        if tuple(rows.shape) != want or tuple(accums.shape) != want:
+            raise ValueError(
+                f"table shapes {tuple(rows.shape)}/{tuple(accums.shape)}"
+                f" != {want}"
+            )
+        with self._lock:
+            if expect_res_epoch is not None and \
+                    expect_res_epoch != self._res_epoch:
+                raise ValueError(
+                    "stale slot tickets: residency moved "
+                    f"({expect_res_epoch} -> {self._res_epoch})"
+                )
+            self._devW = rows
+            self._devA = accums
+            if touched_slots is not None:
+                ts = np.asarray(touched_slots, np.int64)
+                self._dirty[ts] = True
+                self._note_write(self._slot_keys[ts])
+            else:
+                self._dirty[self._slot_keys >= 0] = True
+            self.write_version += 1
+            self._note_dev_sync("adopt")
+
+    def hot_slots(self, keys: np.ndarray) -> np.ndarray:
+        """Public vectorized key -> hot slot map (-1 = not resident) —
+        the slot tickets the trainer fast path gathers by.  A ticket is
+        valid until the next residency change (``res_epoch``)."""
+        with self._lock:
+            return self._hot_slots(np.ascontiguousarray(keys, np.int64))
+
+    @property
+    def res_epoch(self) -> int:
+        """Residency epoch: bumps on any promotion/demotion/eviction —
+        slot tickets from an older epoch must be re-probed."""
+        return self._res_epoch
+
+    # -- fault prefetch pipeline ----------------------------------------------
+    #
+    # The dispatch/commit ticket pair: ``dispatch_prefetch(next_keys)``
+    # (fire-and-forget) stages the NEXT batch's miss payloads — warm/cold
+    # reads only, NO creates (the rng stream is consumed at pull time in
+    # request order), NO admission, NO ledger touch — on a worker thread,
+    # overlapping the copy with the current step's execution.  The
+    # matching ``pull_batch`` commits: staged rows serve the fault path
+    # without touching the slow tiers (counted ``tiered_fault_overlap_
+    # rows_total``); anything not staged, staled by an interleaved write
+    # (``tiered_fault_prefetch_stale_total``), or on a store with the
+    # pipeline disabled falls back to the synchronous read — the bytes
+    # that land are identical either way.
+
+    def dispatch_prefetch(self, keys: np.ndarray) -> int:
+        """Stage the miss payloads a future ``pull_batch(keys)`` will
+        need.  Returns the dispatch ticket (0 = pipeline disabled or
+        queue full — the pull simply reads synchronously).  Safe to call
+        from any thread; never blocks on tier I/O."""
+        if not self._prefetch_enabled or self._closed:
+            return 0
+        # the RAW id stream, exactly as the pull will receive it: the
+        # plan precomputes the dedup (unique + inverse) too
+        keys_arr = np.ascontiguousarray(keys, np.int64).reshape(-1).copy()
+        if not len(keys_arr):
+            return 0
+        self._pf_ensure_thread()
+        if self._pf_queue is None:
+            return 0
+        with self._pf_cond:
+            self._pf_ticket += 1
+            ticket = self._pf_ticket
+        try:
+            self._pf_queue.put_nowait((ticket, keys_arr))
+        except Exception:
+            # double-buffer full: this batch reads synchronously.  The
+            # ticket completes immediately so prefetch_wait never hangs.
+            with self._pf_cond:
+                if ticket > self._pf_completed:
+                    self._pf_completed = ticket
+                self._pf_cond.notify_all()
+            return 0
+        return ticket
+
+    def prefetch_wait(self, ticket: Optional[int] = None,
+                      timeout: float = 30.0) -> bool:
+        """Block until dispatch ``ticket`` (default: the latest) has been
+        staged or dropped — the deterministic handle tests and drain paths
+        use; production callers never need it (commit falls back to the
+        synchronous read)."""
+        with self._pf_cond:
+            want = self._pf_ticket if ticket is None else ticket
+            return self._pf_cond.wait_for(
+                lambda: self._pf_completed >= want, timeout=timeout
+            )
+
+    def _pf_ensure_thread(self) -> None:
+        if self._pf_thread is not None and self._pf_thread.is_alive():
+            return
+        try:
+            import queue as _queue
+
+            # depth 2 = the double buffer: one stage in flight on the
+            # worker, one queued behind it
+            self._pf_queue = _queue.Queue(maxsize=2)
+            t = threading.Thread(
+                target=self._pf_worker, name="tiered-fault-prefetch",
+                daemon=True,
+            )
+            t.start()
+            self._pf_thread = t
+        except Exception:
+            _LOG.warning("fault prefetch worker failed to start; the "
+                         "store stays on the synchronous fault path",
+                         exc_info=True)
+            self._prefetch_enabled = False
+            self._pf_queue = None
+
+    def _pf_worker(self) -> None:
+        while True:
+            item = self._pf_queue.get()
+            if item is None:
+                return
+            ticket, keys_arr = item
+            try:
+                self._pf_stage_batch(keys_arr)
+            except Exception:
+                _LOG.warning("fault prefetch stage failed; batch will "
+                             "read synchronously", exc_info=True)
+            finally:
+                with self._pf_cond:
+                    if ticket > self._pf_completed:
+                        self._pf_completed = ticket
+                    self._pf_cond.notify_all()
+
+    def _pf_stage_batch(self, keys_raw: np.ndarray) -> None:
+        """Worker-side stage: run the commit pull's ENTIRE fault side —
+        dedup, hot probe, ledger touch, admission, demotion write-back,
+        fault-in, fault-cache install — ahead of the pull, behind the
+        step.  Legal because pushes change neither the ledger nor
+        residency: every admission input (and so every decision) is
+        identical whether taken here or at the pull, and the updater
+        math is identical on every path, so the trajectory cannot move
+        (the overlap-vs-sync equivalence contract).  The ONE thing a
+        dispatch must not do is consume the rng stream: a batch with
+        unseen keys degrades to a plain payload stage (reads only), and
+        its commit runs the normal path with the stage in front.
+
+        On success the pull PLAN (dedup arrays + post-admission slot
+        map + guard epochs) is recorded: the matching pull reduces to a
+        guarded hot gather plus cache copies (:meth:`_commit_plan`).
+        Holds the store lock throughout (tier mutation must not
+        interleave a torn view); the foreground only contends here
+        during its own store calls — which is the point: the stage
+        overlaps the step's compute, not the store's protocol ops."""
+        with self._lock:
+            if self._closed:
+                return
+            uniq, inverse = np.unique(keys_raw, return_inverse=True)
+            slots_u = self._hot_slots(uniq)
+            hit = slots_u >= 0
+            hs = slots_u[hit]
+            miss = ~hit
+            n_staged = 0
+            if miss.any():
+                served = self._serve_misses(
+                    uniq[miss], hs, grads=None, speculative=True,
+                )
+                if served is None:
+                    # unseen keys: degrade to the payload-only stage (no
+                    # admission, no rng) — the commit pull runs the
+                    # normal path with these reads in front
+                    miss_keys = uniq[miss]
+                    payload, origin, tickets = self._read_payload(
+                        miss_keys)
+                    self._pf_stage = (
+                        miss_keys, payload, origin, tickets,
+                        self._mut_epoch, np.ones(len(miss_keys), bool),
+                    )
+                    self._pf_plan = None
+                    if obs_gate.enabled():
+                        reg = self.registry
+                        reg.inc("tiered_fault_prefetch_batches_total")
+                        reg.inc("tiered_fault_prefetch_rows_total",
+                                len(miss_keys))
+                    return
+                la = self._last_admitted
+                if la is not None:
+                    midx = np.flatnonzero(miss)
+                    slots_u[midx[la[0]]] = la[1]
+                n_staged = int(miss.sum())
+            self._pf_plan = {
+                "ids": keys_raw,
+                "uniq": uniq,
+                "inverse": inverse,
+                "slots": slots_u,
+                "prehit": hs,
+                "res_epoch": self._res_epoch,
+                "mut_epoch": self._mut_epoch,
+                "cache_serial": self._cache_serial,
+            }
+            if obs_gate.enabled():
+                reg = self.registry
+                reg.inc("tiered_fault_prefetch_batches_total")
+                if n_staged:
+                    reg.inc("tiered_fault_prefetch_rows_total", n_staged)
+
+    def _commit_plan(self, plan: dict,
+                     keys_arr: np.ndarray) -> Optional[np.ndarray]:
+        """The fast half of a planned pull: validate the guards (no
+        interleaved mutation moved residency, the cache is still the
+        dispatch's install, the request is byte-identical), then serve
+        hot rows by gather and planned misses from the fault cache.
+        Returns None on any guard failure — the caller falls through to
+        the normal path, which is state-agnostic and therefore always
+        correct.  Caller holds the lock."""
+        if (plan["mut_epoch"] != self._mut_epoch
+                or plan["res_epoch"] != self._res_epoch
+                or plan["cache_serial"] != self._cache_serial
+                or len(plan["ids"]) != len(keys_arr)
+                or not bool(np.array_equal(plan["ids"], keys_arr))):
+            return None
+        uniq = plan["uniq"]
+        slots_u = plan["slots"]
+        hit = slots_u >= 0
+        miss = ~hit
+        # validate BEFORE mutating anything (a failed commit must leave
+        # the store exactly as the normal path expects to find it)
+        n_miss = int(miss.sum())
+        if n_miss:
+            fc = self._fault_cache
+            if fc is None or fc[4] != self._mut_epoch or not len(fc[0]):
+                return None
+            ck = fc[0]
+            pos = np.minimum(np.searchsorted(ck, uniq[miss]), len(ck) - 1)
+            ok = (ck[pos] == uniq[miss]) & fc[5][pos]
+            if not bool(ok.all()):
+                return None
+        rows_u = np.empty((len(uniq), self.dim), np.float32)
+        hs = slots_u[hit]
+        if len(hs):
+            rows_u[hit] = self._hot_rows_of(hs)
+        prehit = plan["prehit"]
+        if len(prehit):
+            # the pull-side resident bump, exactly the slots the sync
+            # path would have counted (pre-admission hits)
+            self._slot_freq[prehit] += 1.0
+        if n_miss:
+            rows_u[miss] = fc[1][pos][:, : self.dim]
+            self._pf_overlap_rows += n_miss
+        telem = obs_gate.enabled()
+        if telem:
+            reg = self.registry
+            reg.inc("tiered_hot_hits_total", int(len(prehit)))
+            reg.inc("tiered_pull_plan_commits_total")
+            if n_miss:
+                reg.inc("tiered_fault_overlap_rows_total", n_miss)
+        self._slot_cache = (uniq, slots_u, self._res_epoch)
+        return rows_u[plan["inverse"]]
+
+    def _pf_invalidate(self, keys: np.ndarray) -> None:
+        """Surgically drop staged entries for keys whose tier copy just
+        changed (in-place bypass write-backs, demotion write-backs,
+        pending-create flushes).  Caller holds the lock."""
+        st = self._pf_stage
+        if st is None or not len(keys):
+            return
+        sk, _, _, _, epoch, valid = st
+        if epoch != self._mut_epoch:
+            return  # wholesale-invalid already
+        pos = np.minimum(np.searchsorted(sk, keys), len(sk) - 1)
+        stale = (sk[pos] == keys) & valid[pos]
+        if stale.any():
+            valid[pos[stale]] = False
+            if obs_gate.enabled():
+                self.registry.inc("tiered_fault_prefetch_stale_total",
+                                  int(stale.sum()))
+
+    def _pf_consume(
+        self, miss_keys: np.ndarray, payload: np.ndarray,
+        origin: np.ndarray, cold_recs: np.ndarray,
+        unfilled: np.ndarray,
+    ) -> np.ndarray:
+        """Fill ``unfilled`` miss rows from the prefetch stage (valid,
+        epoch-current entries only).  Returns the still-unfilled mask.
+        Caller holds the lock."""
+        st = self._pf_stage
+        if st is None or not unfilled.any():
+            return unfilled
+        sk, sp, so, sr, epoch, valid = st
+        if epoch != self._mut_epoch or not len(sk):
+            return unfilled
+        pos = np.minimum(np.searchsorted(sk, miss_keys), len(sk) - 1)
+        hit = (sk[pos] == miss_keys) & valid[pos] & unfilled
+        if not hit.any():
+            return unfilled
+        hp = pos[hit]
+        payload[hit] = sp[hp]
+        origin[hit] = so[hp]
+        cold_recs[hit] = sr[hp]
+        self._stage_hits_last += int(hit.sum())
+        return unfilled & ~hit
 
     # -- hot-tier bookkeeping -------------------------------------------------
 
@@ -508,10 +1028,54 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 self.hot_rows * self.dim * 8
                 + len(self._warm) * self.dim * 8,
             )
+            total = self._pf_overlap_rows + self._pf_sync_rows
+            reg.gauge_set(
+                "tiered_fault_overlap_ratio",
+                round(self._pf_overlap_rows / total, 5) if total else 0.0,
+            )
 
     def _payload(self, slots: np.ndarray) -> np.ndarray:
-        """[row || accum] block for hot slots — the tier-down wire."""
+        """[row || accum] block for hot slots — the tier-down wire and
+        the ONE accessor demotion/snapshot/migration read authoritative
+        hot rows through (device mode gathers from the pinned block)."""
+        if self.device_hot:
+            return np.concatenate(
+                [self._dev_gather(self._devW, slots),
+                 self._dev_gather(self._devA, slots)], axis=1,
+            )
         return np.concatenate([self._W[slots], self._acc[slots]], axis=1)
+
+    def _hot_rows_of(self, slots: np.ndarray) -> np.ndarray:
+        """[n, dim] ROWS half for hot slots (the pull path's gather)."""
+        if self.device_hot:
+            return self._dev_gather(self._devW, slots)
+        return self._W[slots]
+
+    def _hot_land(self, slots: np.ndarray, payload: np.ndarray,
+                  rows: Optional[np.ndarray] = None,
+                  accums: Optional[np.ndarray] = None) -> None:
+        """Scatter [row ‖ accum] payloads (or a rows/accums pair) into hot
+        slots — admission landing and hot-branch preloads."""
+        if payload is None:
+            payload = np.concatenate(
+                [np.asarray(rows, np.float32),
+                 np.asarray(accums, np.float32)], axis=1,
+            )
+        if self.device_hot:
+            import jax.numpy as jnp
+
+            if not len(slots):
+                return
+            scatter = self._dev_fns()["scatter"]
+            sp, pp = self._pad_scatter(slots, payload)
+            s32 = jnp.asarray(sp)
+            self._devW = scatter(
+                self._devW, s32, jnp.asarray(pp[:, : self.dim]))
+            self._devA = scatter(
+                self._devA, s32, jnp.asarray(pp[:, self.dim:]))
+            return
+        self._W[slots] = payload[:, : self.dim]
+        self._acc[slots] = payload[:, self.dim:]
 
     def _warm_probe(
         self, keys_arr: np.ndarray, refs: bool = False,
@@ -615,6 +1179,10 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 for k in w_keys[to_cold].tolist():
                     if self._warm.pop(k, None):
                         self._warm_dead.add(k)
+            # staged copies of written-back rows are stale (pre-demotion
+            # tier bytes): surgically drop them from the prefetch stage,
+            # exactly like the fault-cache entries below
+            self._pf_invalidate(w_keys)
         n_clean = int(len(victim_slots) - need_write.sum())
         # free the slots only AFTER the write-back landed
         self._slot_keys[victim_slots] = -1
@@ -698,6 +1266,32 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             tickets[rest_idx] = crecs
         return payload, origin, tickets
 
+    def _read_payload_staged(
+        self, miss_keys: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """:meth:`_read_payload` with the prefetch stage in front: rows
+        the dispatch ticket already staged (and no interleaved write has
+        staled) serve without touching the slow tiers — the commit half
+        of the fault pipeline.  Caller holds the lock."""
+        st = self._pf_stage
+        if st is None or st[4] != self._mut_epoch:
+            return self._read_payload(miss_keys)
+        n = len(miss_keys)
+        payload = np.empty((n, 2 * self.dim), np.float32)
+        origin = np.zeros(n, np.int8)
+        recs = np.full(n, -1, np.int64)
+        unfilled = self._pf_consume(
+            miss_keys, payload, origin, recs, np.ones(n, bool)
+        )
+        if unfilled.all():
+            return self._read_payload(miss_keys)
+        if unfilled.any():
+            p2, o2, r2 = self._read_payload(miss_keys[unfilled])
+            payload[unfilled] = p2
+            origin[unfilled] = o2
+            recs[unfilled] = r2
+        return payload, origin, recs
+
     def _read_payload_cached(
         self, miss_keys: np.ndarray, alias_ok: bool = False
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -707,15 +1301,20 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         push's whole miss set.  With ``alias_ok`` (the push path) and a
         full exact-cover cache, the CACHE ARRAYS THEMSELVES are returned
         — zero copies out, and the updater's in-place application IS the
-        cache write-through, so the scatter-back is skipped too.  Caller
-        holds the lock."""
+        cache write-through, so the scatter-back is skipped too.  The
+        prefetch stage sits behind the cache (:meth:`_read_payload_staged`)
+        so a dispatched batch's rows commit without slow-tier reads.
+        Caller holds the lock."""
         self._cache_hits_last = 0
+        self._cache_hits_speculative = 0
+        self._stage_hits_last = 0
         self._cache_hit_info = None
         self._cache_alias = False
         fc = self._fault_cache
         if fc is None or fc[4] != self._mut_epoch or not len(fc[0]):
-            return self._read_payload(miss_keys)
-        if not alias_ok and not self._cache_pending:
+            return self._read_payload_staged(miss_keys)
+        if not alias_ok and not self._cache_pending \
+                and not self._cache_speculative:
             # CLEAN cache on the pull side: every cached row equals its
             # tier copy bit-for-bit (pushes write through), so re-reading
             # a hit costs the same as serving it — and consecutive miss
@@ -724,20 +1323,24 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             # ~15 hits per 2000-row batch at zipf 0.8).  Skip the probe.
             # Only a PENDING create (exists nowhere but the cache) forces
             # it — re-reading one from a tier would re-draw its rng row
-            return self._read_payload(miss_keys)
+            return self._read_payload_staged(miss_keys)
         ck, cp, co, cr, _, valid = fc
         if alias_ok and len(ck) == len(miss_keys) and \
                 bool(valid.all()) and \
                 bool(np.array_equal(ck, miss_keys)):
             self._cache_hits_last = len(miss_keys)
+            if self._cache_speculative:
+                self._cache_hits_speculative = len(miss_keys)
             self._cache_alias = True
             return cp, co, cr
         pos = np.searchsorted(ck, miss_keys)
         pos_c = np.minimum(pos, len(ck) - 1)
         hit = (ck[pos_c] == miss_keys) & valid[pos_c]
         if not hit.any():
-            return self._read_payload(miss_keys)
+            return self._read_payload_staged(miss_keys)
         self._cache_hits_last = int(hit.sum())
+        if self._cache_speculative:
+            self._cache_hits_speculative = self._cache_hits_last
         self._cache_hit_info = (hit, pos_c[hit])
         n = len(miss_keys)
         # empty: hit rows gather from the cache, the rest scatter in
@@ -751,7 +1354,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         cold_recs[hit] = cr[hp]
         rest = ~hit
         if rest.any():
-            p2, o2, c2 = self._read_payload(miss_keys[rest])
+            p2, o2, c2 = self._read_payload_staged(miss_keys[rest])
             payload[rest] = p2
             origin[rest] = o2
             cold_recs[rest] = c2
@@ -863,8 +1466,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         # sequential pops produced, so admission stays bit-deterministic)
         slots = self._free[self._n_free - n:self._n_free][::-1].copy()
         self._n_free -= n
-        self._W[slots] = payload[:, : self.dim]
-        self._acc[slots] = payload[:, self.dim:]
+        self._hot_land(slots, payload)
         self._slot_keys[slots] = keys
         self._slot_freq[slots] = freqs
         # a created row (fresh, or pending in the fault cache) exists
@@ -882,7 +1484,8 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         grads: Optional[np.ndarray],
         create_order: Optional[np.ndarray] = None,
         admit: bool = True,
-    ) -> np.ndarray:
+        speculative: bool = False,
+    ) -> Optional[np.ndarray]:
         """The fault path shared by pull and push: read missed rows from
         their tier, create unseen keys (rng order = first occurrence in
         the request), admit winners into hot (demoting losers), and serve
@@ -900,12 +1503,18 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         Only MISSES touch the shared ledger: resident keys count exactly
         in ``_slot_freq``, so a sketch count reads as "touches while
         outside the hot tier" — the doorkeeper quantity TinyLFU admission
-        actually compares."""
+        actually compares.
+
+        ``speculative`` is the DISPATCH half of the fault pipeline: the
+        worker runs this whole path (reads, ledger touch, admission,
+        demotion, fault-in, cache install) for a batch that has not been
+        pulled yet — legal because pushes touch neither the ledger nor
+        residency, so every admission input is frozen between the
+        dispatch and its commit pull.  The one thing it must NOT do is
+        consume the rng stream: any unseen key bails out (returns None,
+        NO state mutated) and the caller stages plain payloads instead."""
         telem = obs_gate.enabled()
         t0 = time.perf_counter() if telem else 0.0
-        if admit:
-            mf = self.ledger.touch_and_get(miss_keys)
-            self._sync_freq_decay()
         payload, origin, cold_recs = self._read_payload_cached(
             miss_keys, alias_ok=grads is not None and not admit)
         # tier-residency fault counts, BEFORE creates get re-labeled with
@@ -913,6 +1522,13 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         n_warm_f = int((origin == 1).sum())
         n_cold_f = int((origin == 2).sum())
         new = origin == 0
+        if speculative and new.any():
+            # an unseen key's creation order is the PULL's contract —
+            # abort before any mutation (reads mutated nothing)
+            return None
+        if admit:
+            mf = self.ledger.touch_and_get(miss_keys)
+            self._sync_freq_decay()
         n_created = self._create_rows(payload, new, create_order)
         if grads is not None:
             self._apply_payload(payload, grads)
@@ -947,6 +1563,8 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                     b_org, b_tix = origin[bypass], cold_recs[bypass]
                 rest_mask, rest_tier, rest_recs = self._write_in_place(
                     b_keys, b_pay, b_org, b_tix)
+                # staged copies of rows this push just rewrote are stale
+                self._pf_invalidate(b_keys)
                 if rest_tier:
                     ridx = bidx[rest_mask]
                     origin[ridx] = rest_tier
@@ -978,6 +1596,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             # The flush relies on this: no per-row hot probe needed.
             # The pull path's miss keys are a subset of a sorted unique
             # cover — already ordered, no sort needed.
+            self._cache_serial += 1
             if create_order is None and len(miss_keys) > 1 and \
                     not bool(np.all(miss_keys[1:] > miss_keys[:-1])):
                 order = np.argsort(miss_keys, kind="stable")
@@ -990,9 +1609,15 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                     miss_keys, payload, origin, cold_recs,
                     self._mut_epoch, ~admitted,
                 )
+            self._cache_speculative = speculative
             self._cache_pending = bool(
                 (origin == self._ORIGIN_PENDING).any()
             )
+            # the stage is one-shot: whatever this pull did not consume
+            # is for a batch that will never commit it (the next dispatch
+            # replaces it) — absences especially must not outlive the
+            # writes that could create them
+            self._pf_stage = None
         elif self._cache_alias:
             # aliased push: the updater ran in place on the cache arrays
             # and the write-back just landed — refresh the pending flag
@@ -1016,8 +1641,26 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 self._cache_pending = bool(
                     ((fc[2] == self._ORIGIN_PENDING) & fc[5]).any()
                 )
+        # pipeline honesty: fault rows served from the dispatch stage vs
+        # read in-line (the overlap ratio the bench's fault_overlap column
+        # and the ``tiered_fault_overlap_ratio`` gauge report).  A
+        # speculative (dispatch-side) serve counts NOTHING here: its tier
+        # reads are off the critical path by construction, and the commit
+        # records them as overlap rows when it serves them.
+        n_overlap = 0 if speculative else (
+            self._stage_hits_last + self._cache_hits_speculative
+        )
+        n_sync = 0 if speculative else max(
+            0, len(miss_keys) - self._cache_hits_last - self._stage_hits_last
+        )
+        self._pf_overlap_rows += n_overlap
+        self._pf_sync_rows += n_sync
         if telem:
             reg = self.registry
+            if n_overlap:
+                reg.inc("tiered_fault_overlap_rows_total", n_overlap)
+            if n_sync:
+                reg.inc("tiered_fault_sync_rows_total", n_sync)
             if self._cache_hits_last:
                 reg.inc("tiered_fault_cache_hits_total",
                         self._cache_hits_last)
@@ -1100,6 +1743,9 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             co[nidx] = tier
             if recs is not None:
                 cr[nidx] = recs
+            # a staged ABSENCE for a key that just landed tier-side would
+            # re-create it at commit (a second rng draw): drop it
+            self._pf_invalidate(ck[nidx])
         if keep is None:
             self._cache_pending = False
 
@@ -1152,7 +1798,36 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         """One vectorized updater step over unique hot slots — the same
         math (and, for large adagrad batches, the same fused native
         kernel) as ``AsyncParamServer._apply``, so flat/tiered
-        trajectories agree bit-for-bit in both regimes."""
+        trajectories agree bit-for-bit in both regimes.  Device mode runs
+        the expression-identical jitted program, aliasing (donating) the
+        pinned block in place — the push never materializes hot rows on
+        the host."""
+        if self.device_hot:
+            import jax.numpy as jnp
+
+            if not len(slots):
+                return
+            fns = self._dev_fns()
+            gather, scatter = fns["gather"], fns["scatter"]
+            # padded lanes duplicate the last (slot, g) pair: they
+            # compute bit-identical update values, so their repeated
+            # set-writes are harmless and every shape below lands on
+            # the bounded pow2 ladder
+            sp, gp = self._pad_scatter(
+                slots, np.asarray(g, np.float32).reshape(len(slots), -1))
+            g_dev = jnp.asarray(gp)
+            s32 = jnp.asarray(sp)
+            lr = np.float32(self.lr)
+            if self.updater == "sgd":
+                w = gather(self._devW, s32) - lr * g_dev
+                self._devW = scatter(self._devW, s32, w)
+            else:
+                acc = gather(self._devA, s32) + g_dev * g_dev
+                w = gather(self._devW, s32) - lr * g_dev / jnp.sqrt(
+                    acc + np.float32(self.eps))
+                self._devA = scatter(self._devA, s32, acc)
+                self._devW = scatter(self._devW, s32, w)
+            return
         if self.updater == "sgd":
             self._W[slots] -= self.lr * g
         else:  # adagrad
@@ -1226,6 +1901,17 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 uniq, inverse = np.unique(keys_arr, return_inverse=True)
                 rows, _, _ = self._read_values(uniq)
                 return rows[inverse]
+            # planned pull: a matching dispatch already ran the whole
+            # fault side (dedup, ledger, admission, demotion, fault-in)
+            # behind the previous step — commit is a guarded gather
+            plan = self._pf_plan
+            if plan is not None:
+                self._pf_plan = None  # one-shot, consumed or wasted
+                out = self._commit_plan(plan, keys_arr)
+                if out is not None:
+                    return out
+                if obs_gate.enabled():
+                    self.registry.inc("tiered_pull_plan_fallbacks_total")
             # ONE dedup up front: every downstream pass (index probe, hot
             # gather, ledger touch, fault reads) runs at unique width, and
             # the sorted cover + its post-admission slot map are cached
@@ -1238,7 +1924,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             rows_u = np.empty((len(uniq), self.dim), np.float32)
             hs = slots_u[hit]
             if len(hs):
-                rows_u[hit] = self._W[hs]
+                rows_u[hit] = self._hot_rows_of(hs)
                 self._slot_freq[hs] += 1.0
             if obs_gate.enabled():
                 self.registry.inc("tiered_hot_hits_total", int(len(hs)))
@@ -1409,8 +2095,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             hot = slots >= 0
             if hot.any():
                 hs = slots[hot]
-                self._W[hs] = r[hot]
-                self._acc[hs] = a[hot]
+                self._hot_land(hs, None, rows=r[hot], accums=a[hot])
                 self._dirty[hs] = True
             rest = ~hot
             if rest.any():
@@ -1478,10 +2163,23 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 known[cidx[cfound]] = True
         if hot.any():
             hs = slots[hot]
-            rows[hot] = self._W[hs]
-            accs[hot] = self._acc[hs]
+            pay = self._payload(hs)
+            rows[hot] = pay[:, : self.dim]
+            accs[hot] = pay[:, self.dim:]
             known[hot] = True
         return rows, accs, known
+
+    def pull_state_batch(
+        self, keys: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Read-only ``(rows, accums, known)`` for keys wherever they
+        reside — residency untouched, nothing created.  The trainer fast
+        path reads its staged (non-resident) rows' optimizer state here;
+        unknown keys read zeros with ``known`` False."""
+        with self._lock:
+            keys_arr = np.ascontiguousarray(keys, np.int64)
+            self._flush_cache_writes()  # pending creates must be visible
+            return self._read_values(keys_arr)
 
     def migrate_in(self, keys: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """Apply migrated rows (accumulators reset) and return the rows
@@ -1627,6 +2325,18 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                     "kind": "tiered",
                     "rows": total,
                     "capacity": self.hot_rows,
+                    "device_hot": self.device_hot,
+                    "fault_pipeline": {
+                        "enabled": self._prefetch_enabled,
+                        "overlap_rows": self._pf_overlap_rows,
+                        "sync_rows": self._pf_sync_rows,
+                        "overlap_ratio": round(
+                            self._pf_overlap_rows
+                            / (self._pf_overlap_rows + self._pf_sync_rows),
+                            5,
+                        ) if (self._pf_overlap_rows
+                              + self._pf_sync_rows) else 0.0,
+                    },
                     "load_factor": round(n_hot / self.hot_rows, 5),
                     "bytes_resident": (
                         self.hot_rows * self.dim * 8
@@ -1646,6 +2356,24 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         return out
 
     def close(self) -> None:
+        # stop the prefetch worker FIRST (it takes the store lock): no
+        # stage may land after the tiers go away
+        self._closed = True
+        if self._pf_thread is not None and self._pf_thread.is_alive():
+            # the queue may be FULL (double buffer occupied): drain stale
+            # items until the shutdown sentinel lands — a swallowed
+            # sentinel would leave the worker parked in get() forever and
+            # burn the whole join timeout on every close
+            for _ in range(3):
+                try:
+                    self._pf_queue.put_nowait(None)
+                    break
+                except Exception:
+                    try:
+                        self._pf_queue.get_nowait()
+                    except Exception:
+                        pass
+            self._pf_thread.join(timeout=10.0)
         with self._lock:
             # a created-but-unpushed row's only copy may still sit in the
             # fault cache: persist it before the tiers go away

@@ -118,6 +118,7 @@ import optax
 from lightctr_tpu import obs
 from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
 from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.ops.sparse_kernels import next_pow2 as _pow2_pad
 from lightctr_tpu.utils.profiling import annotate
 
 def _hier_local_algo(n: int, kpad: int, vocab: int, dims,
@@ -160,6 +161,11 @@ EXCHANGE_SERIES = (
     "trainer_hier_wire_ef_mass",         # gauge: member EF residual mass
     "trainer_rs_fallback_total",
     "trainer_rs_overflow_total",
+    # tiered device fast path (TieredDeviceEmbedding, ISSUE 15)
+    "trainer_tiered_fast_steps_total",   # all-hot steps (no store surface)
+    "trainer_tiered_fast_rows_total",    # rows through the aliased apply
+    "trainer_tiered_pushed_rows_total",  # non-resident rows via push_batch
+    "trainer_tiered_stale_tickets_total",  # adopt refused: residency moved
 )
 
 
@@ -1483,3 +1489,244 @@ class SparseTableCTRTrainer(CTRTrainer):
                     bytes_per_step=xbytes.get(k, 0),
                     fallback=self._last_step_fallback,
                 )
+
+
+# =========================================================================
+# Device-resident tiered-store fast path (ISSUE 15)
+# =========================================================================
+
+
+# ``_pow2_pad`` (the shared kernel pad policy) is imported at the top.
+
+
+class TieredDeviceEmbedding:
+    """Hot-resident fast path binding an in-process
+    :class:`~lightctr_tpu.embed.tiered.TieredEmbeddingStore`'s pinned
+    device pair to the fused kernel chain (docs/TIERED_STORE.md
+    "Device-resident hot tier").
+
+    Per step: :meth:`gather` probes the store's SLOT TICKETS
+    (``hot_slots`` + ``res_epoch``) for the batch's unique cover — when
+    every id is hot-resident the forward rows are ONE
+    ``ops.sparse_kernels.gather_rows`` off the pinned block (no store
+    surface call, no host row traffic); any miss falls back to the
+    authoritative ``pull_batch`` (creates, admission, promotion, SSP —
+    the PR 8 contract path) and only the still-non-resident rows ride
+    host memory.  :meth:`apply` then runs the batch's gradient rows
+    through ONE fused ``merge_apply`` (segment merge + mean scale +
+    health sumsq + adagrad apply) ALIASING the store's ``(rows,
+    accums)`` pair in place — donated under jit on TPU, so the
+    pull → dedup → gather → grad → merge → apply chain for hot-resident
+    uids never leaves the device — and hands the pair back through
+    ``adopt_device_tables`` (a reference swap pinned to the gather's
+    ``res_epoch``: residency moved underneath means the tickets were
+    stale and the adopt fails loud instead of writing through dead
+    slots).  Non-resident ids push their merged gradients through
+    ``push_batch`` (the store applies its exact in-place math wherever
+    the row lives).
+
+    Single-writer by contract: between a ``gather`` and its ``apply``
+    nothing else may mutate the store (the fused apply aliases the live
+    block — a concurrent residency change is unrecoverable, which is
+    why the adopt is epoch-guarded).  The all-hot trajectory is
+    bit-identical to the same JITTED ``merge_apply`` program over a
+    dense table (the fast path's parity oracle, tested — jit is part
+    of the oracle: XLA fusion contracts FMAs relative to eager
+    op-by-op rounding); mixed batches update miss rows with
+    the store's correctly-rounded eager math instead — each per-row
+    step is the same adagrad recipe within documented kernel ulp.
+
+    ``prefetch_next(ids)`` forwards the NEXT batch's unique cover to
+    ``dispatch_prefetch`` so a miss-bearing pull commits off the staged
+    plan instead of faulting synchronously.
+    """
+
+    def __init__(self, store, worker_id: int = 0, denom: float = 1.0,
+                 registry=None):
+        if not getattr(store, "device_hot", False):
+            raise ValueError(
+                "TieredDeviceEmbedding needs a device_hot store "
+                "(TieredEmbeddingStore(device_hot=True))"
+            )
+        if store.updater != "adagrad":
+            raise ValueError(
+                "the fused merge_apply kernel is the sparse-adagrad "
+                f"recipe; store updater {store.updater!r} unsupported"
+            )
+        self.store = store
+        self.worker_id = int(worker_id)
+        self.denom = float(denom)
+        self.dim = int(store.dim)
+        self.registry = registry if registry is not None else store.registry
+        self.epoch = 0
+        self.fast_steps = 0
+        self.mixed_steps = 0
+        self.stale_tickets = 0
+        self._fused = {}
+        # single-writer discipline vs the store's OWN prefetch worker:
+        # a dispatch runs speculative admission (residency moves!) on a
+        # background thread, so no dispatch may be in flight while a
+        # slot ticket is outstanding — gather() drains the queue before
+        # ticketing, and prefetch_next() defers its dispatch to the end
+        # of the matching apply()
+        self._ticket_open = False
+        self._deferred_prefetch = None
+
+    # -- forward: pull -> dedup -> gather -------------------------------------
+
+    def gather(self, ids):
+        """Batch ids (any shape, duplicates welcome) -> ``(rows_u
+        [U, dim] jax, inv [M] jax int32, ticket)``: the deduped row
+        cover on device plus the position map (``rows_u[inv]`` is the
+        per-position view) and the slot ticket :meth:`apply` consumes."""
+        ids_arr = np.ascontiguousarray(
+            np.asarray(ids).reshape(-1), np.int64)
+        uniq, inv = np.unique(ids_arr, return_inverse=True)
+        store = self.store
+        # no staging may run while the ticket is live (speculative
+        # admission moves residency under the slot map — unrecoverable
+        # once the fused apply has aliased the pair).  A wedged worker
+        # must fail HERE, before any ticket exists, not after donation
+        if not store.prefetch_wait(timeout=30.0):
+            raise RuntimeError(
+                "prefetch worker failed to drain within 30s — refusing "
+                "to open slot tickets over an in-flight stage"
+            )
+        self._ticket_open = True
+        slots = store.hot_slots(uniq)
+        pulled = None
+        if (slots < 0).any() or not len(uniq):
+            pulled = store.pull_batch(uniq, self.epoch, self.worker_id)
+            if pulled is None:
+                raise RuntimeError(
+                    "tiered pull withheld (SSP gate) — the adapter is "
+                    "single-worker; advance the straggler first"
+                )
+            slots = store.hot_slots(uniq)
+        epoch = store.res_epoch
+        hot = slots >= 0
+        u = len(uniq)
+        up = _pow2_pad(max(u, 1))
+        sp = np.zeros(up, np.int32)
+        sp[:u][hot] = slots[hot]
+        w, _ = store.device_tables()
+        from lightctr_tpu.ops import sparse_kernels as _sk
+
+        rows_u = _sk.gather_rows(w, jnp.asarray(sp))[:u]
+        if not hot.all():
+            midx = np.flatnonzero(~hot)
+            rows_u = rows_u.at[jnp.asarray(midx)].set(
+                jnp.asarray(pulled[midx], jnp.float32))
+        ticket = {
+            "uniq": uniq, "inv": inv, "slots": slots, "hot": hot,
+            "res_epoch": epoch,
+        }
+        return rows_u, jnp.asarray(inv, jnp.int32), ticket
+
+    # -- backward: grad -> merge -> apply -------------------------------------
+
+    def _fused_fn(self, key):
+        fn = self._fused.get(key)
+        if fn is None:
+            from lightctr_tpu.ops import sparse_kernels as _sk
+
+            store = self.store
+            lr, eps, denom = store.lr, store.eps, self.denom
+
+            def f(w, a, uids, rows, seg):
+                return _sk.merge_apply(
+                    w, a, uids, rows, seg, lr=lr, eps=eps, denom=denom)
+
+            donate = (0, 1) if jax.default_backend() == "tpu" else ()
+            fn = jax.jit(f, donate_argnums=donate)
+            self._fused[key] = fn
+        return fn
+
+    def apply(self, ticket, grad_rows):
+        """Apply the step's per-position gradient rows ``[M, dim]``
+        (aligned with the ``ids`` stream :meth:`gather` deduped; jax or
+        numpy).  Hot-resident rows ride the fused aliased merge_apply;
+        the rest push through the store surface.  Returns the merged
+        hot rows' sum of squares (the health gradient-norm feed; 0.0
+        when nothing was hot).  Bumps the adapter's SSP epoch — one
+        gather/apply pair per step."""
+        store = self.store
+        uniq, inv, slots = ticket["uniq"], ticket["inv"], ticket["slots"]
+        hot = ticket["hot"]
+        u = len(uniq)
+        telem = obs.enabled()
+        reg = self.registry
+        if store.res_epoch != ticket["res_epoch"]:
+            # tickets went stale before any aliasing ran: the WHOLE batch
+            # can still take the authoritative surface
+            self.stale_tickets += 1
+            if telem:
+                reg.inc("trainer_tiered_stale_tickets_total")
+            hot = np.zeros(u, bool)
+        ssq = 0.0
+        m = int(inv.shape[0])
+        n_hot = int(hot.sum())
+        if n_hot:
+            hs = slots[hot].astype(np.int64)
+            order = np.argsort(hs)
+            sp = _pow2_pad(n_hot)
+            uids_p = np.zeros(sp, np.int32)
+            uids_p[:n_hot] = hs[order]
+            # unique index -> merge segment (sorted-slot position); miss
+            # and padding positions land in a pad segment whose rows are
+            # zeroed below, so their merged sum is exactly zero
+            seg_of = np.full(u, sp - 1, np.int32)
+            seg_of[np.flatnonzero(hot)[order]] = np.arange(
+                n_hot, dtype=np.int32)
+            mp = _pow2_pad(m)
+            g = jnp.asarray(grad_rows, jnp.float32)
+            mask = jnp.asarray(hot[inv].astype(np.float32))[:, None]
+            rows_p = jnp.zeros((mp, self.dim), jnp.float32)
+            rows_p = rows_p.at[: m].set(g * mask)
+            inv_p = np.full(mp, sp - 1, np.int32)
+            inv_p[:m] = seg_of[inv]
+            w, a = store.device_tables()
+            w2, a2, ssq = self._fused_fn((sp, mp))(
+                w, a, jnp.asarray(uids_p), rows_p, jnp.asarray(inv_p))
+            store.adopt_device_tables(
+                w2, a2, touched_slots=hs,
+                expect_res_epoch=ticket["res_epoch"])
+            if telem:
+                reg.inc("trainer_tiered_fast_rows_total", n_hot)
+        miss = ~hot
+        if miss.any():
+            g_np = np.asarray(grad_rows, np.float32).reshape(m, self.dim)
+            gm = np.zeros((u, self.dim), np.float32)
+            np.add.at(gm, inv, g_np)
+            keys = uniq[miss]
+            store.push_batch(self.worker_id, keys,
+                             gm[miss] / self.denom, self.epoch)
+            self.mixed_steps += 1
+            if telem:
+                reg.inc("trainer_tiered_pushed_rows_total", len(keys))
+        else:
+            self.fast_steps += 1
+            if telem:
+                reg.inc("trainer_tiered_fast_steps_total")
+        self.epoch += 1
+        self._ticket_open = False
+        if self._deferred_prefetch is not None:
+            nxt, self._deferred_prefetch = self._deferred_prefetch, None
+            store.dispatch_prefetch(nxt)
+        return ssq
+
+    # -- overlapped fault prefetch -------------------------------------------
+
+    def prefetch_next(self, ids) -> int:
+        """Stage the NEXT batch's miss payloads behind this step
+        (``dispatch_prefetch`` on the batch's unique cover — exactly the
+        key stream the matching pull will carry).  Called between a
+        gather and its apply, the dispatch is DEFERRED to the end of the
+        apply: staging runs speculative admission, which must not move
+        residency under an outstanding slot ticket."""
+        ids_arr = np.unique(
+            np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64))
+        if self._ticket_open:
+            self._deferred_prefetch = ids_arr
+            return 0
+        return self.store.dispatch_prefetch(ids_arr)

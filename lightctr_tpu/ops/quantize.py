@@ -78,6 +78,30 @@ def extract(table: QuantTable, codes: jax.Array) -> jax.Array:
     return jnp.take(table.values, codes.astype(jnp.int32))
 
 
+def pack_nibbles(codes: jax.Array) -> jax.Array:
+    """4-bit codes (values 0..15) -> bit-packed bytes, two codes per byte
+    — the sub-byte wire form behind ``wire_bits=4``
+    (dist/collectives.py `_wire_row_bytes`).  Low nibble is the EVEN
+    element (little-nibble order); an odd count pads one zero code that
+    :func:`unpack_nibbles` slices back off.  Flattens: the wire ships a
+    byte stream, callers reshape after unpack."""
+    c = jnp.asarray(codes, jnp.uint8).reshape(-1)
+    n = c.shape[0]
+    if n % 2:
+        c = jnp.pad(c, (0, 1))
+    pairs = c.reshape(-1, 2)
+    return (pairs[:, 0] | (pairs[:, 1] << 4)).astype(jnp.uint8)
+
+
+def unpack_nibbles(packed: jax.Array, n: int) -> jax.Array:
+    """Inverse of :func:`pack_nibbles`: ``n`` 4-bit codes back out of the
+    byte stream (uint8 values 0..15)."""
+    p = jnp.asarray(packed, jnp.uint8).reshape(-1)
+    lo = p & jnp.uint8(0x0F)
+    hi = (p >> 4) & jnp.uint8(0x0F)
+    return jnp.stack([lo, hi], axis=1).reshape(-1)[:n]
+
+
 @partial(jax.jit, static_argnames=("bits",))
 def lowbit_quantize(x: jax.Array, bits: int = 1):
     """1/2-bit sign-magnitude helper (product_quantizer.h:24-45): codes plus

@@ -69,7 +69,8 @@ ENV_FLAG = "LIGHTCTR_KERNELS"
 
 #: the dispatch phases a kernel may declare (the ``phase`` label of
 #: ``trainer_kernel_path_total``); metrics_report --kernels groups by these
-KERNEL_PHASES = ("dedup", "merge", "apply", "pack", "adagrad", "attention")
+KERNEL_PHASES = ("dedup", "merge", "apply", "pack", "gather", "adagrad",
+                 "attention")
 
 
 class KernelDef(NamedTuple):
@@ -137,6 +138,18 @@ def _resolve(name: str, impl: Optional[str] = None) -> Tuple[str, Callable]:
     if impl == "xla":
         return impl, kd.reference
     return impl, partial(kd.pallas, interpret=(impl == "interpret"))
+
+
+def next_pow2(n: int, floor: int = 8) -> int:
+    """THE pad policy for kernel-facing dynamic lengths: the next power
+    of two >= ``n`` (min ``floor``), so pallas grid counts and jit
+    shapes land on a bounded ladder instead of compiling per batch
+    size.  Train (sparse_trainer), serve (model/cache), and the tiered
+    store's device paths all pad through this one helper."""
+    out = floor
+    while out < n:
+        out *= 2
+    return out
 
 
 # =========================================================================
@@ -404,12 +417,18 @@ APPLY_ROWS_ENV = "LIGHTCTR_APPLY_ROWS"
 
 def apply_rows_per_step(interpret: bool) -> int:
     """Rows the apply kernel batches per grid step.  Default: 8 under the
-    interpreter (grid-step overhead dominates there, the block variant is
-    validated bit-for-bit by the parity suite), 1 compiled (the windowed
-    per-row kernel keeps table traffic to scalar-prefetched (1, dim) DMA
-    windows; the block variant's full-ref dynamic stores await real-TPU
-    validation in tests_tpu before becoming the compiled default).
-    :data:`APPLY_ROWS_ENV` overrides either way."""
+    interpreter (grid-step overhead dominates there; the block variant is
+    validated bit-for-bit by the parity suite), 1 compiled.  Compiled
+    ``rb > 1`` is now CORRECT at any vocabulary — it lowers to
+    :func:`_apply_block_dma_kernel`, whose table/accum refs stay in ANY
+    (HBM) memory space with explicit per-row async-copy windows, instead
+    of the interpreter block kernel's full-VMEM refs (which cap vocab at
+    VMEM size compiled) — and is gated on real hardware by
+    tests_tpu/test_compiled_kernels.py.  It stays opt-in
+    (:data:`APPLY_ROWS_ENV`) until the compiled A/B column of
+    SPARSE_KERNEL_BENCH.json, which must come from a real-TPU run of
+    tools/sparse_kernel_bench.py, shows the grid-step amortization
+    beating the per-row kernel's simpler pipelining."""
     env = os.environ.get(APPLY_ROWS_ENV, "").strip()
     if env:
         return max(1, int(env))
@@ -460,6 +479,117 @@ def _apply_block_kernel(uids_ref, w_ref, a_ref, g_ref, w_out, a_out,
     jax.lax.fori_loop(0, rb, body, 0)
 
 
+def _apply_block_dma_kernel(uids_ref, w_any, a_any, g_ref, w_out, a_out,
+                            ssq_ref, w_scr, a_scr, sems,
+                            *, lr, eps, denom, s, rb):
+    """Compiled-Mosaic row-block fused apply: ``rb`` touched rows per grid
+    step with table/accum refs in ANY (HBM) memory space — the PR 9/10
+    follow-up that makes ``LIGHTCTR_APPLY_ROWS > 1`` correct COMPILED,
+    not just under the interpreter.  The interpreter block kernel
+    (:func:`_apply_block_kernel`) rides full VMEM refs, which compiled
+    would cap the vocabulary at VMEM size; here each row is an explicit
+    async-copy window: HBM row -> VMEM scratch, fused update, VMEM ->
+    HBM write-back, sequential waits so a revisited row (the rotated
+    pad convention — original slot 0 runs LAST) always reads its own
+    prior write back.  Aliasing makes ``w_out``/``a_out`` the same HBM
+    buffers as the inputs, so untouched rows need no seeding pass and
+    the update is truly in place.  Same arithmetic as the other two
+    variants; gated bit-for-bit on hardware by
+    tests_tpu/test_compiled_kernels.py."""
+    pl, pltpu = pallas_modules()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _zero():
+        ssq_ref[0, 0] = 0.0
+
+    def body(j, _):
+        p = i * rb + j
+
+        @pl.when(p < s)
+        def _row():
+            uid = uids_ref[p]
+            in_w = pltpu.make_async_copy(
+                w_out.at[pl.ds(uid, 1), :], w_scr, sems.at[0]
+            )
+            in_a = pltpu.make_async_copy(
+                a_out.at[pl.ds(uid, 1), :], a_scr, sems.at[1]
+            )
+            in_w.start()
+            in_a.start()
+            in_w.wait()
+            in_a.wait()
+            # g_ref is this grid step's (rb, d) window: row j, not p
+            g = g_ref[pl.ds(j, 1), :]
+            if denom != 1.0:
+                g = g / denom
+            # original slot of position p is (p + 1) % s: slot 0 <=> p==s-1
+            g = g * jnp.where((uid == 0) & (p != s - 1), 0.0, 1.0)
+            ssq_ref[0, 0] += jnp.sum(g * g)
+            a_new = a_scr[...] + g * g
+            a_scr[...] = a_new
+            w_scr[...] = w_scr[...] - lr * g * jax.lax.rsqrt(a_new + eps)
+            out_w = pltpu.make_async_copy(
+                w_scr, w_out.at[pl.ds(uid, 1), :], sems.at[0]
+            )
+            out_a = pltpu.make_async_copy(
+                a_scr, a_out.at[pl.ds(uid, 1), :], sems.at[1]
+            )
+            out_w.start()
+            out_a.start()
+            # sequential completion: the next row may BE this row (pad
+            # revisits of slot 0) — its read must see this write
+            out_w.wait()
+            out_a.wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, rb, body, 0)
+    del w_any, a_any  # aliased into w_out/a_out; reads go through the outs
+
+
+def _apply_block_dma(table, accum, uids_r, merged_r, lr, eps, denom, s, rb,
+                     vocab, d, shape):
+    """Launch :func:`_apply_block_dma_kernel` (compiled rb > 1 path)."""
+    pl, pltpu = pallas_modules()
+    sp = -(-s // rb) * rb
+    uids_p = jnp.pad(uids_r, (0, sp - s))
+    merged_p = jnp.pad(merged_r, ((0, sp - s), (0, 0)))
+    any_space = getattr(pltpu, "ANY", getattr(pl, "ANY", None))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(sp // rb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=any_space),
+            pl.BlockSpec(memory_space=any_space),
+            pl.BlockSpec((rb, d), lambda i, u: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=any_space),
+            pl.BlockSpec(memory_space=any_space),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.VMEM((1, d), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    w2, a2, ssq = pl.pallas_call(
+        partial(_apply_block_dma_kernel, lr=lr, eps=eps, denom=denom,
+                s=s, rb=rb),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((vocab, d), table.dtype),
+            jax.ShapeDtypeStruct((vocab, d), accum.dtype),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ),
+        input_output_aliases={1: 0, 2: 1},
+        interpret=False,
+    )(uids_p, table.reshape(vocab, d), accum.reshape(vocab, d), merged_p)
+    return w2.reshape(shape), a2.reshape(shape), ssq[0, 0]
+
+
 def _merge_apply_pallas(
     table, accum, uids, rows, inv, lr, eps, denom, *, interpret: bool
 ):
@@ -478,6 +608,11 @@ def _merge_apply_pallas(
     uids_r = jnp.roll(uids.astype(jnp.int32), -1)
     merged_r = jnp.roll(merged, -1, axis=0)
     rb = apply_rows_per_step(interpret)
+    if rb > 1 and s > 1 and not interpret:
+        # compiled row-block path: ANY-space refs + explicit DMA windows
+        # (full-VMEM refs would cap vocab at VMEM size under Mosaic)
+        return _apply_block_dma(table, accum, uids_r, merged_r, lr, eps,
+                                denom, s, rb, vocab, d, shape)
     if rb > 1 and s > 1:
         sp = -(-s // rb) * rb
         uids_p = jnp.pad(uids_r, (0, sp - s)).reshape(sp, 1)
@@ -565,6 +700,70 @@ def merge_apply(
         )
     _, fn = _resolve("merge_apply")
     return fn(table, accum, uids, rows, inv, lr, eps, denom)
+
+
+# =========================================================================
+# (b2) row gather: the device-resident row path's read half
+# =========================================================================
+#
+# ``rows = block[idx]`` — the gather every consumer of a device-resident
+# row block runs: the tiered store's hot-tier pulls, the trainer's
+# hot-resident fast path, and the serving cache's device-block hits
+# (ISSUE 15: train and serve share ONE row path through this entry).
+# The Pallas twin is the scalar-prefetch windowed copy (the merge_apply
+# steering pattern): the prefetched index steers a (1, dim) source
+# window per grid step, so each row moves HBM -> VMEM -> HBM once with
+# no [n, vocab] one-hot or host round trip.  Indices MUST be in range
+# (both impls clip rather than trap — jnp.take(mode="clip"), pinned
+# explicitly because take's default mode fills NaN).
+
+
+def _gather_reference(block: jax.Array, idx: jax.Array):
+    # mode="clip" explicitly: jnp.take's DEFAULT out-of-range mode is
+    # "fill" (NaN rows), which would silently diverge from the pallas
+    # twin's clipped window
+    return jnp.take(block, idx, axis=0, mode="clip")
+
+
+def _gather_kernel(idx_ref, src_ref, out_ref):
+    del idx_ref  # consumed by the index maps
+    out_ref[...] = src_ref[...]
+
+
+def _gather_pallas(block: jax.Array, idx: jax.Array, *, interpret: bool):
+    pl, pltpu = pallas_modules()
+    n = idx.shape[0]
+    shape = block.shape
+    d = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+    src = block.reshape(shape[0], d)
+    # clip like jnp.take: the index map window must stay in range
+    idx32 = jnp.clip(idx.astype(jnp.int32), 0, shape[0] - 1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, d), lambda i, u: (u[i], 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i, u: (i, 0)),
+    )
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, d), block.dtype),
+        interpret=interpret,
+    )(idx32, src)
+    return out.reshape((n,) + shape[1:])
+
+
+def gather_rows(block: jax.Array, idx: jax.Array):
+    """Dispatch: ``block[idx]`` row gather — ``jnp.take(block, idx,
+    axis=0)`` semantics (out-of-range clips).  The read half of the
+    device-resident row path: hot-tier pulls, the trainer fast path's
+    table assembly, and serving-cache device hits all route here, so
+    train and serve share one gather kernel."""
+    idx = idx.reshape(-1)
+    if idx.shape[0] == 0:
+        return jnp.zeros((0,) + block.shape[1:], block.dtype)
+    _, fn = _resolve("gather_rows")
+    return fn(block, idx)
 
 
 # =========================================================================
@@ -673,11 +872,28 @@ def _qp_pallas(table, x: jax.Array, *, interpret: bool):
 def quantize_pack(table, x: jax.Array) -> jax.Array:
     """Dispatch: float payload -> quantile codes, bit-identical to
     ``ops.quantize.compress`` (the wire pack every coded collective hop
-    ships).  Codes up to 8 bits ride the compare-count sweep; wider
-    tables (16-bit) ride the VMEM binary-search kernel
-    (:func:`_qp_search_kernel`) instead of resolving to the reference."""
+    ships).  Codes up to 8 bits — the 4-bit sub-byte tables included —
+    ride the compare-count sweep; wider tables (16-bit) ride the VMEM
+    binary-search kernel (:func:`_qp_search_kernel`) instead of
+    resolving to the reference."""
     _, fn = _resolve("quantize_pack")
     return fn(table, x)
+
+
+def quantize_pack_packed(table, x: jax.Array) -> jax.Array:
+    """:func:`quantize_pack` plus the sub-byte WIRE form: 4-bit-and-under
+    tables bit-pack two codes per byte (``ops.quantize.pack_nibbles`` —
+    the ``wire_bits=4`` codec `dist.collectives._wire_row_bytes` prices);
+    wider tables return their codes unchanged.  Receiver side:
+    ``unpack_nibbles(packed, x.size)`` then ``quantize.extract`` —
+    bit-parity with the unpacked reference codec is the contract
+    (tests/test_sparse_kernels.py)."""
+    codes = quantize_pack(table, x)
+    if table.bits <= 4:
+        from lightctr_tpu.ops.quantize import pack_nibbles
+
+        return pack_nibbles(codes)
+    return codes
 
 
 def _qp_ef_reference(table, rows, carried, mask):
@@ -936,6 +1152,8 @@ def quantize_pack_ef_update(table, rows: jax.Array, uids: jax.Array,
 
 register_kernel("dedup_ids", phase="dedup",
                 reference=_dedup_reference, pallas=_dedup_pallas)
+register_kernel("gather_rows", phase="gather",
+                reference=_gather_reference, pallas=_gather_pallas)
 register_kernel("merge_rows", phase="merge",
                 reference=_merge_reference, pallas=_merge_pallas)
 register_kernel("merge_apply", phase="apply",

@@ -30,6 +30,17 @@ from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs.registry import MetricsRegistry, default_registry
 
 
+def _pad_slots(slots: np.ndarray, n: int) -> np.ndarray:
+    """``slots[:n]`` in an int32 block padded to the next power of two
+    (the kernel layer's shared pad policy) so the pallas gather grid
+    count stays bounded."""
+    from lightctr_tpu.ops.sparse_kernels import next_pow2
+
+    sp = np.zeros(next_pow2(n), np.int32)
+    sp[:n] = slots[:n]
+    return sp
+
+
 class HotEmbeddingCache:
     """Frequency-admission row cache (uid -> [dim] fp32 row).
 
@@ -40,6 +51,16 @@ class HotEmbeddingCache:
     gatekeep).  ``decay_every``/``decay_factor``: every N touch batches
     the ledger halves (by default), so frequencies track the recent
     stream, not all of history — yesterday's hot keys age out.
+
+    ``device_rows`` (default: the tiered store's resolution — pinned on
+    TPU, host on CPU, ``LIGHTCTR_DEVICE_HOT`` overrides): resident rows
+    live in ONE slot-recycled ``[capacity, dim]`` device block and a hit
+    batch is ONE ``ops.sparse_kernels.gather_rows`` off it — the same
+    registry kernel (and on TPU the same HBM-resident row discipline) the
+    training store's device hot tier and the trainer fast path ride, so
+    train and serve share one row path (docs/TIERED_STORE.md
+    "Device-resident hot tier").  The admission/eviction/invalidation
+    policy is IDENTICAL in both modes; only row residence changes.
     """
 
     def __init__(
@@ -50,6 +71,7 @@ class HotEmbeddingCache:
         decay_every: int = 1000,
         decay_factor: float = 0.5,
         registry: Optional[MetricsRegistry] = None,
+        device_rows: Optional[bool] = None,
     ):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
@@ -60,7 +82,23 @@ class HotEmbeddingCache:
         self.decay_factor = float(decay_factor)
         self.registry = registry if registry is not None else default_registry()
         self._lock = threading.Lock()
-        self._rows: Dict[int, np.ndarray] = {}
+        from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+        self.device_rows = TieredEmbeddingStore._resolve_device_hot(
+            device_rows)
+        # ONE membership map either way: uid -> [dim] row (host mode) or
+        # uid -> block slot (device mode).  Admission, eviction, decay
+        # retention and the min-frequency scan all walk its keys, so the
+        # policy code below is mode-blind.
+        self._rows: Dict = {}
+        self._block = None
+        self._free: list = []
+        if self.device_rows:
+            import jax.numpy as jnp
+
+            self._block = jnp.zeros((self.capacity, self.dim),
+                                    jnp.float32)
+            self._free = list(range(self.capacity - 1, -1, -1))
         self._freq: Dict[int, float] = {}
         self._version: Optional[tuple] = None
         self._touch_batches = 0
@@ -107,11 +145,21 @@ class HotEmbeddingCache:
         present = np.zeros(len(uids), bool)
         with self._lock:
             store = self._rows
-            for i, u in enumerate(uids.tolist()):
-                r = store.get(u)
-                if r is not None:
-                    rows[i] = r
-                    present[i] = True
+            if self.device_rows:
+                slots = np.zeros(len(uids), np.int64)
+                for i, u in enumerate(uids.tolist()):
+                    s = store.get(u)
+                    if s is not None:
+                        slots[i] = s
+                        present[i] = True
+                if present.any():
+                    rows[present] = self._gather_locked(slots[present])
+            else:
+                for i, u in enumerate(uids.tolist()):
+                    r = store.get(u)
+                    if r is not None:
+                        rows[i] = r
+                        present[i] = True
             n_hit = int(present.sum())
             self.hits += n_hit
             self.misses += len(uids) - n_hit
@@ -120,6 +168,82 @@ class HotEmbeddingCache:
             reg.inc("serve_cache_hits_total", n_hit)
             reg.inc("serve_cache_misses_total", len(uids) - n_hit)
         return rows, present
+
+    def _gather_locked(self, slots: np.ndarray) -> np.ndarray:
+        """One registry-kernel gather off the device block (device mode;
+        caller holds the lock).  The slot array is padded to a power of
+        two so the pallas grid count stays bounded."""
+        import jax.numpy as jnp
+
+        from lightctr_tpu.ops import sparse_kernels
+
+        n = len(slots)
+        sp = _pad_slots(slots, n)
+        return np.asarray(
+            sparse_kernels.gather_rows(self._block, jnp.asarray(sp))[:n]
+        )
+
+    def lookup_device(self, uids: np.ndarray):
+        """Device-mode read for consumers that keep computing on device
+        (the serving scorer): ``(rows [n, dim] jax.Array, present bool
+        [n])`` with missing slots ZERO — the hit rows never round-trip
+        through host memory; the caller scatters its PS pulls over the
+        miss positions and hands the block straight to the jitted
+        scorer.  Host mode degrades to :meth:`lookup` + one upload."""
+        import jax.numpy as jnp
+
+        if not self.device_rows:
+            rows, present = self.lookup(uids)
+            return jnp.asarray(rows), present
+        from lightctr_tpu.ops import sparse_kernels
+
+        uids = np.asarray(uids, np.int64)
+        n = len(uids)
+        present = np.zeros(n, bool)
+        slots = np.zeros(n, np.int64)
+        with self._lock:
+            store = self._rows
+            for i, u in enumerate(uids.tolist()):
+                s = store.get(u)
+                if s is not None:
+                    slots[i] = s
+                    present[i] = True
+            n_hit = int(present.sum())
+            self.hits += n_hit
+            self.misses += n - n_hit
+            sp = _pad_slots(slots, n)
+            rows = sparse_kernels.gather_rows(
+                self._block, jnp.asarray(sp))[:n]
+        # miss positions read slot 0's bytes — zero them so a miss can
+        # never leak another uid's row into the scorer
+        rows = rows * jnp.asarray(present.astype(np.float32))[:, None]
+        if obs_gate.enabled():
+            reg = self.registry
+            reg.inc("serve_cache_hits_total", n_hit)
+            reg.inc("serve_cache_misses_total", n - n_hit)
+        return rows, present
+
+    def _write_locked(self, u: int, r: np.ndarray, i: int,
+                      pending: list) -> None:
+        """Land offer row ``i`` for uid ``u`` (insert or overwrite) —
+        host mode copies the row in; device mode allocates/reuses the
+        uid's slot and defers the block write to the caller's batch."""
+        if self.device_rows:
+            s = self._rows.get(u)
+            if s is None:
+                s = self._free.pop()
+                self._rows[u] = s
+            pending.append((s, i))
+        else:
+            self._rows[u] = r[i].copy()
+
+    def _drop_locked(self, u: int) -> None:
+        """Evict uid ``u`` (present by contract) — device mode recycles
+        its slot; the block row goes stale in place and is unreachable
+        once the membership entry dies."""
+        s = self._rows.pop(u)
+        if self.device_rows:
+            self._free.append(s)
 
     def _find_min_locked(self) -> Optional[Tuple[int, float]]:
         if not self._rows:
@@ -135,13 +259,17 @@ class HotEmbeddingCache:
         uids = np.asarray(uids, np.int64)
         r = np.asarray(rows, np.float32).reshape(-1, self.dim)
         admitted = 0
+        # device mode batches slot writes: the policy loop only collects
+        # (slot, offer index) pairs; ONE block scatter lands them at the
+        # end (a per-row .at[].set would rebuild the block n times)
+        pending: list = []
         with self._lock:
             for i, u in enumerate(uids.tolist()):
                 if u in self._rows:
-                    self._rows[u] = r[i].copy()
+                    self._write_locked(u, r, i, pending)
                     continue
                 if len(self._rows) < self.capacity:
-                    self._rows[u] = r[i].copy()
+                    self._write_locked(u, r, i, pending)
                     admitted += 1
                     continue
                 f = self._freq.get(u, 0.0)
@@ -153,11 +281,25 @@ class HotEmbeddingCache:
                 if self._min_freq is None or f <= self._min_freq[1]:
                     self.rejected += 1
                     continue
-                del self._rows[self._min_freq[0]]
+                self._drop_locked(self._min_freq[0])
                 self.evictions += 1
                 self._min_freq = None
-                self._rows[u] = r[i].copy()
+                self._write_locked(u, r, i, pending)
                 admitted += 1
+            if pending:
+                import jax.numpy as jnp
+
+                # duplicate uids in one offer batch repeat a slot: keep
+                # the LAST offer per slot (the host-mode loop's
+                # last-write-wins) — a scatter-set with repeated
+                # indices applies in undefined order
+                last = dict(pending)
+                slots = np.fromiter(last.keys(), np.int32,
+                                    count=len(last))
+                idx = np.fromiter(last.values(), np.int64,
+                                  count=len(last))
+                self._block = self._block.at[jnp.asarray(slots)].set(
+                    jnp.asarray(r[idx]))
             n_entries = len(self._rows)
             evicted, rejected = self.evictions, self.rejected
         if obs_gate.enabled():
@@ -228,7 +370,10 @@ class HotEmbeddingCache:
             self._version = version
             store = self._rows
             for u in np.asarray(uids, np.int64).reshape(-1).tolist():
-                if store.pop(u, None) is not None:
+                s = store.pop(u, None)
+                if s is not None:
+                    if self.device_rows:
+                        self._free.append(s)
                     dropped += 1
             if dropped:
                 self._min_freq = None
@@ -260,6 +405,8 @@ class HotEmbeddingCache:
                 return False
             dropped = len(self._rows)
             self._rows.clear()
+            if self.device_rows:
+                self._free = list(range(self.capacity - 1, -1, -1))
             self._min_freq = None
             self.invalidations += 1
             self.invalidated_rows += dropped
@@ -292,4 +439,5 @@ class HotEmbeddingCache:
                 "delta_invalidations": self.delta_invalidations,
                 "invalidated_rows": self.invalidated_rows,
                 "tracked_uids": len(self._freq),
+                "device_rows": bool(self.device_rows),
             }

@@ -70,11 +70,7 @@ _ID_FIELDS = {
 _REP_KINDS = ("widedeep", "deepfm", "dcn")
 
 
-def _next_pow2(n: int, floor: int = 8) -> int:
-    out = floor
-    while out < n:
-        out *= 2
-    return out
+from lightctr_tpu.ops.sparse_kernels import next_pow2 as _next_pow2
 
 
 def fm_ps_row_leaves(factor_dim: int, w_leaf: str = "w",
@@ -271,7 +267,11 @@ class ServingModel:
         if not self.row_leaves:
             raise ValueError("score_rows needs row_leaves (PS-backed mode)")
         uids = np.asarray(uids, np.int64)
-        rows = np.asarray(rows, np.float32).reshape(len(uids), self.row_dim)
+        # rows may arrive as a jax.Array (the device-mode cache's gather
+        # — serve/cache.py lookup_device): keep it on device; numpy
+        # callers upload here exactly as before
+        rows = jnp.asarray(rows, jnp.float32).reshape(
+            len(uids), self.row_dim)
         arrays = self._with_mask(arrays)
         b = int(np.asarray(arrays[self.id_fields[0]]).shape[0])
         batch = dict(arrays)
@@ -289,12 +289,12 @@ class ServingModel:
             batch[f] = pos.reshape(ids.shape).astype(np.int32)
         k_pad = _next_pow2(len(uids))
         if k_pad != len(uids):
-            rows = np.concatenate(
-                [rows, np.zeros((k_pad - len(uids), self.row_dim),
-                                np.float32)], axis=0)
+            rows = jnp.concatenate(
+                [rows, jnp.zeros((k_pad - len(uids), self.row_dim),
+                                 jnp.float32)], axis=0)
         dev_batch = self._pad_batch(batch, _next_pow2(b))
         return np.asarray(
-            self._jit_rows(self.params, jnp.asarray(rows), dev_batch)
+            self._jit_rows(self.params, rows, dev_batch)
         )[:b]
 
 

@@ -449,7 +449,15 @@ class PredictionServer:
         cache = self.cache
         uids = self.model.touched_uids(arrays)
         cache.note_touched(uids)
-        rows, present = cache.lookup(uids)
+        device = getattr(cache, "device_rows", False)
+        if device:
+            # the fused serve-side row path: hits are ONE registry-kernel
+            # gather off the cache's resident block and stay on device
+            # straight into the jitted scorer (docs/TIERED_STORE.md
+            # "Device-resident hot tier")
+            rows, present = cache.lookup_device(uids)
+        else:
+            rows, present = cache.lookup(uids)
         miss = uids[~present]
         if miss.size:
             # create=False: a READ-ONLY pull — unknown fids come back as
@@ -464,7 +472,13 @@ class PredictionServer:
                     "PS pull withheld/failed for serving miss batch"
                 )
             _, pulled = out
-            rows[~present] = pulled
+            if device:
+                import jax.numpy as jnp
+
+                rows = rows.at[jnp.asarray(np.flatnonzero(~present))].set(
+                    jnp.asarray(pulled, jnp.float32))
+            else:
+                rows[~present] = pulled
             cache.insert(miss, pulled)
         return self.model.score_rows(arrays, uids, rows)
 

@@ -11,6 +11,8 @@ harness proves the repo's reproduction MOVES ROWS where the reference
 re-initializes them.
 """
 
+import os
+import signal
 import sys
 import threading
 import time
@@ -217,3 +219,98 @@ def test_chaos_flight_bundle_readable_via_cli(tmp_path):
     report = json.loads(out.stdout)
     assert report["reason"].startswith("rebalance_drop")
     assert report["event_ring"]["by_kind"].get("failover", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# kill -9 DURING an inflight prefetch fault (ISSUE 15): zero rows lost
+
+
+def _tiered_fault_churn(store_path, ckpt_dir, progress):
+    """Victim process: a prefetch-enabled tiered store under constant
+    fault churn — every step dispatches the NEXT cover (so a staging
+    read is inflight more or less continuously), pulls, pushes, and
+    checkpoints state on a tight cadence.  Killed mid-flight by the
+    parent."""
+    import numpy as np
+
+    from lightctr_tpu.ckpt import checkpoint as ckpt_mod
+    from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+    store = TieredEmbeddingStore(
+        dim=DIM, hot_rows=16, path=store_path, updater="adagrad",
+        learning_rate=0.5, n_workers=1, seed=0, prefetch=True,
+    )
+    rng = np.random.default_rng(0)
+    vocab = 512
+    step = 0
+    cover = np.unique(rng.integers(1, vocab, size=64).astype(np.int64))
+    while True:
+        nxt = np.unique(rng.integers(1, vocab, size=64).astype(np.int64))
+        store.dispatch_prefetch(nxt)  # inflight while we pull/push
+        rows = store.pull_batch(cover, worker_epoch=step, worker_id=0)
+        uniq, first = np.unique(cover, return_index=True)
+        store.push_batch(0, uniq,
+                         (0.1 * (rows[first] - 1.0)).astype(np.float32),
+                         worker_epoch=step)
+        if step % 5 == 4:
+            k, r, a = store.snapshot_state_arrays()
+            ckpt_mod.save_arrays(ckpt_dir, step, k, r, accums=a)
+        cover = nxt
+        step += 1
+        progress.value = step
+
+
+def test_chaos_kill9_during_inflight_fault_zero_row_loss(tmp_path):
+    """SIGKILL lands while the fault-prefetch worker is staging (a
+    dispatch is issued every step, so staging reads race the kill by
+    construction): the newest intact state checkpoint must restore with
+    ZERO row loss — every key's rows AND adagrad accumulators re-read
+    bit-exact from a fresh store (the rebalance protocol's read-back) —
+    and the victim's cold tier must reopen coherently (torn tail
+    dropped, never a poisoned store)."""
+    import multiprocessing as mp
+
+    from lightctr_tpu.ckpt import checkpoint as ckpt_mod
+    from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+    store_path = str(tmp_path / "victim" / "store")
+    ckpt_dir = str(tmp_path / "ckpt")
+    ctx = mp.get_context("spawn")
+    progress = ctx.Value("l", 0)
+    p = ctx.Process(target=_tiered_fault_churn,
+                    args=(store_path, ckpt_dir, progress), daemon=True)
+    p.start()
+    deadline = time.monotonic() + 60
+    while progress.value < 25 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert progress.value >= 25, "victim never got going"
+    os.kill(p.pid, signal.SIGKILL)
+    p.join(10)
+
+    out = ckpt_mod.load_latest_state(ckpt_dir)
+    assert out is not None, "no intact checkpoint survived the kill"
+    step, keys, rows, accums = out
+    assert len(keys) > 0 and accums is not None
+    assert np.isfinite(rows).all() and np.isfinite(accums).all()
+    assert (np.diff(keys) > 0).all()
+    assert (accums > 0).any(), "adagrad accums never moved"
+
+    # zero row loss: the snapshot lands on a fresh shard and re-reads
+    # EXACTLY (rows and optimizer state) — MSG_MIGRATE_STATE's read-back
+    dst = TieredEmbeddingStore(
+        dim=DIM, hot_rows=16, path=str(tmp_path / "dst" / "store"),
+        updater="adagrad", n_workers=1, seed=0,
+    )
+    got_rows, got_accs = dst.migrate_in_state(keys, rows, accums)
+    np.testing.assert_array_equal(got_rows, rows)
+    np.testing.assert_array_equal(got_accs, accums)
+    dst.close()
+
+    # the victim's own cold tier reopens coherently mid-kill
+    reopened = TieredEmbeddingStore(
+        dim=DIM, hot_rows=16, path=store_path, updater="adagrad",
+        n_workers=1, seed=0,
+    )
+    ck = reopened.snapshot_arrays()[0]
+    assert (np.diff(ck) > 0).all() if len(ck) > 1 else True
+    reopened.close()

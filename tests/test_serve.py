@@ -732,3 +732,79 @@ def test_two_process_serving_acceptance(tmp_path, rng):
         if proc.poll() is None:
             proc.kill()
         proc.wait(timeout=10)
+
+
+def test_device_cache_policy_parity_and_device_scoring(rng):
+    """ISSUE 15: the device-resident cache block — same admission,
+    eviction, and invalidation TRAJECTORY as host mode (only row
+    residence changes), ``lookup_device`` keeps hit rows on device, and
+    a PS-backed server scoring through it returns the same scores."""
+    host = serve.HotEmbeddingCache(dim=4, capacity=8, admit_min_freq=2,
+                                   registry=obs.MetricsRegistry())
+    dev = serve.HotEmbeddingCache(dim=4, capacity=8, admit_min_freq=2,
+                                  registry=obs.MetricsRegistry(),
+                                  device_rows=True)
+    assert not host.device_rows and dev.device_rows
+    for step in range(30):
+        uids = np.unique(rng.integers(0, 24, size=6))
+        host.note_touched(uids)
+        dev.note_touched(uids)
+        rh, ph = host.lookup(uids)
+        rd, pd = dev.lookup(uids)
+        np.testing.assert_array_equal(ph, pd)
+        np.testing.assert_array_equal(rh, rd)
+        offer = (uids[:, None] * np.ones((1, 4)) + step).astype(np.float32)
+        assert host.insert(uids[~ph], offer[~ph]) == \
+            dev.insert(uids[~pd], offer[~pd])
+    sh, sd = host.stats(), dev.stats()
+    for k in ("entries", "hits", "misses", "evictions", "rejected"):
+        assert sh[k] == sd[k], k
+    # the device read path: same bytes, zero rows on misses, slots
+    # recycled through a full drop and refilled to capacity
+    probe = np.arange(0, 16, dtype=np.int64)
+    rows_dev, present = dev.lookup_device(probe)
+    rows_host, present_h = dev.lookup(probe)
+    np.testing.assert_array_equal(present, present_h)
+    np.testing.assert_array_equal(np.asarray(rows_dev), rows_host)
+    assert not np.asarray(rows_dev)[~present].any()
+    dev.set_version((1,))
+    assert dev.set_version((2,)) and len(dev) == 0
+    for i in range(3):  # 24 offers through an 8-slot pool: reuse works
+        assert dev.insert(np.arange(i * 8, i * 8 + 8, dtype=np.int64),
+                          np.ones((8, 4), np.float32)) >= 0
+    assert len(dev) <= dev.capacity
+
+    # end-to-end: a PS-backed server scoring through the device cache
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows = serve.fused_fm_rows(params)
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    admin.preload_arrays(keys, rows)
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", {},
+                           row_leaves=serve.fm_ps_row_leaves(K),
+                           row_dim=ROW_DIM),
+        ps=PSClient(svc.address, ROW_DIM), max_batch=16, max_wait_us=100,
+        queue_cap=64, deadline_ms=5000,
+        cache=serve.HotEmbeddingCache(dim=ROW_DIM, capacity=F,
+                                      device_rows=True),
+    )
+    cli = None
+    try:
+        cli = serve.PredictClient(srv.address)
+        b = _batch(rng, n=4)
+        np.testing.assert_allclose(cli.predict(b), _forward(params, b),
+                                   atol=2e-3)
+        st0 = srv.cache.stats()
+        assert st0["misses"] > 0 and st0["device_rows"]
+        # repeat: every row rides the device gather, scores unchanged
+        np.testing.assert_allclose(cli.predict(b), _forward(params, b),
+                                   atol=2e-3)
+        assert srv.cache.stats()["hits"] == st0["misses"]
+    finally:
+        if cli is not None:
+            cli.close()
+        srv.close()
+        admin.close()
+        svc.close()

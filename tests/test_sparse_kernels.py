@@ -303,6 +303,54 @@ def test_quantize_pack_ef_bit_identical(rng):
     np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
 
 
+def test_quantize_pack_packed_nibble_bit_parity(rng):
+    """The sub-byte wire form (ISSUE 15): a 4-bit table's packed bytes
+    carry TWO codes per byte, unpack back to exactly the reference
+    codec's codes (``quantize.compress``), decode to exactly the
+    reference's values, and weigh exactly what the cost model prices
+    (``_wire_row_bytes(dim, 4)`` per row) — even and odd row widths,
+    the odd tail's pad nibble sliced back off."""
+    from lightctr_tpu.dist.collectives import _wire_row_bytes
+    from lightctr_tpu.ops.quantize import pack_nibbles, unpack_nibbles
+
+    t4 = quantize.build_table(-1.0, 1.0, bits=4)
+    for n_rows, dim in ((32, 8), (17, 5)):
+        x = jnp.asarray(
+            (1.5 * rng.normal(size=(n_rows, dim))).astype(np.float32))
+        codes = quantize.compress(t4, x)
+        packed = sk.quantize_pack_packed(t4, x)
+        assert packed.dtype == jnp.uint8
+        assert packed.size == n_rows * dim // 2 + (n_rows * dim) % 2
+        # the cost model prices per ROW (frames pack row-major, one pad
+        # nibble at most per frame — n_rows * per_row bounds it)
+        assert packed.size <= n_rows * _wire_row_bytes(dim, 4)
+        got = unpack_nibbles(packed, n_rows * dim).reshape(n_rows, dim)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(codes))
+        np.testing.assert_array_equal(
+            np.asarray(quantize.extract(t4, got)),
+            np.asarray(quantize.extract(t4, codes)))
+    # wider tables pass through unpacked (one code per byte)
+    t8 = quantize.build_table(-1.0, 1.0, bits=8)
+    x = jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(sk.quantize_pack_packed(t8, x)),
+        np.asarray(sk.quantize_pack(t8, x)))
+
+
+def test_pack_nibbles_round_trip_orders(rng):
+    """Little-nibble order: the EVEN element rides the low nibble —
+    pinned so both wire ends agree byte-for-byte."""
+    from lightctr_tpu.ops.quantize import pack_nibbles, unpack_nibbles
+
+    codes = jnp.asarray(np.array([1, 15, 0, 7, 9], np.uint8))
+    packed = np.asarray(pack_nibbles(codes))
+    np.testing.assert_array_equal(
+        packed, np.array([1 | (15 << 4), 0 | (7 << 4), 9], np.uint8))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_nibbles(jnp.asarray(packed), 5)),
+        np.array([1, 15, 0, 7, 9], np.uint8))
+
+
 # -- dispatcher: capability gates, env flag, telemetry -------------------
 
 

@@ -283,6 +283,24 @@ def test_cost_model_hier_predicted_bytes_match_payload_shapes():
             bw=LinkBandwidth(4e9, 1e7, "env"),
         )
         assert (algo, b) == ("hier", coded_manual)
+        # the SUB-BYTE wire (ISSUE 15): wire_bits=4 prices two codes per
+        # byte, odd dims round up — exactly len(pack_nibbles(codes)) per
+        # row (the payload-shape test in test_sparse_kernels.py pins the
+        # codec side of the same byte count)
+        for d4 in (dim, dim + 1):  # even and odd row widths
+            nib_manual = (k_out + k_in) * (4 + (d4 + 1) // 2)
+            assert hier_wire_bytes(k_out, k_in, d4, wire_bits=4) == \
+                nib_manual
+            _, _, nib_wire_b = hier_exchange_bytes(
+                local_n, n // local_n, k, vocab, d4, wire_bits=4,
+            )
+            assert nib_wire_b == nib_manual
+        algo, b = pick_exchange_algo(
+            n, k, vocab, dim, local_n=local_n, wire_bits=4,
+            bw=LinkBandwidth(4e9, 1e7, "env"),
+        )
+        assert (algo, b) == (
+            "hier", hier_wire_bytes(k_out, k_in, dim, wire_bits=4))
 
 
 def test_cost_model_crossover_in_bandwidth_ratio():

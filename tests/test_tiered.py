@@ -533,3 +533,250 @@ def test_shared_ledger_feeds_admission(tmp_path):
     resident = set(t._slot_keys[t._slot_keys >= 0].tolist())
     assert resident == set(hot_keys.tolist())
     t.close()
+
+
+# ---------------------------------------------------------------------------
+# fault prefetch pipeline (ISSUE 15): overlap changes WHEN, never WHAT
+
+
+def test_fault_prefetch_overlap_vs_sync_equivalence(tmp_path):
+    """The pipeline's core contract: dispatching every batch ahead
+    (dispatch -> wait -> pull -> push) lands BIT-IDENTICAL rows and
+    optimizer state to the same stream served fully synchronously —
+    overlap moves the copy off the critical path, never the bytes —
+    while the overlap accounting proves the pipeline actually engaged."""
+    dim, vocab = 8, 512
+    a = tiered(tmp_path, dim, hot_rows=32, name="sync", prefetch=False)
+    b = tiered(tmp_path, dim, hot_rows=32, name="pipe", prefetch=True)
+    stream = make_stream(vocab, batch=64, steps=40, seed=7)
+    for step, ids in enumerate(stream):
+        # the pipelined driver's ordering (tools/tiered_bench.py): pull,
+        # dispatch the NEXT raw id stream behind the compute window, push
+        ra = a.pull_batch(ids, worker_epoch=step, worker_id=0)
+        rb = b.pull_batch(ids, worker_epoch=step, worker_id=0)
+        np.testing.assert_array_equal(ra, rb)
+        t = b.dispatch_prefetch(stream[step + 1]) \
+            if step + 1 < len(stream) else 0
+        uniq, first = np.unique(ids, return_index=True)
+        g = (0.1 * ra[first]).astype(np.float32)
+        a.push_batch(0, uniq, g, worker_epoch=step)
+        b.push_batch(0, uniq, g, worker_epoch=step)
+        if t:
+            b.prefetch_wait(t)
+    ka, rowsa, acca = a.snapshot_state_arrays()
+    kb, rowsb, accb = b.snapshot_state_arrays()
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(rowsa, rowsb)
+    np.testing.assert_array_equal(acca, accb)
+    st = b.stats()["store"]["fault_pipeline"]
+    assert st["enabled"] and st["overlap_rows"] > 0
+    assert b.stats()["store"]["fault_pipeline"]["overlap_ratio"] > 0.3
+    sa = a.stats()["store"]["fault_pipeline"]
+    assert not sa["enabled"] and sa["overlap_rows"] == 0
+    a.close()
+    b.close()
+
+
+def test_fault_prefetch_stale_and_demotion_ticket_reuse(tmp_path):
+    """Writes and residency churn between a dispatch and its pull must
+    invalidate the staged work, not serve it: a push rewrites staged
+    keys (surgical staleness), and an interleaved hot-tier storm demotes
+    them (slot tickets recycled, plan epoch-guarded) — the committing
+    pull still returns exactly what a synchronous twin returns, and the
+    pipeline's honesty counters record the fallbacks."""
+    dim = 8
+    s = tiered(tmp_path, dim, hot_rows=8, name="churn", prefetch=True)
+    o = tiered(tmp_path, dim, hot_rows=8, name="oracle", prefetch=False)
+    # seed two disjoint key bands; the small hot tier demotes between them
+    band1 = np.arange(1, 17, dtype=np.int64)
+    band2 = np.arange(100, 116, dtype=np.int64)
+    for step, ids in enumerate((band1, band2, band1)):
+        train_step(s, ids, step)
+        train_step(o, ids, step)
+    # PLAN FALLBACK: dispatch band2's cover, then pull band1 instead —
+    # the one-shot plan is consumed by a mismatched request and the pull
+    # takes the (always-correct) normal path
+    t = s.dispatch_prefetch(band2)
+    assert t and s.prefetch_wait(t)
+    train_step(s, band1, 3)
+    train_step(o, band1, 3)
+    # STALENESS: stage a cover holding UNSEEN keys (the payload-only
+    # degrade — no rng consumed), then push some of its SEEN keys before
+    # the commit: the in-place write-back surgically invalidates their
+    # staged copies
+    band3 = np.arange(300, 308, dtype=np.int64)
+    cover = np.concatenate([band2[:8], band3])
+    t = s.dispatch_prefetch(cover)
+    assert t and s.prefetch_wait(t)
+    g = np.full((8, dim), 0.05, np.float32)
+    s.push_batch(0, band2[:8], g, worker_epoch=4)
+    o.push_batch(0, band2[:8], g, worker_epoch=4)
+    # the committing pull serves fresh bytes — identical to the oracle
+    r_s = s.pull_batch(cover, worker_epoch=5, worker_id=0)
+    r_o = o.pull_batch(cover, worker_epoch=5, worker_id=0)
+    np.testing.assert_array_equal(r_s, r_o)
+    snap = s.registry.snapshot()["counters"]
+    assert snap.get("tiered_pull_plan_fallbacks_total", 0) > 0, \
+        "the mismatched pull never recorded a plan fallback"
+    assert snap.get("tiered_fault_prefetch_stale_total", 0) > 0, \
+        "the interleaved push never staled the staged rows"
+    # demoted-and-recycled slots: occupancy never exceeded the budget
+    assert s.peak_hot_rows <= 8
+    s.close()
+    o.close()
+
+
+def test_device_mode_trajectory_matches_numpy_mode(tmp_path):
+    """The acceptance contract: the device-resident hot tier
+    (``device_hot=True`` — committed host buffer on CPU) follows the
+    numpy-mode store bit-for-bit through training, demotion write-back,
+    and the state-carrying snapshot, with the prefetch pipeline live on
+    both.  The stream trains toward a NONZERO target: rows decaying to
+    exactly zero leave fp32's normal range, and XLA (CPU and TPU alike)
+    flushes subnormals where numpy keeps them — the documented edge of
+    the bit-parity contract (docs/TIERED_STORE.md)."""
+    dim, vocab = 8, 256
+    rng = np.random.default_rng(5)
+    target = (0.5 * rng.normal(size=(vocab + 1, dim))).astype(np.float32)
+    a = tiered(tmp_path, dim, hot_rows=16, name="np_m", device_hot=False)
+    b = tiered(tmp_path, dim, hot_rows=16, name="dev_m", device_hot=True)
+    for step, ids in enumerate(make_stream(vocab, 48, 30, seed=11)):
+        ra = train_step(a, ids, step, target=target)
+        rb = train_step(b, ids, step, target=target)
+        np.testing.assert_array_equal(ra, rb)
+    ka, rowsa, acca = a.snapshot_state_arrays()
+    kb, rowsb, accb = b.snapshot_state_arrays()
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(rowsa, rowsb)
+    np.testing.assert_array_equal(acca, accb)
+    # migrate the device-mode store's rows+accums out and back in (the
+    # MSG_MIGRATE_STATE body) — read-back equals the snapshot exactly
+    c = tiered(tmp_path, dim, hot_rows=16, name="dst_m", device_hot=True)
+    mr, ma = c.migrate_in_state(kb, rowsb, accb)
+    np.testing.assert_array_equal(mr, rowsb)
+    np.testing.assert_array_equal(ma, accb)
+    a.close()
+    b.close()
+    c.close()
+
+
+def test_trainer_device_fast_path_parity_and_stale_tickets(tmp_path):
+    """models/sparse_trainer.TieredDeviceEmbedding (ISSUE 15): the
+    all-hot chain (slot tickets -> gather_rows -> fused merge_apply
+    aliasing the pair -> adopt) is bit-identical to the same JITTED
+    merge_apply program over a dense oracle table; mixed batches land
+    their miss rows through push_batch; stale tickets (residency moved
+    after the gather) refuse the adopt loudly."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    from lightctr_tpu.models.sparse_trainer import TieredDeviceEmbedding
+    from lightctr_tpu.ops import sparse_kernels as sk
+
+    dim = 8
+    store = tiered(tmp_path, dim, hot_rows=64, name="fastpath",
+                   device_hot=True, prefetch=False)
+    emb = TieredDeviceEmbedding(store, denom=2.0)
+    rng = np.random.default_rng(0)
+    keys = np.arange(1, 33, dtype=np.int64)
+    emb.gather(keys)  # create + promote everything: all-hot regime
+    rows0, _, known = store.pull_state_batch(keys)
+    assert known.all()
+    vocab = 1 << 10
+    W = jnp.zeros((vocab, dim), jnp.float32)
+    A = jnp.zeros((vocab, dim), jnp.float32)
+    W = W.at[jnp.asarray(keys)].set(jnp.asarray(rows0))
+    oracle = jax.jit(partial(sk.merge_apply, lr=store.lr, eps=store.eps,
+                             denom=2.0))
+    for step in range(15):
+        ids = rng.choice(keys, size=64)
+        rows_u, inv, tk = emb.gather(ids)
+        uq = np.unique(ids)
+        np.testing.assert_array_equal(
+            np.asarray(rows_u), np.asarray(W[jnp.asarray(uq)]))
+        g = rng.normal(size=(64, dim)).astype(np.float32)
+        emb.apply(tk, g)
+        up = 8
+        while up < len(uq):
+            up *= 2
+        uids_p = np.zeros(up, np.int32)
+        uids_p[: len(uq)] = uq
+        inv_p = np.full(64, up - 1, np.int32)
+        inv_p[:64] = np.unique(ids, return_inverse=True)[1]
+        W, A, _ = oracle(W, A, jnp.asarray(uids_p), jnp.asarray(g),
+                         jnp.asarray(inv_p))
+        got, accs, _ = store.pull_state_batch(keys)
+        np.testing.assert_array_equal(got, np.asarray(W[jnp.asarray(keys)]))
+        np.testing.assert_array_equal(accs, np.asarray(A[jnp.asarray(keys)]))
+    assert emb.fast_steps == 15
+    store.close()
+
+    # mixed residency: misses ride push_batch, values stay finite and
+    # every touched key exists afterwards
+    s2 = tiered(tmp_path, dim, hot_rows=8, name="fastmixed",
+                device_hot=True, prefetch=True)
+    e2 = TieredDeviceEmbedding(s2)
+    touched = set()
+    for step in range(20):
+        ids = rng.integers(1, 100, size=32)
+        touched.update(np.unique(ids).tolist())
+        rows_u, inv, tk = e2.gather(ids)
+        if step + 1 < 20:
+            e2.prefetch_next(rng.integers(1, 100, size=32))
+        e2.apply(tk, rng.normal(size=(32, dim)).astype(np.float32))
+    assert e2.mixed_steps > 0
+    tk_all = np.sort(np.fromiter(touched, np.int64))
+    rows, _, known = s2.pull_state_batch(tk_all)
+    assert known.all() and np.isfinite(rows).all()
+
+    # stale tickets: residency moves between gather and apply -> the
+    # apply falls back to the store surface (no adopt through dead slots)
+    ids = rng.integers(1, 100, size=16)
+    rows_u, inv, tk = e2.gather(ids)
+    # churn residency underneath the ticket (evict always moves it)
+    hot_now = tk["uniq"][tk["hot"]]
+    assert len(hot_now), "regime never promoted anything"
+    s2.evict_batch(hot_now[:1])
+    before = e2.stale_tickets
+    e2.apply(tk, np.zeros((16, dim), np.float32))
+    assert e2.stale_tickets == before + 1
+    # and a direct stale adopt fails loud
+    w, a = s2.device_tables()
+    with pytest.raises(ValueError, match="stale slot tickets"):
+        s2.adopt_device_tables(w, a, expect_res_epoch=-1)
+    s2.close()
+
+
+def test_hosted_push_echo_prefetch_overlaps(tmp_path):
+    """dist/ps_server.py wiring: a hosted tiered store's landed pushes
+    echo their covers into dispatch_prefetch, so the worker's next pull
+    finds its repeat-miss rows staged — overlap rows accrue over a real
+    socket with NO lookahead protocol, and the trajectory equals an
+    in-process store fed the identical stream."""
+    from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+
+    dim = 4
+    hosted = tiered(tmp_path, dim, hot_rows=8, name="hosted",
+                    prefetch=True)
+    oracle = tiered(tmp_path, dim, hot_rows=8, name="wire_oracle",
+                    prefetch=False)
+    svc = ParamServerService(hosted)
+    c = PSClient(svc.address, dim=dim)
+    rng = np.random.default_rng(3)
+    keys = np.arange(1, 40, dtype=np.int64)
+    for ep in range(25):
+        ks = np.unique(rng.choice(keys, 16))
+        rows = c.pull_arrays(ks, worker_epoch=ep, worker_id=0)[1]
+        want = oracle.pull_batch(ks, worker_epoch=ep, worker_id=0)
+        np.testing.assert_allclose(rows, want, rtol=0, atol=1e-3)
+        g = np.ones((len(ks), dim), np.float32)
+        c.push_arrays(0, ks, g, worker_epoch=ep)
+        oracle.push_batch(0, ks, g, worker_epoch=ep)
+        time.sleep(0.005)  # the echo stages behind the reply
+    st = hosted.stats()["store"]["fault_pipeline"]
+    assert st["enabled"] and st["overlap_rows"] > 0
+    c.close()
+    svc.close()
+    hosted.close()
+    oracle.close()

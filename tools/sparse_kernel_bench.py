@@ -100,6 +100,21 @@ def run(steps: int = 20, out: str = "SPARSE_KERNEL_BENCH.json",
           f"{cells[-1]['t_fused_ms']}ms {cells[-1]['impl_fused']}",
           file=sys.stderr, flush=True)
 
+    # -- gather: the device-resident row path's read half (ISSUE 15) ----
+    gb, gd = 1 << 16, 16
+    gn = 2048 if interp else 8192
+    block = jnp.asarray(r.normal(size=(gb, gd)).astype(np.float32))
+    gidx = jnp.asarray(r.integers(0, gb, size=gn).astype(np.int32))
+    ref = jax.jit(lambda b, i: sk.KERNELS["gather_rows"].reference(b, i))
+    fused = jax.jit(lambda b, i: sk.gather_rows(b, i))
+    cells.append(_cell("gather", "gather_rows",
+                       f"{gn} rows of [{gb}, {gd}] block",
+                       lambda: ref(block, gidx),
+                       lambda: fused(block, gidx), steps))
+    print(f"gather: {cells[-1]['t_ref_ms']}ms ref vs "
+          f"{cells[-1]['t_fused_ms']}ms {cells[-1]['impl_fused']}",
+          file=sys.stderr, flush=True)
+
     # -- merge + apply: touched-row adagrad over a big table ------------
     s = 1024 if interp else 8192
     m, dim, tv = 4 * s, 16, 1 << 18

@@ -2,9 +2,10 @@
 
 The tiered store's promise (docs/TIERED_STORE.md) is quantitative: because
 CTR id traffic is power-law skewed, a hot tier holding a FRACTION of the
-vocabulary should keep most of the flat store's throughput — the skewed
-cells must hold >= 70% of flat-store row throughput at 1/16 residency.
-This bench measures exactly that grid:
+vocabulary should keep — since the device-resident fault pipeline (PR 15),
+MATCH OR BEAT — the flat store's throughput: the skewed cells must hold
+>= 1.0x flat-store row throughput at 1/16 residency.  This bench measures
+exactly that grid:
 
   - zipf skews {1.1, 0.8, uniform}: the head-heavy CTR shape, a flatter
     tail-heavy stream, and the adversarial no-locality case (bounded
@@ -14,13 +15,30 @@ This bench measures exactly that grid:
   - each cell trains the SAME pull/push stream against a flat
     ``AsyncParamServer`` and a ``TieredEmbeddingStore`` (same updater,
     same seed discipline) and reports row throughput, the ratio, per-tier
-    hit/fault rates, and the fault-path latency distribution from the
-    ``tiered_fault_seconds`` histogram;
+    hit/fault rates, the fault-path latency distribution from the
+    ``tiered_fault_seconds`` histogram, and the ``fault_overlap`` column
+    proving the async pipeline actually engaged;
   - the full vocabulary is PRE-CREATED before the timed window (both
     stores): the cells measure STEADY-STATE row traffic — the regime a
     checkpoint-restored production store lives in — not the one-time
     vocabulary-discovery appends a zipf tail drips into every batch of a
     cold-start run (those are a bounded O(vocab) cost, not a throughput).
+
+Timing model (PR 15): the driver is the PIPELINED training loop a
+device-resident store serves — pull, dispatch the NEXT batch's fault
+prefetch, a fixed ``--compute-ms`` step window (the fwd/bwd the device
+executes; ``time.sleep``, so the store's worker thread gets the CPU the
+device step would leave idle), push.  The timed quantity is the
+STORE-ATTRIBUTABLE wall time — pull + push on the critical path, the
+compute window excluded for BOTH stores — because that is exactly what a
+trainer's step time charges the store.  Work the tiered store overlaps
+into the window (tier reads, ledger, admission, demotion write-backs,
+all run by ``dispatch_prefetch``) leaves the critical path honestly: the
+``fault_overlap`` column reports how much did, and a store driven
+WITHOUT dispatch (the synchronous fallback) still serves every batch —
+it just pays the reads in line, like the pre-PR-15 numbers.  Wall clock
+rather than process CPU because overlap is the point being measured;
+best-of-N repeats absorb shared-box noise.
 
 Emits ``TIERED_BENCH.json`` (stdout + file).  Synthetic streams: no
 dataset needed, runs in any checkout.
@@ -48,7 +66,7 @@ from lightctr_tpu.obs.registry import histogram_quantile  # noqa: E402
 SKEWS = (1.1, 0.8, 0.0)  # 0.0 = uniform
 FRACTIONS = (4, 16, 64)  # hot tier = vocab / fraction
 GATE_FRACTION = 16
-GATE_RATIO = 0.70
+GATE_RATIO = 1.0  # the PR 15 gate: tiered >= flat at 1/16, skewed cells
 
 
 def _log(msg: str) -> None:
@@ -81,15 +99,17 @@ def pretouch(store, vocab: int, chunk: int = 8192) -> None:
         store.pull_batch(ids[i:i + chunk], worker_epoch=0, worker_id=0)
 
 
-def run_store(store, stream, warmup: int):
-    """Drive one pull+push pass per batch; returns rows/s over the timed
-    (post-warmup) portion.  Process time, not wall clock: the store is
-    single-threaded and synchronous, so CPU time IS its cost — and it
-    keeps the ratio honest on a contended box (a descheduled slice would
-    otherwise charge one store for a neighbor's cache pressure)."""
+def run_store(store, stream, warmup: int, compute_s: float = 0.002):
+    """Drive the pipelined loop (module docstring): pull -> dispatch the
+    next batch's prefetch (stores that have the pipeline) -> the step's
+    compute window -> push.  Returns rows/s over the STORE-ATTRIBUTABLE
+    wall time of the timed (post-warmup) portion — pull + push on the
+    critical path; the compute window (identical for both stores) and
+    the host-side gradient build are excluded."""
     dim = store.dim
+    dispatch = getattr(store, "dispatch_prefetch", None)
     rows_done = 0
-    t0 = None
+    t_store = 0.0
     for i, ids in enumerate(stream):
         if i == warmup:
             reg = getattr(store, "registry", None)
@@ -97,22 +117,32 @@ def run_store(store, stream, warmup: int):
                 # counters/hit rates in the report describe the TIMED
                 # window, not the pretouch/warmup churn
                 reg.reset()
-            t0 = time.process_time()
+            rows_done = 0
+            t_store = 0.0
+        t0 = time.monotonic()
         rows = store.pull_batch(ids, worker_epoch=i, worker_id=0)
+        t1 = time.monotonic()
+        if dispatch is not None and i + 1 < len(stream):
+            dispatch(stream[i + 1])
+        if compute_s > 0:
+            # the device step the fault pipeline overlaps: sleep yields
+            # the core, exactly like a dispatched accelerator step would
+            time.sleep(compute_s)
         uniq = np.unique(ids)
         # the teaching push: a constant pull toward zero, enough to make
         # every row dirty (the demotion write-back path stays honest)
         g = np.full((len(uniq), dim), 0.01, np.float32)
+        t2 = time.monotonic()
         store.push_batch(0, uniq, g, worker_epoch=i)
-        if i >= warmup:
-            rows_done += len(ids) + len(uniq)
+        t3 = time.monotonic()
+        t_store += (t1 - t0) + (t3 - t2)
+        rows_done += len(ids) + len(uniq)
         del rows
-    dt = time.process_time() - t0
-    return rows_done / dt, dt
+    return rows_done / t_store, t_store
 
 
 def run_cell(vocab, dim, batch, steps, warmup, skew, frac, workdir,
-             repeats=3):
+             repeats=3, compute_s=0.002):
     stream = make_stream(vocab, batch, steps + warmup, skew,
                          seed=int(skew * 10) + frac)
     hot_rows = vocab // frac
@@ -127,7 +157,7 @@ def run_cell(vocab, dim, batch, steps, warmup, skew, frac, workdir,
             dim=dim, updater="adagrad", n_workers=1, seed=0
         )
         pretouch(flat, vocab)
-        rps, _ = run_store(flat, stream, warmup)
+        rps, _ = run_store(flat, stream, warmup, compute_s=compute_s)
         flat_rps = max(flat_rps, rps)
         t = TieredEmbeddingStore(
             dim=dim, hot_rows=hot_rows,
@@ -135,7 +165,7 @@ def run_cell(vocab, dim, batch, steps, warmup, skew, frac, workdir,
             updater="adagrad", n_workers=1, seed=0,
         )
         pretouch(t, vocab)
-        rps, _ = run_store(t, stream, warmup)
+        rps, _ = run_store(t, stream, warmup, compute_s=compute_s)
         if rps > tiered_rps or tiered is None:
             tiered_rps = rps
             if tiered is not None:
@@ -172,6 +202,20 @@ def run_cell(vocab, dim, batch, steps, warmup, skew, frac, workdir,
         },
         "cold_compactions": c.get("tiered_cold_compactions_total", 0),
     }
+    # the async fault pipeline's engagement (PR 15): rows whose tier
+    # reads the dispatch stage absorbed vs rows read on the critical
+    # path, plus how many pulls committed off a dispatched plan
+    ov = c.get("tiered_fault_overlap_rows_total", 0)
+    sy = c.get("tiered_fault_sync_rows_total", 0)
+    cell["fault_overlap"] = {
+        "overlap_rows": ov,
+        "sync_rows": sy,
+        "ratio": round(ov / (ov + sy), 5) if (ov + sy) else 0.0,
+        "plan_commits": c.get("tiered_pull_plan_commits_total", 0),
+        "plan_fallbacks": c.get("tiered_pull_plan_fallbacks_total", 0),
+        "staged_rows": c.get("tiered_fault_prefetch_rows_total", 0),
+        "stale_rows": c.get("tiered_fault_prefetch_stale_total", 0),
+    }
     hist = snap.get("histograms", {}).get("tiered_fault_seconds")
     if hist and hist.get("count"):
         cell["fault_latency"] = {
@@ -202,6 +246,11 @@ def main(argv=None):
     ap.add_argument("--repeats", type=int, default=3,
                     help="replays per cell; best run wins (shared-box "
                          "interference shows up as slow outliers)")
+    ap.add_argument("--compute-ms", type=float, default=2.0,
+                    help="the simulated device-step window per batch "
+                         "(module docstring): identical for both stores, "
+                         "excluded from the timed store cost, and the "
+                         "window the fault pipeline overlaps into")
     ap.add_argument("--out", default="TIERED_BENCH.json",
                     help="also write the artifact here ('-' = stdout only)")
     args = ap.parse_args(argv)
@@ -212,10 +261,12 @@ def main(argv=None):
         for frac in FRACTIONS:
             cell = run_cell(args.vocab, args.dim, args.batch, args.steps,
                             args.warmup, skew, frac, workdir,
-                            repeats=args.repeats)
+                            repeats=args.repeats,
+                            compute_s=args.compute_ms / 1e3)
             _log(f"skew={cell['skew']} frac=1/{frac}: "
                  f"ratio={cell['throughput_ratio']} "
-                 f"hot_hit={cell['hit_rates']['hot']}")
+                 f"hot_hit={cell['hit_rates']['hot']} "
+                 f"overlap={cell['fault_overlap']['ratio']}")
             cells.append(cell)
 
     gate_cells = [
@@ -227,6 +278,12 @@ def main(argv=None):
         "vocab": args.vocab, "dim": args.dim, "batch": args.batch,
         "steps": args.steps, "warmup": args.warmup,
         "repeats": args.repeats,
+        "timing": {
+            "model": "pipelined: store-attributable wall time "
+                     "(pull + push on the critical path; the identical "
+                     "compute window excluded for both stores)",
+            "compute_ms": args.compute_ms,
+        },
         "cells": cells,
         "gate": {
             "rule": f"skewed cells hold >= {GATE_RATIO} of flat "
@@ -238,6 +295,10 @@ def main(argv=None):
     report["ok"] = bool(
         all(c["throughput_ratio"] >= GATE_RATIO for c in gate_cells)
         and all(c["budget_held"] for c in cells)
+        # the pipeline must actually ENGAGE (honesty: a ratio earned with
+        # the async path dead would be flat-store noise, not the feature)
+        and all(c["fault_overlap"]["plan_commits"] > 0
+                or c["fault_overlap"]["ratio"] > 0 for c in cells)
     )
     if args.out and args.out != "-":
         with open(args.out, "w") as f:
