@@ -671,13 +671,12 @@ def hier_wire_bytes(
     table: push its ``k_out`` locally-merged entries + pull the
     ``k_in``-entry cross-host union, each entry an id plus ``dim`` values
     (``wire_bits`` None = the exact fp32 wire codec, 16 = the PS fp16
-    codec, 5..8 = 1-byte codes, <=4 = bit-packed nibble codes at two per
-    byte — ``ops.quantize.pack_nibbles``).  Note ``wire_bits=4`` prices
-    the ``quantize_pack_packed`` nibble codec, which exists at the
-    kernel layer; ``HierExchangeClient`` ships None/16/8-bit frames
-    today, so pass 4 only when pricing a 4-bit wire you actually run
-    (client wiring is a ROADMAP follow-up).  Flat in local replica count
-    by construction — the replicas merged before the wire."""
+    codec, 5..8 = 1-byte codes — the client's ``q8_ef`` frame, <=4 =
+    bit-packed nibble codes at two per byte — the client's ``q4_ef``
+    frame, ``ops.quantize.pack_nibbles`` order on the wire).  Every
+    priced width is a codec ``HierExchangeClient`` actually ships.  Flat
+    in local replica count by construction — the replicas merged before
+    the wire."""
     idb = 4 if include_ids else 0
     per = idb + _wire_row_bytes(dim, wire_bits)
     return int((int(k_out) + int(k_in)) * per)
@@ -742,6 +741,8 @@ def pick_exchange_algo(
     wire_bits: int | None = None,
     prev: str | None = None,
     hier_margin: float = HIER_DCN_MARGIN,
+    stripes: int = 1,
+    overlap_push: bool = False,
 ) -> tuple[str, int]:
     """Trace-time exchange pick -> ``(algo, bytes)``.
 
@@ -773,8 +774,19 @@ def pick_exchange_algo(
     probe noise.  For the hier branch the returned bytes are the DCN WIRE
     bytes per host (the scarce resource the pick is protecting);
     ``wire_bits`` prices the wire codec (None = exact fp32, 16 = the PS
-    fp16 codec, 8 = the q8_ef coded frame, 4 = the bit-packed nibble
-    codec — kernel-layer only today, see :func:`hier_wire_bytes`)."""
+    fp16 codec, 8 = the client's q8_ef frame, 4 = the client's q4_ef
+    nibble frame — see :func:`hier_wire_bytes`).
+
+    STREAMING rendezvous terms (ISSUE 16): ``stripes`` is the number of
+    rendezvous shards a table's id space is striped across — aggregate
+    DCN bandwidth scales with shard count, so the hier wire sees
+    ``stripes ×`` the per-link rate (the flat candidates ride in-jit
+    collectives and do not stripe).  ``overlap_push=True`` prices the
+    dispatch/commit ticket: the chunked push of step N transmits while
+    the NEXT step's local merge computes, so the hier time is
+    ``max(local_t, push_t) + pull_t`` instead of the serial sum — only
+    the pull stays on the critical path when the push hides under
+    compute."""
     dense_b = dense_ring_bytes(vocab, dim, n, dense_bits)
     ag_b = sparse_exchange_bytes(n, k_padded, dim, sparse_bits)
     bucket, shard = rs_default_caps(n, k_padded, vocab, slack)
@@ -811,9 +823,24 @@ def pick_exchange_algo(
         return (local_n * b * cross / bw.dcn_bps
                 + b * (1.0 - cross) / bw.ici_bps)
 
+    # streaming terms: striped shards multiply the wire rate; an
+    # overlapped push hides under the local merge (docstring above).
+    # The push/pull split reuses the union estimator the combined
+    # hier_wire_b was built from, so the two always sum consistently.
+    dcn_eff = bw.dcn_bps * max(1, int(stripes))
+    local_t = hier_local_b / bw.ici_bps
+    if overlap_push:
+        k_out = expected_union(k_padded, vocab, local_n)
+        k_in = expected_union(k_padded, vocab, local_n * n_hosts)
+        push_t = hier_wire_bytes(k_out, 0, dim, wire_bits) / dcn_eff
+        pull_t = hier_wire_bytes(0, k_in, dim, wire_bits) / dcn_eff
+        hier_t = max(local_t, push_t) + pull_t
+    else:
+        hier_t = local_t + hier_wire_b / dcn_eff
+
     times = {
         flat_algo: flat_time(flat_b),
-        "hier": (hier_local_b / bw.ici_bps + hier_wire_b / bw.dcn_bps),
+        "hier": hier_t,
     }
     bytes_of = {flat_algo: flat_b, "hier": hier_wire_b}
     best = min(times, key=times.get)

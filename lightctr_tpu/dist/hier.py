@@ -68,6 +68,7 @@ import socket
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -97,10 +98,18 @@ from lightctr_tpu.obs.registry import (
 #: rollup's straggler attributor (obs/cluster.py) ranks hosts off its
 #: sum/count.
 HIER_ROUND_SERIES = (
-    "hier_round_wait_seconds",            # shard hist {host}: arrival offset
-                                          # behind the round's first push
+    "hier_round_wait_seconds",            # shard hist {host}: FIRST-chunk
+                                          # arrival offset behind the
+                                          # round's first push
+    "hier_round_chunk_spread_seconds",    # shard hist {host}: last-chunk
+                                          # minus first-chunk offset (a slow
+                                          # TRICKLER, vs a late starter)
     "hier_round_client_seconds",          # client hist: push->pull-satisfied
     "hier_round_withheld_retries_total",  # client counter: withheld retries
+    "hier_stripe_push_bytes_total",       # client counter {stripe}: push
+                                          # frame bytes per rendezvous shard
+    "hier_stripe_pull_bytes_total",       # client counter {stripe}: pull
+                                          # reply bytes per rendezvous shard
 )
 
 #: push/pull header codec flags (a varint bitfield, so old peers that only
@@ -110,12 +119,25 @@ HIER_ROUND_SERIES = (
 #:   bit 1 — quantile-coded payload (the tagged ``wire.pack_rows_coded``
 #:           frame / ``pack_codes_section`` group sections)
 #:   bit 2 — GROUP frame: one shared id stream + per-table value sections
+#:   bit 3 — CHUNKED push: the payload is prefixed with
+#:           ``wire.pack_chunk_header`` — one fixed row WINDOW of this
+#:           host's contribution (the streaming rendezvous, ISSUE 16); an
+#:           unflagged frame is exactly chunk (0, 1)
+#:   bit 4 — NIBBLE pull: the puller asks the owner-side encode for 4-bit
+#:           codes (``codec="q4_ef"``) — the header is frozen at exactly
+#:           five varints, so the code width rides a flag, not a field
 FLAG_F32 = 1
 FLAG_CODED = 2
 FLAG_GROUP = 4
+FLAG_CHUNK = 8
+FLAG_NIBBLE = 16
 
 #: code width of the ``q8_ef`` wire codec (<= 8 — one byte per value)
 CODED_BITS = 8
+
+#: code width of the ``q4_ef`` wire codec (two codes per byte — the
+#: kernel-layer nibble packing of PR 15, now on the socket wire)
+NIBBLE_BITS = 4
 
 
 class _EFCarry:
@@ -243,21 +265,38 @@ def _decode_section(buf: bytes, n: int, dim: int, flags: int
 
 
 class _Round:
-    """One (epoch, table) reduction round: contributions keyed by host,
-    merged lazily on the first complete pull, garbage-collected once every
-    host pulled it back.  ``coded_section`` caches the ONE owner-side
-    EF-compensated encode of the merged rows (every host must decode
-    identical bytes and the owner carry must advance exactly once per
-    round); ``ids_bytes`` caches the tagged id stream beside it.  ``t0``
-    is the perf-counter instant of the round's FIRST push and
-    ``arrivals`` the per-host offsets behind it — the straggler
-    attribution timeline (ISSUE 14)."""
+    """One (epoch, table) reduction round.  Every contribution is a
+    sequence of ``n_chunks`` disjoint sorted uid windows (a legacy
+    unchunked frame is exactly chunk ``(0, 1)``); ``chunks_seen`` /
+    ``chunks_total`` dedup retried chunks — at-least-once delivery counts
+    each window ONCE — and decide host completion without an
+    end-of-stream frame.  In STREAMING mode (ISSUE 16) each chunk
+    segment-merges into the bounded ``(acc_keys, acc_rows)`` accumulator
+    AS IT ARRIVES, so round memory tracks the cross-host id UNION rather
+    than ``n_hosts × payload``; in barrier mode chunks buffer in
+    ``contrib`` and merge lazily on the first complete pull (the PR 10
+    path, retained as the bench A/B baseline).  ``coded_section`` caches
+    the ONE owner-side EF-compensated encode of the merged rows (every
+    host must decode identical bytes and the owner carry must advance
+    exactly once per round); ``ids_bytes`` caches the tagged id stream
+    beside it.  ``t0`` is the perf-counter instant of the round's FIRST
+    push; ``first_off``/``last_off`` are the per-host first- and
+    last-chunk offsets behind it — a late STARTER and a slow TRICKLER
+    are different straggler diagnoses (ISSUE 14/16)."""
 
-    __slots__ = ("contrib", "merged", "pulled", "dim", "coded_section",
-                 "ids_bytes", "t0", "arrivals")
+    __slots__ = ("contrib", "acc_keys", "acc_rows", "chunks_seen",
+                 "chunks_total", "first_off", "last_off", "merged",
+                 "pulled", "dim", "coded_section", "ids_bytes", "t0",
+                 "arrivals")
 
     def __init__(self, dim: int):
-        self.contrib: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.contrib: Dict[int, list] = {}
+        self.acc_keys: Optional[np.ndarray] = None
+        self.acc_rows: Optional[np.ndarray] = None
+        self.chunks_seen: Dict[int, set] = {}
+        self.chunks_total: Dict[int, int] = {}
+        self.first_off: Dict[int, float] = {}
+        self.last_off: Dict[int, float] = {}
         self.merged: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self.pulled: set = set()
         self.dim = dim
@@ -266,16 +305,68 @@ class _Round:
         self.t0: Optional[float] = None
         self.arrivals: List[Tuple[int, float]] = []
 
+    def hosts_done(self) -> int:
+        """Hosts whose every declared chunk has arrived."""
+        return sum(1 for h, t in self.chunks_total.items()
+                   if len(self.chunks_seen[h]) >= t)
+
+    def accumulate(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Sorted-merge one chunk into the streaming accumulator: ids
+        already accumulated segment-sum in place, fresh ids pay one union
+        merge.  Chunks of one host window DISJOINT uid ranges, so each
+        (host, uid) adds exactly once per round — the dedup in ``_push``
+        plus this invariant is what keeps chunked and single-shot rounds
+        numerically aligned."""
+        if not keys.size:
+            return
+        if self.acc_keys is None or not self.acc_keys.size:
+            self.acc_keys = keys.astype(np.int64, copy=True)
+            self.acc_rows = rows.astype(np.float32, copy=True)
+            return
+        pos = np.searchsorted(self.acc_keys, keys)
+        pos_c = np.minimum(pos, self.acc_keys.size - 1)
+        hit = self.acc_keys[pos_c] == keys
+        self.acc_rows[pos_c[hit]] += rows[hit]
+        if hit.all():
+            return
+        fresh = ~hit
+        union = np.union1d(self.acc_keys, keys[fresh])
+        merged = np.zeros((union.size, self.dim), np.float32)
+        merged[np.searchsorted(union, self.acc_keys)] = self.acc_rows
+        merged[np.searchsorted(union, keys[fresh])] = rows[fresh]
+        self.acc_keys, self.acc_rows = union, merged
+
+    def nbytes(self) -> int:
+        """Live payload bytes this round pins (accumulator, barrier
+        buffers, merged result) — the shard's peak-memory telemetry."""
+        total = 0
+        if self.acc_keys is not None:
+            total += self.acc_keys.nbytes + self.acc_rows.nbytes
+        for parts in self.contrib.values():
+            for _, k, r in parts:
+                total += k.nbytes + r.nbytes
+        if self.merged is not None:
+            total += self.merged[0].nbytes + self.merged[1].nbytes
+        return total
+
 
 class SparseReduceShard:
     """One owner shard of the cross-host reduce rendezvous (class
     docstring above).  ``n_hosts`` is the round-completion bar: a pull is
-    withheld until that many distinct hosts pushed the round.
+    withheld until that many distinct hosts pushed ALL their declared
+    chunks of the round.
 
-    Determinism: contributions merge in HOST-ID order with one
-    ``np.add.at`` segment sum over the sorted union — every host pulls
-    bit-identical merged rows, the replicas-cannot-diverge contract of the
-    in-jit exchanges carried across the DCN."""
+    ``streaming=True`` (the default, ISSUE 16) reduces each arriving
+    chunk into the round's bounded accumulator off the wire — peak round
+    memory tracks the cross-host id union, independent of ``n_hosts`` —
+    and per-uid sums land in ARRIVAL order (every host still pulls
+    bit-identical merged rows: all hosts read the one accumulator; with
+    two hosts the sum is also bit-equal to the barrier merge by
+    commutativity).  ``streaming=False`` retains the PR 10 barrier: buffer
+    every contribution, merge once in (host-id, chunk-idx) order with one
+    ``np.add.at`` segment sum — the replicas-cannot-diverge contract of
+    the in-jit exchanges carried across the DCN, and the bench's A/B
+    baseline arm."""
 
     #: completed rounds older than this many epochs behind the newest seen
     #: are dropped even if a host never pulled them (a crashed host must
@@ -288,14 +379,20 @@ class SparseReduceShard:
     ARRIVAL_RING = 64
 
     def __init__(self, n_hosts: int, host: str = "127.0.0.1", port: int = 0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 streaming: bool = True):
         if n_hosts < 1:
             raise ValueError("n_hosts must be >= 1")
         self.n_hosts = int(n_hosts)
+        self.streaming = bool(streaming)
         self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
         self._rounds: Dict[Tuple[int, int], _Round] = {}
         self._max_epoch = -(1 << 62)
+        # high-water mark of live round payload bytes (accumulators +
+        # barrier buffers) — the flat-as-n_hosts-doubles acceptance gate
+        # reads this from stats()
+        self._peak_round_bytes = 0
         # owner-side EF carries, one sparse table-keyed carry per table:
         # the stage-2 sum-mode rs EF of the in-jit exchange, across the
         # DCN — each merged round's encode compensates from the previous
@@ -347,8 +444,11 @@ class SparseReduceShard:
         return 1 if epoch < 0 else self.n_hosts
 
     def _push(self, host_id: int, epoch: int, table: int,
-              keys: np.ndarray, rows: np.ndarray, dim: int) -> None:
+              keys: np.ndarray, rows: np.ndarray, dim: int,
+              chunk: Tuple[int, int] = (0, 1)) -> None:
+        chunk_idx, n_chunks = int(chunk[0]), int(chunk[1])
         arrival = None
+        spread = None
         with self._lock:
             # stamped INSIDE the lock: arrivals are ordered by the merge
             # order the round actually sees, so offsets behind t0 can
@@ -367,34 +467,74 @@ class SparseReduceShard:
                 # a retried push after the merge (its reply was lost):
                 # at-least-once delivery, the contribution already counted
                 return
-            fresh = host_id not in rd.contrib
-            rd.contrib[host_id] = (keys, rows)
-            # arrival timeline (REAL rounds, first delivery per host):
-            # offset behind the round's first push — the wait this host
-            # charged the round with.  Retried pushes re-land rows but
-            # must not double-count the arrival.
-            if epoch >= 0 and fresh:
+            total = rd.chunks_total.get(host_id)
+            if total is None:
+                rd.chunks_total[host_id] = total = n_chunks
+                rd.chunks_seen[host_id] = set()
+            elif total != n_chunks:
+                raise ValueError(
+                    f"host {host_id} chunk-count skew in round "
+                    f"({epoch}, {table}): declared {total}, now {n_chunks}"
+                )
+            seen = rd.chunks_seen[host_id]
+            if chunk_idx in seen:
+                # a duplicate retried chunk (its reply was lost): counted
+                # exactly once — the accumulator must never double-add
+                return
+            seen.add(chunk_idx)
+            if self.streaming:
+                rd.accumulate(keys, rows)
+            else:
+                rd.contrib.setdefault(host_id, []).append(
+                    (chunk_idx, keys, rows)
+                )
+            self._peak_round_bytes = max(
+                self._peak_round_bytes,
+                sum(r.nbytes() for r in self._rounds.values()),
+            )
+            # arrival timeline (REAL rounds, first delivery per chunk):
+            # the first-chunk offset behind the round's first push is the
+            # late-STARTER signal, the last-minus-first spread the slow-
+            # TRICKLER signal — two different straggler diagnoses.
+            if epoch >= 0:
                 if rd.t0 is None:
                     rd.t0 = now
-                arrival = now - rd.t0
-                rd.arrivals.append((host_id, arrival))
-                if len(rd.contrib) >= self.n_hosts:
+                off = now - rd.t0
+                if len(seen) == 1:
+                    arrival = off
+                    rd.first_off[host_id] = off
+                    rd.arrivals.append((host_id, off))
+                rd.last_off[host_id] = off
+                if len(seen) >= total:
+                    spread = off - rd.first_off[host_id]
+                if rd.hosts_done() >= self.n_hosts:
                     # round complete: freeze its timeline into the ring
                     self._arrivals.append({
                         "epoch": int(epoch), "table": int(table),
-                        "arrivals": {str(h): round(off, 6)
-                                     for h, off in rd.arrivals},
+                        "arrivals": {str(h): round(o, 6)
+                                     for h, o in rd.arrivals},
+                        "last": {str(h): round(o, 6)
+                                 for h, o in rd.last_off.items()},
+                        "chunks": {str(h): len(s)
+                                   for h, s in rd.chunks_seen.items()},
                         "wait_s": round(max(o for _, o in rd.arrivals), 6),
                     })
             self._gc_locked()
-        if arrival is not None and obs_gate.enabled():
-            self.registry.observe(
-                labeled("hier_round_wait_seconds", host=str(host_id)),
-                arrival,
-            )
+        if obs_gate.enabled():
+            if arrival is not None:
+                self.registry.observe(
+                    labeled("hier_round_wait_seconds", host=str(host_id)),
+                    arrival,
+                )
+            if spread is not None:
+                self.registry.observe(
+                    labeled("hier_round_chunk_spread_seconds",
+                            host=str(host_id)),
+                    spread,
+                )
 
     def _pull(self, host_id: int, epoch: int, table: int,
-              coded: bool = False):
+              coded: bool = False, bits: int = CODED_BITS):
         """One host's pull of a round.  Returns None while withheld;
         else the merged ``(uids, rows)`` — or, with ``coded``, the
         round's ``(ids_bytes, coded_section)`` wire bytes.  The coded
@@ -403,24 +543,43 @@ class SparseReduceShard:
         every host receives byte-identical codes — a GC racing between
         the lookup and the encode (a straggler host vs the epoch-lag
         reaper) can no longer re-encode through an already-advanced
-        carry."""
+        carry.  ``bits`` picks the code width of that ONE encode (the
+        q4_ef nibble wire asks for 4); the first pull's width wins and
+        the cached section self-describes, so a skewed puller still
+        decodes correctly."""
         bar = self._bar(epoch)
         with self._lock:
             rd = self._rounds.get((epoch, table))
             if rd is None or (rd.merged is None
-                              and len(rd.contrib) < bar):
+                              and rd.hosts_done() < bar):
                 self._counts["withheld"] += 1
                 return None
             if rd.merged is None:
-                # deterministic merge: host-id order, one segment sum
-                parts = [rd.contrib[h] for h in sorted(rd.contrib)]
-                keys = np.concatenate([p[0] for p in parts])
-                rows = np.concatenate([p[1] for p in parts])
-                uniq, inv = np.unique(keys, return_inverse=True)
-                merged = np.zeros((uniq.size, rd.dim), np.float32)
-                np.add.at(merged, inv.reshape(-1), rows)
-                rd.merged = (uniq, merged)
-                rd.contrib.clear()
+                if self.streaming:
+                    # the streaming accumulator IS the merge — chunks
+                    # already segment-summed off the wire as they arrived
+                    uniq = (rd.acc_keys if rd.acc_keys is not None
+                            else np.zeros(0, np.int64))
+                    merged = (rd.acc_rows if rd.acc_rows is not None
+                              else np.zeros((0, rd.dim), np.float32))
+                    rd.merged = (uniq, merged)
+                    rd.acc_keys = rd.acc_rows = None
+                else:
+                    # deterministic barrier merge: (host-id, chunk-idx)
+                    # order, one segment sum
+                    parts = [p for h in sorted(rd.contrib)
+                             for p in sorted(rd.contrib[h],
+                                             key=lambda q: q[0])]
+                    keys = (np.concatenate([p[1] for p in parts])
+                            if parts else np.zeros(0, np.int64))
+                    rows = (np.concatenate([p[2] for p in parts])
+                            if parts else np.zeros((0, rd.dim),
+                                                   np.float32))
+                    uniq, inv = np.unique(keys, return_inverse=True)
+                    merged = np.zeros((uniq.size, rd.dim), np.float32)
+                    np.add.at(merged, inv.reshape(-1), rows)
+                    rd.merged = (uniq, merged)
+                    rd.contrib.clear()
                 self._counts["rounds_merged"] += 1
             if coded and rd.coded_section is None:
                 uniq, merged = rd.merged
@@ -432,7 +591,7 @@ class SparseReduceShard:
                 carried = carry.get(uniq)
                 val = merged + carried
                 rd.coded_section, dec = wire.pack_codes_section(
-                    val, CODED_BITS
+                    val, bits
                 )
                 carry.set(uniq, val - dec)
                 rd.ids_bytes = wire.pack_ids(uniq)
@@ -457,6 +616,10 @@ class SparseReduceShard:
             out = dict(self._counts)
             out["rounds_open"] = len(self._rounds)
             out["n_hosts"] = self.n_hosts
+            out["streaming"] = self.streaming
+            # high-water mark of live round payload bytes: the bench's
+            # flat-as-n_hosts-doubles shard peak-memory column
+            out["peak_round_bytes"] = int(self._peak_round_bytes)
             # undelivered owner-side EF mass per table: with the dynamic
             # per-round range this stays sub-bucket noise (tested) — a
             # growing number here means the codec is eating gradient
@@ -487,14 +650,16 @@ class SparseReduceShard:
                 used + used2 + used3)
 
     def _group_push(self, host_id: int, epoch: int, flags: int,
-                    buf: bytes) -> None:
+                    buf: bytes, chunk: Tuple[int, int] = (0, 1)) -> None:
         """One grouped push: a shared tagged id stream + per-table value
         sections — the ids of a (host, field group) ride the wire ONCE
         and land as one contribution per table's round.  The WHOLE frame
         decodes and validates (sections, trailing bytes) BEFORE the
         first round mutates, matching the single-frame path's
         reject-loudly-never-half-parse invariant — a malformed frame
-        must not count its host toward any round's bar."""
+        must not count its host toward any round's bar.  A chunked group
+        frame lands the SAME chunk window in every listed table's round
+        (the group shares one id stream, so it shares one chunking)."""
         tables, dims, pos = self._split_group_header(buf)
         keys, used = wire.split_ids(buf[pos:])
         pos += used
@@ -511,7 +676,7 @@ class SparseReduceShard:
                 f"{len(buf)} bytes"
             )
         for table, dim, rows in sections:
-            self._push(host_id, epoch, table, keys, rows, dim)
+            self._push(host_id, epoch, table, keys, rows, dim, chunk=chunk)
 
     def _group_pull_reply(self, host_id: int, epoch: int, flags: int,
                           buf: bytes) -> Optional[bytes]:
@@ -523,9 +688,10 @@ class SparseReduceShard:
         reply ships the union ONCE with per-table value sections."""
         tables, dims, _ = self._split_group_header(buf)
         coded = bool(flags & FLAG_CODED)
+        bits = NIBBLE_BITS if flags & FLAG_NIBBLE else CODED_BITS
         outs = []
         for table in tables:
-            out = self._pull(host_id, epoch, table, coded=coded)
+            out = self._pull(host_id, epoch, table, coded=coded, bits=bits)
             if out is None:
                 return None
             outs.append(out)
@@ -596,12 +762,17 @@ class SparseReduceShard:
                             host_id, epoch, table, dim, flags = (
                                 int(x) for x in hdr
                             )
+                            body = payload[used:]
+                            chunk = (0, 1)
+                            if flags & FLAG_CHUNK:
+                                chunk, used2 = wire.split_chunk_header(body)
+                                body = body[used2:]
                             if flags & FLAG_GROUP:
                                 self._group_push(host_id, epoch, flags,
-                                                 payload[used:])
+                                                 body, chunk=chunk)
                             else:
                                 keys, rows = _decode_payload(
-                                    payload[used:], dim, flags
+                                    body, dim, flags
                                 )
                                 if len(keys) > 1 and not \
                                         (np.diff(keys) > 0).all():
@@ -610,7 +781,7 @@ class SparseReduceShard:
                                         "unique"
                                     )
                                 self._push(host_id, epoch, table, keys,
-                                           rows, dim)
+                                           rows, dim, chunk=chunk)
                             conn.sendall(struct.pack("<IB", 1, 0) + b"\x00")
                             sent = 6
                         elif msg_type == MSG_PULL:
@@ -624,8 +795,11 @@ class SparseReduceShard:
                                 )
                             else:
                                 coded = bool(flags & FLAG_CODED)
+                                bits = (NIBBLE_BITS
+                                        if flags & FLAG_NIBBLE
+                                        else CODED_BITS)
                                 out = self._pull(host_id, epoch, table,
-                                                 coded=coded)
+                                                 coded=coded, bits=bits)
                                 if out is None:
                                     body = None
                                 elif coded:
@@ -716,12 +890,29 @@ class HierExchangeClient:
 
     ``codec``: ``"f32"`` (default — exact, the dense-psum-exact branch
     contract), ``"f16"`` (the PS hot-path ``pack_rows`` frame, half the
-    value bytes), or ``"q8_ef"`` (the quantile-coded error-feedback wire:
+    value bytes), ``"q8_ef"`` (the quantile-coded error-feedback wire:
     1-byte codes over a per-frame dynamic range, a member-side sparse EF
     carry per table on the push side and the shard's owner-side carry on
-    pulls — module docstring).  ``pull_timeout_s`` bounds the
-    withheld-retry loop — a peer host that died mid-step must surface as
-    an error, not a hang.
+    pulls — module docstring), or ``"q4_ef"`` (the same EF recipe at
+    4-bit nibble codes, two per byte — PR 15's kernel-layer packing on
+    the socket wire; coarser buckets, more carried residual, half the
+    code bytes).  ``pull_timeout_s`` bounds the withheld-retry loop — a
+    peer host that died mid-step must surface as an error, not a hang.
+
+    Streaming dispatch (ISSUE 16): ``chunk_rows=R`` windows every shard
+    partition into ceil(n/R)-chunk pushes so the shard can segment-merge
+    off the wire, and transmissions ride ONE single-thread FIFO executor
+    per shard — different stripes transmit concurrently (aggregate DCN
+    bandwidth scales with shard count) while each shard's socket stays
+    strictly ordered (``PSClient`` is not thread-safe).  ``push_async``
+    returns once every frame is HANDED to its stripe pipeline;
+    ``commit`` joins the in-flight transmissions and re-raises the first
+    failure — the dispatch/commit ticket contract of the tiered
+    embedding (PR 15), here overlapping the trainer's compute with the
+    DCN push.  ``pull``/``pull_group`` commit defensively, so a pull can
+    never overtake this host's own pushes on a stripe.
+    ``chunk_rows=None`` (default) ships the legacy single frame per
+    shard, byte-identical to the PR 10/13 wire.
     """
 
     #: withheld-pull backoff: start fast (the peer host is usually mid
@@ -732,11 +923,14 @@ class HierExchangeClient:
     def __init__(self, addresses, host_id: int, n_hosts: int,
                  codec: str = "f32", pull_timeout_s: float = 120.0,
                  timeout: Optional[float] = None,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 chunk_rows: Optional[int] = None):
         if not addresses:
             raise ValueError("need at least one reduce shard address")
-        if codec not in ("f32", "f16", "q8_ef"):
+        if codec not in ("f32", "f16", "q8_ef", "q4_ef"):
             raise ValueError(f"unknown wire codec {codec!r}")
+        if chunk_rows is not None and int(chunk_rows) < 1:
+            raise ValueError("chunk_rows must be >= 1 (or None)")
         # per-round client latency telemetry (HIER_ROUND_SERIES): defaults
         # to the process registry like the trainers
         self.registry = registry if registry is not None else \
@@ -750,7 +944,21 @@ class HierExchangeClient:
         self.host_id = int(host_id)
         self.n_hosts = int(n_hosts)
         self.codec = codec
+        self._coded_bits = NIBBLE_BITS if codec == "q4_ef" else CODED_BITS
+        self.chunk_rows = None if chunk_rows is None else int(chunk_rows)
         self.pull_timeout_s = float(pull_timeout_s)
+        # streaming-dispatch machinery (class docstring): one lazily
+        # created single-thread FIFO executor per shard, plus the
+        # in-flight frame futures `commit` joins
+        self._pools: List[Optional[ThreadPoolExecutor]] = \
+            [None] * self.n_shards
+        self._inflight: List = []
+        self._inflight_lock = threading.Lock()
+        # chunk-fill accounting (rows shipped vs rows the dispatched
+        # windows could hold) — the trainer's chunk telemetry reads these
+        self.chunk_pushes_total = 0
+        self.chunk_rows_total = 0
+        self.chunk_capacity_rows_total = 0
         # member-side EF carries, one sparse table-keyed carry per table
         # (q8_ef only): last step's quantization error re-enters this
         # step's encode, so coded mass is delivered late, never lost
@@ -805,6 +1013,11 @@ class HierExchangeClient:
             flags = FLAG_F32
         elif self.codec == "q8_ef":
             flags = FLAG_CODED
+        elif self.codec == "q4_ef":
+            # the NIBBLE bit asks the owner-side pull encode for 4-bit
+            # codes; push sections self-describe their width, and an old
+            # shard fails loud on the halved code stream (tested)
+            flags = FLAG_CODED | FLAG_NIBBLE
         else:
             flags = 0
         return flags | (FLAG_GROUP if group else 0)
@@ -828,20 +1041,89 @@ class HierExchangeClient:
         per-partition encodes share one table-keyed carry safely)."""
         carry = self._carry_for(table, rows.shape[1])
         val = rows + carry.get(uids)
-        body, dec = wire.pack_rows_coded(uids, val, CODED_BITS)
+        body, dec = wire.pack_rows_coded(uids, val, self._coded_bits)
         carry.set(uids, val - dec)
         return body
 
-    def push(self, table: int, uids: np.ndarray, rows: np.ndarray,
-             epoch: int, exact: bool = False) -> None:
-        """Ship this host's merged (sorted-unique uids [n], rows [n, dim])
-        contribution for round ``(epoch, table)``, owner-partitioned
-        across the shards.  Every shard receives a frame (possibly empty —
-        the round bar counts HOSTS, so a host whose batch touched no ids
-        owned by a shard must still check in there).  ``exact=True``
-        forces the fp32 frame regardless of codec (the dense+loss
-        pseudo-table: the loss readout must not wobble with the wire
-        codec)."""
+    # -- streaming dispatch (ISSUE 16) --------------------------------------
+
+    def _pool(self, s: int) -> ThreadPoolExecutor:
+        pool = self._pools[s]
+        if pool is None:
+            pool = self._pools[s] = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"hier-stripe-{s}"
+            )
+        return pool
+
+    def _dispatch(self, s: int, frame: bytes, what: str,
+                  span_attrs: Dict) -> None:
+        """Hand one push frame to shard ``s``'s FIFO pipeline.  The
+        worker transmits and checks the ack; `commit` re-raises its
+        failure.  Stripe byte accounting lands HERE, on the caller
+        thread at dispatch, so scrapes never race the workers."""
+        if obs_gate.enabled():
+            self.registry.inc(
+                labeled("hier_stripe_push_bytes_total", stripe=str(s)),
+                len(frame),
+            )
+        client = self.clients[s]
+
+        def _send():
+            with obs_trace.span("hier_client/push_chunk",
+                                host=self.host_id, **span_attrs):
+                reply = client._rpc(MSG_PUSH, frame)
+            if reply != b"\x00":
+                raise ConnectionError(f"reduce shard {s} refused {what}")
+
+        fut = self._pool(s).submit(_send)
+        with self._inflight_lock:
+            self._inflight.append(fut)
+
+    def commit(self) -> None:
+        """Join every dispatched push frame — the commit half of the
+        overlap ticket (class docstring): block until the in-flight
+        transmissions drain, re-raising the first failure.  Idempotent
+        and cheap when nothing is in flight."""
+        with self._inflight_lock:
+            pending, self._inflight = self._inflight, []
+        err = None
+        for fut in pending:
+            try:
+                fut.result()
+            except BaseException as e:
+                if err is None:
+                    err = e
+        if err is not None:
+            raise err
+
+    def _chunk_windows(self, n: int) -> Optional[List[Tuple[int, int]]]:
+        """Row windows ``[(lo, hi)]`` of one n-row shard partition, or
+        None for the legacy unchunked single frame (chunk_rows unset).
+        An empty partition still yields one (empty) window — the round
+        bar counts hosts, so every shard hears from every host."""
+        if self.chunk_rows is None:
+            return None
+        step = self.chunk_rows
+        n_chunks = max(1, -(-n // step))
+        return [(i * step, min(n, (i + 1) * step))
+                for i in range(n_chunks)]
+
+    def push_async(self, table: int, uids: np.ndarray, rows: np.ndarray,
+                   epoch: int, exact: bool = False) -> None:
+        """Dispatch this host's merged (sorted-unique uids [n], rows
+        [n, dim]) contribution for round ``(epoch, table)``,
+        owner-partitioned across the shards and windowed into
+        ``chunk_rows``-row chunks.  Returns once every frame is handed
+        to its stripe pipeline — overlap compute here, `commit` before
+        the round's pull.  Every shard receives at least one frame
+        (possibly empty — the round bar counts HOSTS, so a host whose
+        batch touched no ids owned by a shard must still check in
+        there).  Encoding — including the member EF carry advance —
+        happens on the CALLER thread in shard-then-chunk order, so
+        carries stay deterministic no matter how stripe transmissions
+        interleave.  ``exact=True`` forces the fp32 frame regardless of
+        codec (the dense+loss pseudo-table: the loss readout must not
+        wobble with the wire codec)."""
         uids = np.ascontiguousarray(uids, np.int64)
         rows = np.asarray(rows, np.float32)
         if rows.ndim != 2 or rows.shape[0] != len(uids):
@@ -853,32 +1135,56 @@ class HierExchangeClient:
         if len(uids) > 1 and not (np.diff(uids) > 0).all():
             raise ValueError("hier push uids must be sorted unique")
         flags = self._flags(exact)
+        chunked = self.chunk_rows is not None
+        if chunked:
+            flags |= FLAG_CHUNK
         hdr = self._hdr(epoch, table, dim, flags)
         shard = self._shard_of(uids)
         self._note_push((epoch, int(table)))
         with obs_trace.span("hier_client/push", n_keys=int(uids.size),
                             table=table, epoch=epoch, host=self.host_id):
-            for s, c in enumerate(self.clients):
+            for s in range(self.n_shards):
                 idx = np.flatnonzero(shard == s)
-                if flags & FLAG_CODED:
-                    body = self._coded_body(table, uids[idx], rows[idx])
-                else:
-                    body = _encode_payload(uids[idx], rows[idx], flags)
-                reply = c._rpc(MSG_PUSH, hdr + body)
-                if reply != b"\x00":
-                    raise ConnectionError(
-                        f"reduce shard {s} refused push for round "
-                        f"({epoch}, {table})"
+                su, sr = uids[idx], rows[idx]
+                wins = self._chunk_windows(len(su)) or [(0, len(su))]
+                for ci, (lo, hi) in enumerate(wins):
+                    if flags & FLAG_CODED:
+                        body = self._coded_body(table, su[lo:hi],
+                                                sr[lo:hi])
+                    else:
+                        body = _encode_payload(su[lo:hi], sr[lo:hi],
+                                               flags)
+                    prefix = (wire.pack_chunk_header(ci, len(wins))
+                              if chunked else b"")
+                    self.chunk_pushes_total += 1
+                    self.chunk_rows_total += hi - lo
+                    self.chunk_capacity_rows_total += (
+                        self.chunk_rows if chunked else hi - lo
+                    )
+                    self._dispatch(
+                        s, hdr + prefix + body,
+                        f"push for round ({epoch}, {table})",
+                        {"table": table, "epoch": epoch, "chunk": ci,
+                         "n_keys": hi - lo},
                     )
 
-    def push_group(self, tables, uids: np.ndarray, rows_list,
-                   epoch: int) -> None:
-        """Grouped push for tables sharing ONE id stream (the same batch-
-        field tuple): the tagged id section rides each shard frame once
-        and every table contributes a value section referencing it by
-        position — the wire twin of the in-jit shared streams (PR 5).
-        ``rows_list[i]`` is table ``tables[i]``'s [n, dim_i] rows over the
-        SHARED sorted-unique ``uids``."""
+    def push(self, table: int, uids: np.ndarray, rows: np.ndarray,
+             epoch: int, exact: bool = False) -> None:
+        """Synchronous push: `push_async` + `commit` — ship and confirm
+        this host's contribution before returning (the PR 10 call
+        shape)."""
+        self.push_async(table, uids, rows, epoch, exact=exact)
+        self.commit()
+
+    def push_group_async(self, tables, uids: np.ndarray, rows_list,
+                         epoch: int) -> None:
+        """Grouped dispatch for tables sharing ONE id stream (the same
+        batch-field tuple): the tagged id section rides each chunk frame
+        once and every table contributes a value section referencing it
+        by position — the wire twin of the in-jit shared streams (PR 5).
+        ``rows_list[i]`` is table ``tables[i]``'s [n, dim_i] rows over
+        the SHARED sorted-unique ``uids``.  A chunk windows the shared
+        ids, so it lands the same window in every listed table's round."""
         tables = [int(t) for t in tables]
         uids = np.ascontiguousarray(uids, np.int64)
         rows_list = [np.asarray(r, np.float32) for r in rows_list]
@@ -894,6 +1200,9 @@ class HierExchangeClient:
             raise ValueError("hier push uids must be sorted unique")
         dims = [r.shape[1] for r in rows_list]
         flags = self._flags(group=True)
+        chunked = self.chunk_rows is not None
+        if chunked:
+            flags |= FLAG_CHUNK
         hdr = self._hdr(epoch, tables[0], dims[0], flags)
         g_hdr = (wire.pack_varint(np.array([len(tables)], np.int64))
                  + wire.pack_varint(np.array(tables, np.int64))
@@ -903,31 +1212,52 @@ class HierExchangeClient:
         with obs_trace.span("hier_client/push_group", n_keys=int(uids.size),
                             tables=len(tables), table=tables[0],
                             epoch=epoch, host=self.host_id):
-            for s, c in enumerate(self.clients):
+            for s in range(self.n_shards):
                 idx = np.flatnonzero(shard == s)
                 su = uids[idx]
-                ids_sec = wire.pack_ids(su)
-                self.shared_id_saved_bytes += \
-                    (len(tables) - 1) * len(ids_sec)
-                parts = [g_hdr, ids_sec]
-                for t, r in zip(tables, rows_list):
-                    sr = r[idx]
-                    if flags & FLAG_CODED:
-                        carry = self._carry_for(t, sr.shape[1])
-                        val = sr + carry.get(su)
-                        sec, dec = wire.pack_codes_section(val, CODED_BITS)
-                        carry.set(su, val - dec)
-                    elif flags & FLAG_F32:
-                        sec = np.ascontiguousarray(sr, np.float32).tobytes()
-                    else:
-                        sec = wire.pack_values(sr)[0]
-                    parts.append(sec)
-                reply = c._rpc(MSG_PUSH, hdr + b"".join(parts))
-                if reply != b"\x00":
-                    raise ConnectionError(
-                        f"reduce shard {s} refused group push for epoch "
-                        f"{epoch} tables {tables}"
+                srs = [r[idx] for r in rows_list]
+                wins = self._chunk_windows(len(su)) or [(0, len(su))]
+                for ci, (lo, hi) in enumerate(wins):
+                    cu = su[lo:hi]
+                    ids_sec = wire.pack_ids(cu)
+                    self.shared_id_saved_bytes += \
+                        (len(tables) - 1) * len(ids_sec)
+                    parts = [g_hdr, ids_sec]
+                    for t, r in zip(tables, srs):
+                        cr = r[lo:hi]
+                        if flags & FLAG_CODED:
+                            carry = self._carry_for(t, cr.shape[1])
+                            val = cr + carry.get(cu)
+                            sec, dec = wire.pack_codes_section(
+                                val, self._coded_bits
+                            )
+                            carry.set(cu, val - dec)
+                        elif flags & FLAG_F32:
+                            sec = np.ascontiguousarray(
+                                cr, np.float32
+                            ).tobytes()
+                        else:
+                            sec = wire.pack_values(cr)[0]
+                        parts.append(sec)
+                    prefix = (wire.pack_chunk_header(ci, len(wins))
+                              if chunked else b"")
+                    self.chunk_pushes_total += 1
+                    self.chunk_rows_total += hi - lo
+                    self.chunk_capacity_rows_total += (
+                        self.chunk_rows if chunked else hi - lo
                     )
+                    self._dispatch(
+                        s, hdr + prefix + b"".join(parts),
+                        f"group push for epoch {epoch} tables {tables}",
+                        {"table": tables[0], "tables": len(tables),
+                         "epoch": epoch, "chunk": ci, "n_keys": hi - lo},
+                    )
+
+    def push_group(self, tables, uids: np.ndarray, rows_list,
+                   epoch: int) -> None:
+        """Synchronous grouped push: `push_group_async` + `commit`."""
+        self.push_group_async(tables, uids, rows_list, epoch)
+        self.commit()
 
     def _pull_one(self, c, s: int, hdr: bytes, what: str):
         """One shard's pull with the withheld-retry loop -> reply body."""
@@ -939,6 +1269,12 @@ class HierExchangeClient:
             # here); only the WITHHELD byte b"\x01" loops
             reply = c._rpc(MSG_PULL, hdr)
             if reply[:1] == b"\x00":
+                if obs_gate.enabled():
+                    self.registry.inc(
+                        labeled("hier_stripe_pull_bytes_total",
+                                stripe=str(s)),
+                        len(reply) - 1,
+                    )
                 return reply[1:]
             if obs_gate.enabled():
                 self.registry.inc("hier_round_withheld_retries_total")
@@ -965,14 +1301,24 @@ class HierExchangeClient:
         """Fetch round ``(epoch, table)``'s cross-host merge: per shard,
         retry withheld replies with capped backoff until the round
         completes, then splice the shard unions into one globally sorted
-        (uids [U], rows [U, dim]) pair."""
+        (uids [U], rows [U, dim]) pair.  Commits first: a pull must never
+        overtake this host's own dispatched pushes on a stripe.  The
+        per-shard pulls ride the stripe pipelines CONCURRENTLY — the
+        aggregate DCN bandwidth of the striped topology applies to the
+        pull leg exactly as to the push leg (each shard is its own
+        link), and the per-stripe FIFO keeps each shard's connection
+        single-threaded."""
+        self.commit()
         flags = self._flags(exact)
         hdr = self._hdr(epoch, table, dim, flags)
         keys_parts, rows_parts = [], []
         with obs_trace.span("hier_client/pull", table=table, epoch=epoch,
                             host=self.host_id):
-            for s, c in enumerate(self.clients):
-                body = self._pull_one(c, s, hdr, f"({epoch}, {table})")
+            futs = [self._pool(s).submit(self._pull_one, c, s, hdr,
+                                         f"({epoch}, {table})")
+                    for s, c in enumerate(self.clients)]
+            for fut in futs:
+                body = fut.result()
                 k, r = _decode_payload(body, dim, flags)
                 keys_parts.append(k)
                 rows_parts.append(r)
@@ -985,7 +1331,10 @@ class HierExchangeClient:
         """Grouped pull: one request per shard fetches every listed
         table's merged round behind ONE shared id stream -> (globally
         sorted union uids [U], [rows_i [U, dim_i] per table]).  The
-        shard withholds until ALL the group's rounds complete."""
+        shard withholds until ALL the group's rounds complete.  Commits
+        first and rides the stripe pipelines concurrently, like
+        `pull`."""
+        self.commit()
         tables = [int(t) for t in tables]
         dims = [int(d) for d in dims]
         flags = self._flags(group=True)
@@ -998,9 +1347,11 @@ class HierExchangeClient:
         with obs_trace.span("hier_client/pull_group", tables=len(tables),
                             table=tables[0], epoch=epoch,
                             host=self.host_id):
-            for s, c in enumerate(self.clients):
-                body = self._pull_one(c, s, hdr + req,
-                                      f"({epoch}, group {tables})")
+            futs = [self._pool(s).submit(self._pull_one, c, s, hdr + req,
+                                         f"({epoch}, group {tables})")
+                    for s, c in enumerate(self.clients)]
+            for fut in futs:
+                body = fut.result()
                 keys, pos = wire.split_ids(body)
                 self.shared_id_saved_bytes += (len(tables) - 1) * pos
                 keys_parts.append(keys)
@@ -1043,6 +1394,7 @@ class HierExchangeClient:
         Probe rounds ride NEGATIVE epochs, which the shard completes at a
         single contribution — the probe needs no peer hosts (each host's
         probe epochs are disjoint, so concurrent probes cannot collide)."""
+        self.commit()  # the probe talks to shard 0 directly: drain first
         dim = 64
         n = max(1, payload_bytes // (4 * dim))
         uids = np.arange(1, n + 1, dtype=np.int64) * self.n_shards  # shard 0
@@ -1070,12 +1422,16 @@ class HierExchangeClient:
         return moved / max(float(np.median(ts)), 1e-9)
 
     def stats(self) -> List[Dict]:
+        self.commit()  # stats share the shard sockets: drain first
         out = []
         for c in self.clients:
             out.append(json.loads(c._rpc(MSG_STATS, b"").decode()))
         return out
 
     def close(self) -> None:
+        for pool in self._pools:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
         for c in self.clients:
             try:
                 c.close()

@@ -218,6 +218,15 @@ def unpack_rows(buf: bytes, dim: int) -> Tuple[np.ndarray, np.ndarray, int]:
 #: first byte of every coded rows frame / grouped section stream
 CODED_MAGIC = 0xC3
 
+#: first byte of every CHUNKED push payload (the streaming rendezvous,
+#: ISSUE 16): a frame whose header flags carry the chunk bit prefixes its
+#: payload with ``CHUNK_MAGIC ++ varint [chunk_idx, n_chunks]``.  The magic
+#: is checked before any decode, so a chunked frame reaching an old reader
+#: (which would try to parse the payload body directly) fails LOUD on the
+#: magic-led varint garbage / row-count mismatch, never half-parses — the
+#: same tagged-frame discipline as :data:`CODED_MAGIC`.
+CHUNK_MAGIC = 0xC5
+
 #: id-section tags
 ID_DELTA = 0    # pack_keys: n varint + zigzag delta varints
 ID_BITMAP = 1   # varint [n, base, span] + ceil(span/8) bitmap bytes (LSB0)
@@ -292,14 +301,47 @@ def split_ids(buf: bytes) -> Tuple[np.ndarray, int]:
     raise ValueError(f"unknown id-section tag {tag:#x}")
 
 
+def _nibble_pack(codes: np.ndarray) -> bytes:
+    """4-bit codes -> two per byte, little-nibble order (the EVEN element
+    is the LOW nibble) — the host-numpy twin of
+    ``ops.quantize.pack_nibbles``, so a kernel-packed stream and a
+    host-packed stream are byte-identical.  An odd count pads one zero
+    code that :func:`_nibble_unpack` slices back off."""
+    c = np.ascontiguousarray(codes, np.uint8).reshape(-1)
+    if c.size % 2:
+        c = np.concatenate([c, np.zeros(1, np.uint8)])
+    pairs = c.reshape(-1, 2)
+    return (pairs[:, 0] | (pairs[:, 1] << 4)).astype(np.uint8).tobytes()
+
+
+def _nibble_unpack(buf: bytes, n: int) -> np.ndarray:
+    """Inverse of :func:`_nibble_pack`: ``n`` 4-bit codes (uint8 0..15)."""
+    p = np.frombuffer(buf, np.uint8)
+    lo = p & np.uint8(0x0F)
+    hi = (p >> 4) & np.uint8(0x0F)
+    return np.stack([lo, hi], axis=1).reshape(-1)[:n]
+
+
+def _codes_section_bytes(n_vals: int, bits: int) -> int:
+    """Code-stream bytes of a value section: 1 byte per code above 4 bits,
+    BIT-PACKED two per byte at <= 4 (``_wire_row_bytes``'s pricing, now a
+    wire form the section actually ships)."""
+    return (n_vals + 1) // 2 if int(bits) <= 4 else n_vals
+
+
 def pack_codes_section(vals: np.ndarray, bits: int = 8
                        ) -> Tuple[bytes, np.ndarray]:
     """Quantile-code one [n, dim] fp32 payload -> (section bytes, decoded
-    view).  Section: ``u8 bits ++ f32 range ++ n*dim u8 codes``.  The
-    decoded view is what every receiver will reconstruct — the caller's
-    error-feedback carry is ``vals - decoded`` (dist/hier.py).  Range is
-    dynamic per payload (max |val| with headroom + floor), so the encode
-    never clips and the EF carry stays sub-bucket."""
+    view).  Section: ``u8 bits ++ f32 range ++ codes`` — one byte per code
+    for 5..8-bit tables, NIBBLE-PACKED two per byte for <= 4 bits (the
+    ``q4_ef`` wire, ISSUE 16: the kernel layer's ``pack_nibbles`` order,
+    byte-identical on host and device).  The decoded view is what every
+    receiver will reconstruct — the caller's error-feedback carry is
+    ``vals - decoded`` (dist/hier.py).  Range is dynamic per payload (max
+    |val| with headroom + floor), so the encode never clips and the EF
+    carry stays sub-bucket.  A nibble-packed section reaching a reader
+    that predates it fails LOUD on the code-stream length check (half the
+    bytes it expects), never misparses — tested in test_wire_codec.py."""
     if not (1 <= int(bits) <= 8):
         raise ValueError(f"coded wire sections carry <=8-bit codes, "
                          f"got {bits}")
@@ -310,15 +352,16 @@ def pack_codes_section(vals: np.ndarray, bits: int = 8
     boundaries, values = coded_table(rng, bits)
     codes = np.searchsorted(boundaries, v.reshape(-1),
                             side="left").astype(np.uint8)
-    body = (bytes([int(bits)]) + np.float32(rng).tobytes()
-            + codes.tobytes())
+    stream = (_nibble_pack(codes) if int(bits) <= 4 else codes.tobytes())
+    body = bytes([int(bits)]) + np.float32(rng).tobytes() + stream
     return body, values[codes].reshape(v.shape).astype(np.float32)
 
 
 def unpack_codes_section(buf: bytes, n: int, dim: int
                          ) -> Tuple[np.ndarray, int]:
     """Inverse of :func:`pack_codes_section` -> ([n, dim] fp32 rows, bytes
-    consumed)."""
+    consumed).  Dispatches on the section's own ``bits`` byte: <= 4 reads
+    the nibble-packed stream, 5..8 the one-byte codes."""
     if len(buf) < 5:
         raise ValueError("truncated coded value section")
     bits = buf[0]
@@ -327,14 +370,23 @@ def unpack_codes_section(buf: bytes, n: int, dim: int
     rng = float(np.frombuffer(buf[1:5], np.float32)[0])
     if not np.isfinite(rng) or rng <= 0:
         raise ValueError(f"coded section range {rng} is not positive finite")
-    need = int(n) * int(dim)
+    n_vals = int(n) * int(dim)
+    need = _codes_section_bytes(n_vals, bits)
     body = buf[5:5 + need]
     if len(body) != need:
         raise ValueError(
-            f"coded section carries {len(body)} codes for {need} values"
+            f"coded section carries {len(body)} code bytes for "
+            f"{n_vals} {bits}-bit values (needs {need})"
         )
     _, values = coded_table(rng, bits)
-    codes = np.frombuffer(body, np.uint8)
+    if bits <= 4:
+        codes = _nibble_unpack(body, n_vals)
+        if codes.size and int(codes.max()) >= values.size:
+            raise ValueError(
+                f"coded section carries codes beyond the {bits}-bit table"
+            )
+    else:
+        codes = np.frombuffer(body, np.uint8)
     return values[codes].reshape(int(n), int(dim)).copy(), 5 + need
 
 
@@ -369,6 +421,38 @@ def unpack_rows_coded(buf: bytes, dim: int
     uids, used = split_ids(buf[1:])
     rows, used2 = unpack_codes_section(buf[1 + used:], uids.size, dim)
     return uids, rows, 1 + used + used2
+
+
+# -- chunked push framing (the streaming rendezvous, ISSUE 16) ---------------
+
+
+def pack_chunk_header(chunk_idx: int, n_chunks: int) -> bytes:
+    """Chunk header for one window of a chunked rendezvous push:
+    ``CHUNK_MAGIC ++ varint [chunk_idx, n_chunks]``.  ``n_chunks`` is the
+    host's declared chunk count for the round — every chunk of one
+    (host, round) must declare the same total, which is how the shard
+    knows when the host's contribution is complete without a separate
+    end-of-stream frame (and a lost/retried chunk stays idempotent: the
+    shard dedups on ``chunk_idx``)."""
+    ci, nc = int(chunk_idx), int(n_chunks)
+    if nc < 1 or not 0 <= ci < nc:
+        raise ValueError(f"chunk {ci} of {nc} is not a valid window")
+    return bytes([CHUNK_MAGIC]) + pack_varint(np.array([ci, nc], np.int64))
+
+
+def split_chunk_header(buf: bytes) -> Tuple[Tuple[int, int], int]:
+    """Decode a :func:`pack_chunk_header` -> ((chunk_idx, n_chunks), bytes
+    consumed).  Rejects loudly on a missing magic or an out-of-window
+    index — a chunked frame must never half-parse."""
+    if not buf or buf[0] != CHUNK_MAGIC:
+        raise ValueError(
+            "not a chunked push payload (bad chunk magic — old peer?)"
+        )
+    hdr, used = split_varint(buf[1:], 2)
+    ci, nc = int(hdr[0]), int(hdr[1])
+    if nc < 1 or not 0 <= ci < nc:
+        raise ValueError(f"chunk header claims chunk {ci} of {nc}")
+    return (ci, nc), 1 + used
 
 
 # -- prediction frames (serving plane, lightctr_tpu/serve) -------------------

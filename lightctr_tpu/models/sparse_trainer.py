@@ -159,6 +159,14 @@ EXCHANGE_SERIES = (
     "trainer_hier_wire_fp32_bytes_total",     # fp32 equiv of same payload
     "trainer_hier_wire_id_saved_bytes_total",  # shared-stream id savings
     "trainer_hier_wire_ef_mass",         # gauge: member EF residual mass
+    # streaming rendezvous (ISSUE 16): chunked dispatch + compute/push
+    # overlap — chunk fill is rows/capacity, overlap ratio is
+    # 1 - blocked/push (metrics_report --exchange derives both)
+    "trainer_hier_chunk_pushes_total",    # chunk frames dispatched
+    "trainer_hier_chunk_rows_total",      # rows those chunks carried
+    "trainer_hier_chunk_capacity_rows_total",  # rows the windows could hold
+    "trainer_hier_overlap_push_seconds_total",   # dispatch->commit wall
+    "trainer_hier_overlap_blocked_seconds_total",  # of which commit blocked
     "trainer_rs_fallback_total",
     "trainer_rs_overflow_total",
     # tiered device fast path (TieredDeviceEmbedding, ISSUE 15)
@@ -260,8 +268,8 @@ class SparseTableCTRTrainer(CTRTrainer):
             if compress_bits is not None:
                 raise ValueError(
                     "hier_exchange owns its wire codec via the "
-                    "HierExchangeClient knob (codec='f16'/'q8_ef'); "
-                    "compress_bits must stay None"
+                    "HierExchangeClient knob (codec='f16'/'q8_ef'/"
+                    "'q4_ef'); compress_bits must stay None"
                 )
             self._hybrid_dp = False
         # {table: "sparse" | "sparse_rs" | "dense"} — the three-way
@@ -311,6 +319,14 @@ class SparseTableCTRTrainer(CTRTrainer):
         self._hier_wire_packed_bytes = 0
         self._hier_wire_fp32_bytes = 0
         self._hier_wire_id_saved = 0
+        # streaming-rendezvous overlap numbers (ISSUE 16): per-step chunk
+        # dispatch counts (deltas of the client's counters) and the
+        # dispatch->commit wall split into total vs commit-blocked seconds
+        self._hier_chunk_pushes = 0
+        self._hier_chunk_rows = 0
+        self._hier_chunk_capacity = 0
+        self._hier_push_seconds = 0.0
+        self._hier_blocked_seconds = 0.0
         self._hier_local_j = None
         self._hier_local_ag_j = None
         self._hier_apply_j = None
@@ -1085,10 +1101,13 @@ class SparseTableCTRTrainer(CTRTrainer):
         fp32 whatever the codec (the loss readout must not wobble)."""
         from lightctr_tpu.dist.collectives import hier_wire_bytes
 
+        import time as _time
+
         client = self._hier_client
         n_local = self.mesh.shape["data"]
         total = n_local * client.n_hosts
-        wire_bits = {"f32": None, "f16": 16, "q8_ef": 8}[client.codec]
+        wire_bits = {"f32": None, "f16": 16, "q8_ef": 8,
+                     "q4_ef": 4}[client.codec]
         epoch = self._hier_epoch
         self._hier_epoch += 1
 
@@ -1111,6 +1130,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         groups = self._field_groups(self._spec)
         sock0 = client.bytes_sent + client.bytes_received
         saved0 = client.shared_id_saved_bytes
+        chunk0 = (client.chunk_pushes_total, client.chunk_rows_total,
+                  client.chunk_capacity_rows_total)
         fp32_equiv = 0
         sw = self.stepwatch
         if sw is not None:
@@ -1119,6 +1140,13 @@ class SparseTableCTRTrainer(CTRTrainer):
             sw.mark("exchange")
         with annotate("sparse_tables/hier_wire", tables=len(self._spec),
                       epoch=epoch):
+            # dispatch/commit overlap (ISSUE 16): every group's chunked
+            # push is DISPATCHED to its stripe pipelines as its arrays
+            # materialize — group k's frames transmit while group k+1's
+            # device outputs force and strip on this thread — and one
+            # commit joins them right before the first pull.  The commit
+            # wall is the push time the overlap did NOT hide.
+            t_dispatch0 = _time.perf_counter()
             pushed = []
             for fields, keys in groups.items():
                 # one pad-strip/sort per GROUP (the stream's union is
@@ -1133,15 +1161,20 @@ class SparseTableCTRTrainer(CTRTrainer):
                 tids = [table_id[k] for k in keys]
                 dims = [r.shape[1] for r in rows_g]
                 if len(keys) == 1:
-                    client.push(tids[0], su, rows_g[0], epoch)
+                    client.push_async(tids[0], su, rows_g[0], epoch)
                 else:
-                    client.push_group(tids, su, rows_g, epoch)
+                    client.push_group_async(tids, su, rows_g, epoch)
                 pushed.append((keys, tids, dims, len(su)))
             # dense leaves + loss: positions as dim-1 rows, exact fp32
             dvec = np.asarray(dense_flat, np.float32).reshape(-1, 1)
-            client.push(self._HIER_DENSE_TABLE,
-                        np.arange(len(dvec), dtype=np.int64), dvec, epoch,
-                        exact=True)
+            client.push_async(self._HIER_DENSE_TABLE,
+                              np.arange(len(dvec), dtype=np.int64), dvec,
+                              epoch, exact=True)
+            t_commit0 = _time.perf_counter()
+            client.commit()
+            t_done = _time.perf_counter()
+            self._hier_push_seconds = t_done - t_dispatch0
+            self._hier_blocked_seconds = t_done - t_commit0
             for keys, tids, dims, k_out in pushed:
                 if len(keys) == 1:
                     g_u, rows_out = client.pull(tids[0], epoch, dims[0])
@@ -1178,6 +1211,11 @@ class SparseTableCTRTrainer(CTRTrainer):
         )
         self._hier_wire_fp32_bytes = fp32_equiv
         self._hier_wire_id_saved = client.shared_id_saved_bytes - saved0
+        self._hier_chunk_pushes = client.chunk_pushes_total - chunk0[0]
+        self._hier_chunk_rows = client.chunk_rows_total - chunk0[1]
+        self._hier_chunk_capacity = (
+            client.chunk_capacity_rows_total - chunk0[2]
+        )
         dsum = d_r.reshape(-1) / total
         loss = float(dsum[-1])
         dense_mean = jnp.asarray(dsum[:-1], jnp.float32)
@@ -1472,6 +1510,20 @@ class SparseTableCTRTrainer(CTRTrainer):
                     self._hier_wire_id_saved)
             reg.gauge_set("trainer_hier_wire_ef_mass",
                           self._hier_client.carry_mass())
+            # streaming-rendezvous overlap honesty (ISSUE 16): chunk fill
+            # = rows/capacity (near-empty windows waste frame headers),
+            # overlap ratio = 1 - blocked/push (how much of the push wall
+            # the dispatch/commit ticket hid under compute)
+            reg.inc("trainer_hier_chunk_pushes_total",
+                    self._hier_chunk_pushes)
+            reg.inc("trainer_hier_chunk_rows_total",
+                    self._hier_chunk_rows)
+            reg.inc("trainer_hier_chunk_capacity_rows_total",
+                    self._hier_chunk_capacity)
+            reg.inc("trainer_hier_overlap_push_seconds_total",
+                    self._hier_push_seconds)
+            reg.inc("trainer_hier_overlap_blocked_seconds_total",
+                    self._hier_blocked_seconds)
         # the pick is static post-trace: one ``exchange`` event per table
         # per PROGRAM, not one per step.  Primary and fallback decisions
         # log independently (a fallback first step must not be

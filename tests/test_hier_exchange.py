@@ -128,6 +128,210 @@ def test_reduce_shard_rejects_malformed_and_counts():
         shard.close()
 
 
+# -- the streaming rendezvous (ISSUE 16) ----------------------------------
+
+
+def test_chunked_striped_exchange_matches_single_shot_bit_identical(rng):
+    """THE streaming parity gate: the same two-host contribution pushed
+    (a) single-shot to one shard and (b) chunked into 3-row windows
+    across TWO striped shards pulls back the bit-identical merged union
+    — chunk boundaries and stripe splits change packets, never floats —
+    and the client's chunk-fill counters plus the per-stripe byte
+    counters land."""
+    from lightctr_tpu import obs
+    from lightctr_tpu.obs import labeled
+
+    dim, n = 5, 23
+    uids = [np.unique(rng.integers(1, 200, 40))[:n].astype(np.int64),
+            np.unique(rng.integers(1, 200, 40))[:n].astype(np.int64)]
+    rows = [rng.normal(size=(u.size, dim)).astype(np.float32)
+            for u in uids]
+
+    def run(n_shards, chunk_rows):
+        shards = [SparseReduceShard(n_hosts=2) for _ in range(n_shards)]
+        regs = [MetricsRegistry(), MetricsRegistry()]
+        cs = [HierExchangeClient([s.address for s in shards], host_id=h,
+                                 n_hosts=2, chunk_rows=chunk_rows,
+                                 registry=regs[h])
+              for h in (0, 1)]
+        try:
+            for h in (0, 1):
+                cs[h].push_async(0, uids[h], rows[h], epoch=0)
+            got = [cs[h].pull(0, 0, dim) for h in (0, 1)]
+            stats = [s.stats() for s in shards]
+            counters = (cs[0].chunk_pushes_total, cs[0].chunk_rows_total,
+                        cs[0].chunk_capacity_rows_total)
+            snap = regs[0].snapshot()["counters"]
+        finally:
+            for c in cs:
+                c.close()
+            for s in shards:
+                s.close()
+        return got, stats, counters, snap
+
+    with obs.override(True):
+        (base, _, base_counters, _) = run(n_shards=1, chunk_rows=None)
+        (got, stats, counters, snap) = run(n_shards=2, chunk_rows=3)
+    # hosts agree with each other and with the single-shot oracle, bit
+    # for bit (two f32 addends per uid commute; windows touch disjoint
+    # uid ranges so each (host, uid) lands exactly once)
+    for g in (base[1], got[0], got[1]):
+        np.testing.assert_array_equal(base[0][0], g[0])
+        np.testing.assert_array_equal(base[0][1], g[1])
+    # the pull committed the in-flight chunks first: no frame was lost
+    assert all(s["streaming"] for s in stats)
+    assert all(s["peak_round_bytes"] > 0 for s in stats)
+    # chunk-fill accounting: every window counted, capacity >= rows,
+    # unchunked pushes count capacity == rows (fill 1.0 by construction)
+    assert counters[0] > base_counters[0]
+    assert counters[2] >= counters[1] == n
+    assert base_counters[2] == base_counters[1] == n
+    # per-stripe byte counters: BOTH stripes carried frames
+    for s in ("0", "1"):
+        assert snap[labeled("hier_stripe_push_bytes_total",
+                            stripe=s)] > 0
+        assert snap[labeled("hier_stripe_pull_bytes_total",
+                            stripe=s)] > 0
+
+
+def test_streaming_out_of_order_duplicate_and_skewed_chunks(rng):
+    """The at-least-once chunk contract, against the shard surface
+    directly: chunks may arrive in ANY order, a retried duplicate chunk
+    is counted exactly once, the round completes only when every host's
+    declared total is in, a chunk-count skew inside one round fails
+    loud, and the frozen arrival ring carries the per-chunk timeline
+    (first/last offsets + chunk counts)."""
+    dim = 3
+    shard = SparseReduceShard(n_hosts=2)
+    try:
+        # host 0: three chunks, delivered 2, 0, 1; host 1: single-shot
+        u = np.arange(1, 10, dtype=np.int64)
+        r = rng.normal(size=(9, dim)).astype(np.float32)
+        chunks = [(u[0:3], r[0:3]), (u[3:6], r[3:6]), (u[6:9], r[6:9])]
+        shard._push(0, 0, 7, *chunks[2], dim, chunk=(2, 3))
+        assert shard._pull(0, 0, 7) is None  # withheld: incomplete
+        shard._push(0, 0, 7, *chunks[0], dim, chunk=(0, 3))
+        shard._push(0, 0, 7, *chunks[0], dim, chunk=(0, 3))  # dup retry
+        # a mid-round chunk-count skew is a protocol violation
+        with pytest.raises(ValueError, match="chunk-count skew"):
+            shard._push(0, 0, 7, *chunks[1], dim, chunk=(1, 4))
+        shard._push(0, 0, 7, *chunks[1], dim, chunk=(1, 3))
+        assert shard._pull(0, 0, 7) is None  # host 1 still missing
+        u1 = np.array([2, 5, 40], np.int64)
+        r1 = rng.normal(size=(3, dim)).astype(np.float32)
+        shard._push(1, 0, 7, u1, r1, dim, chunk=(0, 1))
+        ku, kr = shard._pull(0, 0, 7)
+        # oracle: duplicate chunk counted once, every id summed once
+        want_u = np.unique(np.concatenate([u, u1]))
+        want = np.zeros((want_u.size, dim), np.float32)
+        want[np.searchsorted(want_u, u)] += r
+        want[np.searchsorted(want_u, u1)] += r1
+        np.testing.assert_array_equal(ku, want_u)
+        np.testing.assert_allclose(kr, want, rtol=0, atol=0)
+        ring = shard.stats()["arrivals"]
+        assert ring and ring[-1]["epoch"] == 0
+        entry = ring[-1]
+        assert entry["chunks"] == {"0": 3, "1": 1}
+        assert set(entry["arrivals"]) == {"0", "1"}
+        # last-chunk offsets bound the first-chunk offsets per host
+        for h in ("0", "1"):
+            assert entry["last"][h] >= entry["arrivals"][h]
+        assert entry["wait_s"] == max(entry["arrivals"].values())
+    finally:
+        shard.close()
+
+
+def test_barrier_mode_chunk_merge_and_streaming_memory_flat(rng):
+    """streaming=False keeps the PR 10 barrier shape (chunks buffered,
+    one deterministic (host, chunk) merge at the first pull) and both
+    modes agree on grid-representable values; the streaming
+    accumulator's peak memory stays FLAT (+-10%) when n_hosts doubles
+    over the same id universe — the barrier buffer grows linearly."""
+    dim, n = 4, 30
+    u = np.arange(1, n + 1, dtype=np.int64)
+
+    def run(streaming, n_hosts):
+        shard = SparseReduceShard(n_hosts=n_hosts, streaming=streaming)
+        try:
+            for h in range(n_hosts):
+                # grid values: exact under any accumulation order
+                r = (rng.integers(-8, 9, size=(n, dim)) * 0.25
+                     ).astype(np.float32)
+                for ci in range(3):
+                    lo, hi = ci * 10, (ci + 1) * 10
+                    shard._push(h, 0, 0, u[lo:hi], r[lo:hi], dim,
+                                chunk=(ci, 3))
+            out = shard._pull(0, 0, 0)
+            return out, shard.stats()
+        finally:
+            shard.close()
+
+    rng_state = rng.bit_generator.state
+    (su, sr), s_stats = run(streaming=True, n_hosts=2)
+    rng.bit_generator.state = rng_state
+    (bu, br), b_stats = run(streaming=False, n_hosts=2)
+    assert s_stats["streaming"] and not b_stats["streaming"]
+    np.testing.assert_array_equal(su, bu)
+    np.testing.assert_array_equal(sr, br)  # grid values: bit-equal modes
+    # memory: the streaming accumulator is bounded by the UNION, so
+    # doubling the contributor count leaves the peak flat; the barrier
+    # buffer holds every contribution and roughly doubles
+    _, s2 = run(streaming=True, n_hosts=2)
+    _, s4 = run(streaming=True, n_hosts=4)
+    p2, p4 = s2["peak_round_bytes"], s4["peak_round_bytes"]
+    assert abs(p4 - p2) <= 0.1 * p2, (p2, p4)
+    _, b4 = run(streaming=False, n_hosts=4)
+    assert b4["peak_round_bytes"] > 1.5 * p4, (b4["peak_round_bytes"], p4)
+
+
+def test_owner_coded_encode_once_under_chunked_pushes(rng):
+    """The q8_ef/q4_ef owner contract survives chunking: however many
+    chunks fed the round, the owner-side encode happens EXACTLY once
+    (coded_rounds), every host pulls byte-identical code sections, a
+    retried pull re-serves the cached bytes, and the owner EF carry
+    advances once per ROUND — two identical rounds decode to different
+    bytes only through the carried residual."""
+    dim = 6
+    for bits, codec in ((8, "q8_ef"), (4, "q4_ef")):
+        shard = SparseReduceShard(n_hosts=2)
+        cs = [HierExchangeClient([shard.address], host_id=h, n_hosts=2,
+                                 codec=codec, chunk_rows=2)
+              for h in (0, 1)]
+        try:
+            u = np.arange(1, 8, dtype=np.int64)
+            r = (0.1 * rng.normal(size=(7, dim))).astype(np.float32)
+            outs = []
+            for epoch in (0, 1):
+                for h in (0, 1):
+                    cs[h].push(0, u, r, epoch=epoch)
+                raw = [shard._pull(h, epoch, 0, coded=True,
+                                   bits=cs[0]._coded_bits)
+                       for h in (0, 1)]
+                # encode-once: every pull (including a retry) serves the
+                # SAME cached bytes
+                assert raw[0] == raw[1]
+                assert shard._pull(0, epoch, 0, coded=True,
+                                   bits=cs[0]._coded_bits) == raw[0]
+                outs.append(raw[0])
+                got = [cs[h].pull(0, epoch, dim) for h in (0, 1)]
+                np.testing.assert_array_equal(got[0][0], got[1][0])
+                np.testing.assert_array_equal(got[0][1], got[1][1])
+            stats = shard.stats()
+            assert stats["coded_rounds"] == 2  # one encode per round
+            # the carry advanced between rounds: identical payloads
+            # encode to different bytes only via the carried residual,
+            # and the residual stays sub-bucket
+            assert outs[0] != outs[1]
+            mass = stats["owner_ef_mass"]["0"]
+            assert 0.0 < mass < 2.0, mass
+            # member-side carries advanced once per chunked push round
+            assert cs[0].carry_mass() > 0.0
+        finally:
+            for c in cs:
+                c.close()
+            shard.close()
+
+
 # -- in-process hier trainer (threads as hosts) ---------------------------
 
 
@@ -400,6 +604,11 @@ _WORKER = textwrap.dedent(
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
         int(sys.argv[4]), sys.argv[5], sys.argv[6])
     codec = sys.argv[7] if len(sys.argv) > 7 else "f32"
+    # "<codec>+stream" turns on the streaming rendezvous: chunked
+    # windows, striped dispatch, dispatch/commit overlap (ISSUE 16)
+    chunk_rows = None
+    if codec.endswith("+stream"):
+        codec, chunk_rows = codec[: -len("+stream")], 16
     import os
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -420,7 +629,7 @@ _WORKER = textwrap.dedent(
     params = fm.init(jax.random.PRNGKey(0), int(data["f"]), int(data["dim"]))
     client = HierExchangeClient(
         [("127.0.0.1", port0), ("127.0.0.1", port1)],
-        host_id=host_id, n_hosts=2, codec=codec)
+        host_id=host_id, n_hosts=2, codec=codec, chunk_rows=chunk_rows)
     tr = SparseTableCTRTrainer(
         params, fm.logits, TrainConfig(learning_rate=0.1),
         sparse_tables={"w": ["fids"], "v": ["fids"]},
@@ -441,6 +650,9 @@ _WORKER = textwrap.dedent(
             set(tr.exchange_policy.values()) == {"hier"}),
         carry_mass=np.float64(client.carry_mass()),
         id_saved=np.int64(client.shared_id_saved_bytes),
+        chunk_pushes=np.int64(client.chunk_pushes_total),
+        chunk_rows=np.int64(client.chunk_rows_total),
+        chunk_capacity=np.int64(client.chunk_capacity_rows_total),
     )
     client.close()
     print("WORKER_DONE", host_id, flush=True)
@@ -469,11 +681,14 @@ def test_two_process_hier_acceptance(tmp_path, rng):
     script.write_text(_WORKER)
 
     # every config runs CONCURRENTLY (each against its own pair of
-    # reduce shards) — six workers, one wall-clock wait: fp32 wire at
-    # {2, 4} local replicas, plus the q8_ef CODED wire at 2 replicas
-    # (the ISSUE 13 acceptance: trajectory within the EF bound of the
-    # fp32-wire run, wire bytes well under it)
-    cases = [("r2", 2, "f32"), ("r4", 4, "f32"), ("q8", 2, "q8_ef")]
+    # reduce shards) — eight workers, one wall-clock wait: fp32 wire at
+    # {2, 4} local replicas, the q8_ef CODED wire at 2 replicas (the
+    # ISSUE 13 acceptance: trajectory within the EF bound of the
+    # fp32-wire run, wire bytes well under it), and the STREAMING
+    # rendezvous (ISSUE 16) — chunked + striped + overlapped q8_ef —
+    # which must keep every one of those guarantees
+    cases = [("r2", 2, "f32"), ("r4", 4, "f32"), ("q8", 2, "q8_ef"),
+             ("qs", 2, "q8_ef+stream")]
     configs = {}
     try:
         for name, local_n, codec in cases:
@@ -561,3 +776,26 @@ def test_two_process_hier_acceptance(tmp_path, rng):
     # fids stream (w + v) saved real id bytes on the wire
     assert 0.0 < float(q0["carry_mass"]) < 1.0, q0["carry_mass"]
     assert int(q0["id_saved"]) > 0
+
+    # -- the STREAMING rendezvous (ISSUE 16) --------------------------
+    s0, s1 = by_case["qs"]
+    assert bool(s0["policy_hier"]) and bool(s1["policy_hier"])
+    # chunking really happened: more frames than the 2-shard minimum,
+    # and the windows shipped real rows under their declared capacity
+    assert int(s0["chunk_pushes"]) > int(q0["chunk_pushes"])
+    assert 0 < int(s0["chunk_rows"]) <= int(s0["chunk_capacity"])
+    # chunked + striped + overlapped rounds keep the PROCESS-level
+    # bit-identity: both hosts decode the same accumulator bytes
+    np.testing.assert_allclose(s0["losses"], s1["losses"], rtol=0, atol=0)
+    for k in ("w", "v"):
+        np.testing.assert_array_equal(s0[k], s1[k])
+    # and the trajectory stays within the SAME EF bound of the fp32-wire
+    # run the unchunked coded wire is held to (per-chunk dynamic ranges
+    # change the quantization grid, not the contract)
+    np.testing.assert_allclose(
+        s0["losses"], by_replicas[2][0]["losses"], rtol=0, atol=2e-3,
+        err_msg="streaming q8_ef trajectory left the EF bound",
+    )
+    # the streamed wire stays compressed: same budget band as unchunked
+    # q8_ef despite the per-chunk section headers
+    assert float(s0["socket_bytes"]) < 0.5 * s2, (s0["socket_bytes"], s2)

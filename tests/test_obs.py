@@ -908,17 +908,21 @@ def test_every_exchange_series_is_declared_and_emitted():
 
 def test_every_round_cluster_stall_series_is_declared_and_emitted():
     """The ISSUE-14 observability planes follow the same no-dark-series
-    contract as EXCHANGE_SERIES/HEALTH_SERIES: every ``hier_round_*``
-    series dist/hier.py emits must be declared in ``HIER_ROUND_SERIES``,
-    every ``cluster_*`` in obs/cluster.py in ``CLUSTER_SERIES``, every
-    ``stall_*`` in obs/stepwatch.py in ``STALL_SERIES`` — and every
-    declaration must be emitted (both directions, no duplicates)."""
+    contract as EXCHANGE_SERIES/HEALTH_SERIES: every ``hier_round_*`` or
+    ``hier_stripe_*`` series dist/hier.py emits must be declared in
+    ``HIER_ROUND_SERIES``, every ``cluster_*`` in obs/cluster.py in
+    ``CLUSTER_SERIES``, every ``stall_*`` in obs/stepwatch.py in
+    ``STALL_SERIES`` — and every declaration must be emitted (both
+    directions, no duplicates).  A case's prefix may be a TUPLE of
+    prefixes — one declaration tuple can own several series families in
+    one module (the ISSUE-16 stripe counters live beside the round
+    series)."""
     from lightctr_tpu.dist import hier
     from lightctr_tpu.obs import cluster as cluster_mod
     from lightctr_tpu.obs import stepwatch as stepwatch_mod
 
     cases = [
-        (LIB_ROOT / "dist" / "hier.py", "hier_round_",
+        (LIB_ROOT / "dist" / "hier.py", ("hier_round_", "hier_stripe_"),
          hier.HIER_ROUND_SERIES, "HIER_ROUND_SERIES"),
         (LIB_ROOT / "obs" / "cluster.py", "cluster_",
          cluster_mod.CLUSTER_SERIES, "CLUSTER_SERIES"),
@@ -979,6 +983,12 @@ def test_metrics_report_exchange_section(tmp_path, capsys):
     reg.inc("trainer_hier_wire_fp32_bytes_total", 4500)
     reg.inc("trainer_hier_wire_id_saved_bytes_total", 250)
     reg.gauge_set("trainer_hier_wire_ef_mass", 0.125)
+    # streaming rendezvous counters (ISSUE 16)
+    reg.inc("trainer_hier_chunk_pushes_total", 24)
+    reg.inc("trainer_hier_chunk_rows_total", 600)
+    reg.inc("trainer_hier_chunk_capacity_rows_total", 768)
+    reg.inc("trainer_hier_overlap_push_seconds_total", 2.0)
+    reg.inc("trainer_hier_overlap_blocked_seconds_total", 0.5)
     path = tmp_path / "snap.json"
     path.write_text(json.dumps(reg.snapshot()))
     assert metrics_report.main(["--exchange", str(path)]) == 0
@@ -999,6 +1009,15 @@ def test_metrics_report_exchange_section(tmp_path, capsys):
     assert codec["shared_id_saved_bytes"] == 250
     assert codec["shared_id_dedup_x"] == 1.25
     assert codec["ef_residual_mass"] == 0.125
+    # the streaming section: chunk fill = rows / window capacity, overlap
+    # ratio = the share of the push wall hidden under compute
+    streaming = report["streaming"]
+    assert streaming["chunk_pushes"] == 24
+    assert streaming["chunk_rows"] == 600
+    assert streaming["chunk_fill"] == round(600 / 768, 3)
+    assert streaming["push_seconds"] == 2.0
+    assert streaming["blocked_seconds"] == 0.5
+    assert streaming["overlap_ratio"] == 0.75
 
 
 # -- online plane telemetry lints + report (ISSUE 11) ------------------------

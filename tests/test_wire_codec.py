@@ -425,6 +425,126 @@ def test_old_hier_frames_byte_identical_and_coded_fails_old_readers(rng):
         hier._decode_payload(coded, 6, 0)              # old f16 path
 
 
+# -- nibble-packed q4 sections + chunked push framing (ISSUE 16) -------------
+
+
+def test_nibble_section_roundtrips_and_matches_kernel_packing(rng):
+    """The 4-bit value section (the ``q4_ef`` wire): codes nibble-pack two
+    per byte in the kernel layer's ``pack_nibbles`` order — a host-packed
+    stream and a device-packed stream of the same codes are
+    byte-identical — the section self-describes via its ``bits`` byte so
+    ``unpack_codes_section`` needs no out-of-band width, and the decode
+    error stays within half a 16-level bucket."""
+    import jax.numpy as jnp
+
+    from lightctr_tpu.ops import quantize
+
+    for n, dim in ((33, 5), (1, 1), (0, 4)):  # odd n*dim exercises the pad
+        vals = (0.3 * rng.normal(size=(n, dim))).astype(np.float32)
+        sec, dec = wire.pack_codes_section(vals, bits=4)
+        # 1 bits byte + 4 range bytes + ceil(n_vals/2) packed codes
+        assert len(sec) == 5 + (n * dim + 1) // 2
+        assert sec[0] == 4
+        out, used = wire.unpack_codes_section(sec + b"TRAILER", n, dim)
+        assert used == len(sec)
+        np.testing.assert_array_equal(out, dec)
+        if n:
+            bucket = 2 * 1.05 * np.abs(vals).max() / 16
+            assert np.abs(dec - vals).max() <= bucket / 2 * 1.0001
+    # host nibble order == the kernel pack_nibbles order, bit for bit
+    codes = rng.integers(0, 16, size=37).astype(np.uint8)
+    host = np.frombuffer(wire._nibble_pack(codes), np.uint8)
+    kernel = np.asarray(quantize.pack_nibbles(jnp.asarray(codes)))
+    np.testing.assert_array_equal(host, kernel)
+    np.testing.assert_array_equal(wire._nibble_unpack(host.tobytes(), 37),
+                                  codes)
+
+
+def test_nibble_section_fails_loud_at_old_readers(rng):
+    """Mixed-version interop: a nibble-packed section reaching a reader
+    that predates sub-byte packing (one byte per code, any ``bits``) dies
+    on the code-stream LENGTH check — half the bytes it expects — never a
+    silent misparse; and the full q4 coded frame round-trips through the
+    current reader with no out-of-band width."""
+    uids = np.unique(rng.integers(1, 1 << 14, 90)).astype(np.int64)
+    vals = (0.2 * rng.normal(size=(uids.size, 6))).astype(np.float32)
+
+    def old_unpack_codes_section(buf, n, dim):
+        # the pre-ISSUE-16 reader, verbatim: bits byte + range + n codes,
+        # ONE byte per code regardless of bits
+        bits = buf[0]
+        if not 1 <= bits <= 8:
+            raise ValueError(f"coded section claims {bits}-bit codes")
+        n_vals = int(n) * int(dim)
+        body = buf[5:5 + n_vals]
+        if len(body) != n_vals:
+            raise ValueError(
+                f"coded section carries {len(body)} code bytes for "
+                f"{n_vals} values"
+            )
+        return np.frombuffer(body, np.uint8), 5 + n_vals
+
+    sec8, _ = wire.pack_codes_section(vals, bits=8)
+    old_unpack_codes_section(sec8, uids.size, 6)  # 8-bit still parses
+    sec4, dec4 = wire.pack_codes_section(vals, bits=4)
+    with pytest.raises(ValueError, match="code bytes"):
+        old_unpack_codes_section(sec4, uids.size, 6)
+    # the current reader dispatches on the section's own bits byte
+    frame, dec = wire.pack_rows_coded(uids, vals, bits=4)
+    np.testing.assert_array_equal(dec, dec4)
+    u2, r2, used = wire.unpack_rows_coded(frame, 6)
+    assert used == len(frame)
+    np.testing.assert_array_equal(u2, uids)
+    np.testing.assert_array_equal(r2, dec)
+    # and a TRUNCATED nibble stream still fails the new reader loud
+    with pytest.raises(ValueError):
+        wire.unpack_rows_coded(frame[:-3], 6)
+
+
+def test_chunk_header_roundtrip_and_old_reader_rejection(rng):
+    """The chunked-push window header (streaming rendezvous): round-trips
+    ahead of any payload, rejects out-of-window indices at BOTH ends, and
+    a chunk-prefixed payload reaching an old reader (any of the three
+    legacy payload decodes) raises instead of applying a misparse."""
+    from lightctr_tpu.dist import hier
+
+    for ci, nc in ((0, 1), (3, 7), (126, 127), (0, 1 << 20)):
+        buf = wire.pack_chunk_header(ci, nc) + b"PAYLOAD"
+        got, used = wire.split_chunk_header(buf)
+        assert got == (ci, nc)
+        assert buf[used:] == b"PAYLOAD"
+    for bad_ci, bad_nc in ((1, 1), (-1, 2), (5, 5), (0, 0)):
+        with pytest.raises(ValueError, match="chunk"):
+            wire.pack_chunk_header(bad_ci, bad_nc)
+    with pytest.raises(ValueError, match="magic"):
+        wire.split_chunk_header(b"\x00\x01\x02")
+    with pytest.raises(ValueError):
+        wire.split_chunk_header(b"")
+    # forged header claiming chunk 5 of 3: split rejects
+    forged = bytes([wire.CHUNK_MAGIC]) + wire.pack_varint(
+        np.array([5, 3], np.int64))
+    with pytest.raises(ValueError, match="chunk header"):
+        wire.split_chunk_header(forged)
+    # old readers: a chunked frame must never half-parse as a legacy one
+    uids = np.unique(rng.integers(1, 1 << 12, 40)).astype(np.int64)
+    rows = rng.normal(size=(uids.size, 4)).astype(np.float32)
+    chunked = (wire.pack_chunk_header(0, 2)
+               + hier._encode_payload(uids, rows, hier.FLAG_F32))
+    with pytest.raises(ValueError):
+        hier._decode_payload(chunked, 4, hier.FLAG_F32)
+    with pytest.raises(ValueError):
+        hier._decode_payload(chunked, 4, 0)
+    with pytest.raises(ValueError):
+        wire.unpack_rows_coded(chunked, 4)
+    # and an UNCHUNKED client stays byte-identical to the legacy wire:
+    # chunk (0, 1) is the degenerate window the header only ships when
+    # the client opted into chunking
+    legacy = hier._encode_payload(uids, rows, hier.FLAG_F32)
+    k, r = hier._decode_payload(legacy, 4, hier.FLAG_F32)
+    np.testing.assert_array_equal(k, uids)
+    np.testing.assert_array_equal(r, rows)
+
+
 def test_rows_adagrad_native_matches_numpy_path(rng):
     """Fused one-pass server adagrad (ps_rows.cpp) == the numpy five-pass
     _apply, through the public push/pull surface, above and below the

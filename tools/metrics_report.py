@@ -432,6 +432,29 @@ def summarize_exchange(doc) -> dict:
             codec["ef_residual_mass"] = round(
                 gauges["trainer_hier_wire_ef_mass"], 6)
         report["wire_codec"] = codec
+    # streaming rendezvous (ISSUE 16): chunk fill — rows shipped over
+    # rows the dispatched windows could hold (near-empty windows waste
+    # frame headers) — and overlap ratio — the share of the push wall
+    # the dispatch/commit ticket hid under compute
+    chunk_pushes = counters.get("trainer_hier_chunk_pushes_total", 0)
+    chunk_rows = counters.get("trainer_hier_chunk_rows_total", 0)
+    chunk_cap = counters.get("trainer_hier_chunk_capacity_rows_total", 0)
+    push_s = counters.get("trainer_hier_overlap_push_seconds_total", 0)
+    blocked_s = counters.get(
+        "trainer_hier_overlap_blocked_seconds_total", 0)
+    if chunk_pushes:
+        streaming = {
+            "chunk_pushes": chunk_pushes,
+            "chunk_rows": chunk_rows,
+            "chunk_fill": round(chunk_rows / max(chunk_cap, 1), 3),
+            "push_seconds": round(float(push_s), 6),
+            "blocked_seconds": round(float(blocked_s), 6),
+        }
+        if push_s:
+            streaming["overlap_ratio"] = round(
+                min(max(1.0 - float(blocked_s) / float(push_s), 0.0),
+                    1.0), 3)
+        report["streaming"] = streaming
     return report
 
 

@@ -448,6 +448,157 @@ def hier_codec_grid(rng, vocab=8192, dims=(1, 16), host_rows=1024, nnz=8,
     return cell
 
 
+def hier_stream_grid(rng, dim=16, vocab=16384, draws=32768,
+                     hosts_sweep=(2, 4), link_bps=6.25e6, rounds=4,
+                     chunk_rows=512):
+    """Barrier-vs-streaming A/B for the rendezvous (ISSUE 16), under a
+    PACED wire standing in for a constrained DCN (the LIGHTCTR_LINK_BW
+    regime): every push frame sleeps ``bytes / link_bps`` before
+    transmitting, so the outbound leg costs what the slow link would.
+    The pace is per CONNECTION — each rendezvous shard is its own link,
+    the way distinct remote shard hosts are — so striping multiplies the
+    aggregate bandwidth, which is the point.  The barrier arm runs the
+    pre-streaming shape end to end: ONE unsplit shard
+    (``streaming=False``), compute then push then pull, serially.  The
+    streaming arm is the shipped configuration: two striped shards,
+    chunked pushes dispatched FIRST, the compute leg overlapped under
+    the in-flight transmissions, commit, then pull.  Reported per
+    n_hosts: measured step walls, the speedup (>=1.5x asserted), and
+    the shard peak-round-bytes column — the streaming accumulator is
+    bounded by the UNION, so it stays flat (+-10% asserted) when
+    n_hosts doubles while the barrier buffer (every contribution held
+    to the merge) grows ~linearly.  A stripe-scaling subcell isolates
+    the striping term: the same streamed payload over 1 vs 2 shards,
+    commit wall ~halving."""
+    from lightctr_tpu.dist.hier import HierExchangeClient, SparseReduceShard
+
+    def paced_client(addrs, h, n, chunked):
+        c = HierExchangeClient(addrs, host_id=h, n_hosts=n,
+                               chunk_rows=chunk_rows if chunked else None)
+        for pc in c.clients:
+            real = pc._rpc
+
+            def paced(msg, payload, _real=real):
+                # both directions ride the constrained link: the frame
+                # out, the (possibly megabyte-scale pull) reply back
+                time.sleep(len(payload) / link_bps)
+                reply = _real(msg, payload)
+                time.sleep(len(reply) / link_bps)
+                return reply
+
+            pc._rpc = paced
+        return c
+
+    # fixed per-host payloads: heavy union overlap (draws >> vocab / n),
+    # so the GLOBAL union — the streaming accumulator's bound — barely
+    # moves when n_hosts doubles
+    def payload(h):
+        g = np.random.default_rng(1000 + h)
+        u = np.unique(g.integers(1, vocab, size=draws)).astype(np.int64)
+        return u, g.normal(size=(u.size, dim)).astype(np.float32) * 0.1
+
+    payloads = [payload(h) for h in range(max(hosts_sweep))]
+    row_b = 8 + dim * 4
+    # compute leg sized to the paced per-stripe push wall: the regime
+    # where overlap hides the most
+    compute_s = payloads[0][0].size * row_b / 2 / link_bps
+
+    def run_arm(n, streaming, n_shards=2):
+        import threading
+
+        shards = [SparseReduceShard(n_hosts=n, streaming=streaming)
+                  for _ in range(n_shards)]
+        addrs = [s.address for s in shards]
+        # hosts move in LOCKSTEP (a barrier per round): the A/B measures
+        # the step shapes, not the withheld-retry backoff an artificially
+        # drifted puller would accumulate waiting on a straggler
+        gate = threading.Barrier(n)
+        walls = [[] for _ in range(rounds)]
+        push_walls = [[] for _ in range(rounds)]
+        errors = []
+
+        def host_fn(h):
+            c = paced_client(addrs, h, n, chunked=streaming)
+            try:
+                for ep in range(rounds):
+                    gate.wait(timeout=120)
+                    t0 = time.perf_counter()
+                    if streaming:
+                        c.push_async(0, *payloads[h], epoch=ep)
+                        time.sleep(compute_s)  # overlapped compute
+                        c.commit()
+                    else:
+                        time.sleep(compute_s)  # serial compute
+                        c.push(0, *payloads[h], epoch=ep)
+                    push_walls[ep].append(time.perf_counter() - t0)
+                    c.pull(0, ep, dim)
+                    walls[ep].append(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append((h, repr(e)))
+                gate.abort()
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=host_fn, args=(h,))
+                   for h in range(n)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            peak = max(s.stats()["peak_round_bytes"] for s in shards)
+        finally:
+            for s in shards:
+                s.close()
+        assert not errors, errors
+        # a round costs what its SLOWEST host paid (barrier semantics);
+        # the first round carries the connects, so take the median
+        return (float(np.median([max(w) for w in walls])),
+                float(np.median([max(w) for w in push_walls])),
+                peak)
+
+    cells = []
+    for n in hosts_sweep:
+        b_wall, _, b_peak = run_arm(n, streaming=False, n_shards=1)
+        s_wall, s_push, s_peak = run_arm(n, streaming=True)
+        cells.append({
+            "n_hosts": n,
+            "paced_link_bps": link_bps,
+            "compute_s": round(compute_s, 6),
+            "barrier_step_s": round(b_wall, 6),
+            "streaming_step_s": round(s_wall, 6),
+            "speedup_x": round(b_wall / s_wall, 3),
+            "shard_peak_round_bytes": {"barrier": int(b_peak),
+                                       "streaming": int(s_peak)},
+        })
+        print(f"hier stream n={n}: barrier {b_wall * 1e3:.1f}ms vs "
+              f"streaming {s_wall * 1e3:.1f}ms "
+              f"({cells[-1]['speedup_x']}x), peak "
+              f"{s_peak:,}B vs {b_peak:,}B barrier",
+              file=sys.stderr, flush=True)
+    # acceptance: the overlapped step is >=1.5x faster under the paced
+    # link, and the streaming accumulator's peak stays flat (+-10%)
+    # when n_hosts doubles while the barrier buffer grows
+    for c in cells:
+        assert c["speedup_x"] >= 1.5, c
+    peaks = [c["shard_peak_round_bytes"]["streaming"] for c in cells]
+    assert max(peaks) <= 1.1 * min(peaks), peaks
+    assert cells[-1]["shard_peak_round_bytes"]["barrier"] > \
+        1.5 * cells[-1]["shard_peak_round_bytes"]["streaming"], cells[-1]
+
+    # stripe scaling: the same streamed payload, 1 vs 2 shards — the
+    # paced transmissions run one pipeline per stripe, so the commit
+    # wall (no compute overlap here: compute_s still sleeps, the PUSH
+    # wall is what shrinks) reflects the aggregate bandwidth doubling
+    _, p1, _ = run_arm(2, streaming=True, n_shards=1)
+    _, p2, _ = run_arm(2, streaming=True, n_shards=2)
+    stripe = {"push_wall_1_shard_s": round(p1, 6),
+              "push_wall_2_shards_s": round(p2, 6),
+              "bandwidth_scaling_x": round(p1 / p2, 3)}
+    assert stripe["bandwidth_scaling_x"] >= 1.3, stripe
+    return cells, stripe
+
+
 def hier_trainer_cell(rng, steps=3):
     """One LIVE hier-trainer cell: two threaded hosts x 2 local replicas
     through the in-process rendezvous — the trace-time policy records
@@ -616,6 +767,7 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
     # bandwidth-aware cost model's picks at representative link ratios
     hgrid = hier_grid(rng)
     codec_cell = hier_codec_grid(rng)
+    stream_cells, stripe_cell = hier_stream_grid(rng)
     trainer_hier = hier_trainer_cell(rng, steps=steps)
     from lightctr_tpu.dist import LinkBandwidth
 
@@ -628,6 +780,18 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
             "ici_bps": ici_bps, "dcn_bps": dcn_bps,
             "regime": "vocab=4096 k=2048 dim=16, 2 hosts x 8 replicas",
             "pick": algo, "bytes": b,
+        })
+        # the streaming terms (ISSUE 16): striped shards multiply the
+        # effective DCN rate, overlap hides the push leg under the local
+        # merge — same regime, re-priced
+        algo_s, b_s = pick_exchange_algo(
+            16, 2048, 4096, 16, local_n=8, bw=bw, stripes=2,
+            overlap_push=True)
+        hier_cost.append({
+            "ici_bps": ici_bps, "dcn_bps": dcn_bps,
+            "regime": "vocab=4096 k=2048 dim=16, 2 hosts x 8 replicas, "
+                      "2 stripes + overlapped push",
+            "pick": algo_s, "bytes": b_s,
         })
     # acceptance: rs bytes roughly FLAT in world size at fixed density
     # (the allgather's grow ~(n-1)), and the pick takes rs past the
@@ -726,6 +890,23 @@ def run(steps: int = 4, out: str = "SPARSE_RING_BENCH.json",
                         "and both EF carries' residual mass shown as "
                         "sub-bucket noise per value.",
                 "cell": codec_cell,
+            },
+            "streaming": {
+                "note": "streaming rendezvous (ISSUE 16): barrier vs "
+                        "streaming A/B under a paced wire standing in "
+                        "for a constrained DCN (LIGHTCTR_LINK_BW "
+                        "regime).  The streaming arm dispatches chunked "
+                        "pushes, overlaps the compute leg under the "
+                        "in-flight transmissions and commits before the "
+                        "pull; >=1.5x step speedup asserted.  The shard "
+                        "peak-round-bytes column shows the streaming "
+                        "accumulator flat (+-10% asserted) as n_hosts "
+                        "doubles while the barrier buffer grows; the "
+                        "stripe subcell shows the commit wall shrinking "
+                        "with the shard count (aggregate paced "
+                        "bandwidth scales with stripes).",
+                "cells": stream_cells,
+                "stripe_scaling": stripe_cell,
             },
         },
         "hier_trainer_cell": trainer_hier,

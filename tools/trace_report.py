@@ -147,9 +147,13 @@ def summarize_rounds(spans: List[Dict], epoch=None) -> Dict:
     attrs (dist/hier.py), so one merged span set from all hosts yields,
     per round: each host's push arrival offset behind the round's FIRST
     push (the wait it charged the round with), its pull-satisfied
-    offset, the straggler by name, and the critical path.  Shard-side
-    ``hier/push|pull`` spans stitch under these via the wire trace
-    context (counted here as ``shard_spans``)."""
+    offset, the straggler by name, and the critical path.  Chunked
+    pushes (the streaming rendezvous, ISSUE 16) emit one
+    ``hier_client/push_chunk`` span per transmitted window — each host's
+    entry then carries the per-chunk timeline (first/last chunk offsets
+    and count), separating a late STARTER from a slow TRICKLER.
+    Shard-side ``hier/push|pull`` spans stitch under these via the wire
+    trace context (counted here as ``shard_spans``)."""
     rounds: Dict = {}
     shard_spans = 0
     for s in spans:
@@ -158,6 +162,7 @@ def summarize_rounds(spans: List[Dict], epoch=None) -> Dict:
             shard_spans += 1
             continue
         if name not in ("hier_client/push", "hier_client/push_group",
+                        "hier_client/push_chunk",
                         "hier_client/pull", "hier_client/pull_group"):
             continue
         attrs = s.get("attrs") or {}
@@ -168,7 +173,13 @@ def summarize_rounds(spans: List[Dict], epoch=None) -> Dict:
         r = rounds.setdefault(key, {"hosts": {}})
         host = str(attrs.get("host", s.get("pid", "?")))
         h = r["hosts"].setdefault(host, {})
-        if name.startswith("hier_client/push"):
+        if name == "hier_client/push_chunk":
+            # the transmit instant of ONE chunk window (worker-thread
+            # side): the per-chunk timeline of this host's contribution
+            h.setdefault("chunk_ts", []).append(
+                (int(attrs.get("chunk", 0)), float(s.get("ts", 0.0)))
+            )
+        elif name.startswith("hier_client/push"):
             # first push per host wins (a retried frame keeps the
             # original arrival)
             h.setdefault("push_ts", float(s.get("ts", 0.0)))
@@ -193,6 +204,12 @@ def summarize_rounds(spans: List[Dict], epoch=None) -> Dict:
                 e["push_offset_s"] = round(v["push_ts"] - t0, 6)
             if "pull_done_ts" in v:
                 e["pull_done_offset_s"] = round(v["pull_done_ts"] - t0, 6)
+            if "chunk_ts" in v:
+                cts = [ts for _, ts in v["chunk_ts"]]
+                e["chunks"] = len(v["chunk_ts"])
+                e["first_chunk_offset_s"] = round(min(cts) - t0, 6)
+                e["last_chunk_offset_s"] = round(max(cts) - t0, 6)
+                e["chunk_spread_s"] = round(max(cts) - min(cts), 6)
             hosts[h] = e
         entry: Dict = {
             "epoch": ep, "table": table, "hosts": hosts,
