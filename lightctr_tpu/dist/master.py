@@ -47,6 +47,7 @@ from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.cluster import ClusterRollup, attribute_stragglers
+from lightctr_tpu.obs.quality import quality_rollup
 from lightctr_tpu.obs.registry import labeled
 
 
@@ -212,11 +213,12 @@ class MasterService:
                     or "/stragglerz" in obs_exporter.json_routes():
                 logging.getLogger(__name__).warning(
                     "another cluster rollup is registered in this "
-                    "process; /stragglerz and /metrics now serve THIS "
-                    "master's view"
+                    "process; /stragglerz, /qualityz and /metrics now "
+                    "serve THIS master's view"
                 )
             obs_flight.register_registry("cluster", self.rollup)
             obs_exporter.register_json_route("/stragglerz", self.stragglerz)
+            obs_exporter.register_json_route("/qualityz", self.qualityz)
             self._scrape_thread = threading.Thread(
                 target=self._scrape_loop, name="master-scrape", daemon=True,
             )
@@ -903,6 +905,15 @@ class MasterService:
                              "(set scrape_period_s)"}
         return attribute_stragglers(self.rollup.members())
 
+    def qualityz(self) -> dict:
+        """Cluster-wide model-quality rollup — per-member calibration/
+        AUC/drift series merged from the scraped snapshots, the
+        ``/qualityz`` ops route's payload (obs/quality.py)."""
+        if self.rollup is None:
+            return {"error": "cluster scrape loop not armed "
+                             "(set scrape_period_s)"}
+        return quality_rollup(self.rollup.members())
+
     def close(self) -> None:
         self.monitor.stop()
         if self._scrape_thread is not None:
@@ -915,6 +926,9 @@ class MasterService:
             if obs_exporter.json_routes().get("/stragglerz") \
                     == self.stragglerz:
                 obs_exporter.unregister_json_route("/stragglerz")
+            if obs_exporter.json_routes().get("/qualityz") \
+                    == self.qualityz:
+                obs_exporter.unregister_json_route("/qualityz")
             if obs_flight.registered_registries().get("cluster") \
                     is self.rollup:
                 obs_flight.unregister_registry("cluster")

@@ -28,6 +28,7 @@ import optax
 from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import quality as quality_mod
 from lightctr_tpu.obs import stepwatch as stepwatch_mod
 from lightctr_tpu.obs import trace as trace_mod
 from lightctr_tpu.utils.profiling import annotate
@@ -138,6 +139,7 @@ class CTRTrainer:
         error_feedback: Optional[bool] = None,
         fused_adagrad: bool = False,
         zero_sharded: bool = False,
+        quality_bins: Optional[int] = None,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -239,6 +241,19 @@ class CTRTrainer:
         # would force a device sync per step and stall the dispatch
         # pipeline (the <5% overhead guard measures exactly that)
         self._health_pending: list = []
+        # model-quality sketch (obs/quality.py): when armed (ctor arg or
+        # LIGHTCTR_QUALITY) every step variant concatenates a fixed-size
+        # f32[4*bins] calibration/AUC/logloss sketch onto the health
+        # vector; it rides the same is_ready drain, so arming it never
+        # syncs the in-flight step.  Static at trace time: unarmed
+        # trainers keep the exact PR-4 health payload.
+        self._quality_bins = quality_mod.resolve_bins(quality_bins)
+        self.quality: Optional[quality_mod.QualityTracker] = None
+        if self._quality_bins is not None:
+            self.quality = quality_mod.QualityTracker(
+                component="trainer", num_bins=self._quality_bins,
+                monitor=self.health, registry=self.telemetry,
+            )
         # step stall watchdog (obs/stepwatch.py): wall time since the
         # last COMPLETED step vs an EWMA-derived deadline — the signal a
         # wedged exchange cannot suppress.  Armed by LIGHTCTR_STALL=1 (or
@@ -279,7 +294,7 @@ class CTRTrainer:
         exclude the leaves they exchange sparsely."""
         return params
 
-    def _make_loss_fn(self):
+    def _make_loss_fn(self, with_probs: bool = False):
         lambda_l2 = self.cfg.lambda_l2
         l2_fn = self.l2_fn
         logits_fn = self.logits_fn
@@ -295,12 +310,47 @@ class CTRTrainer:
             loss = losses_lib.logistic_loss(z, batch["labels"], reduction="sum")
             if lambda_l2 > 0.0:
                 loss = loss + lambda_l2 * l2
+            if with_probs:
+                # aux for the quality sketch: the predicted probabilities
+                # of the SAME forward pass (no second scoring pass)
+                return loss / n, sigmoid(z)
             return loss / n
 
         return loss_fn
 
+    def _make_grad_fn(self):
+        """``(params, batch) -> (loss, probs, grads)``; ``probs`` is the
+        aux predicted probabilities when the quality sketch is armed,
+        else None — one builder so every step variant gets the same
+        arming rule."""
+        armed = self._quality_bins is not None
+        loss_fn = self._make_loss_fn(with_probs=armed)
+        if armed:
+            def grad_fn(params, batch):
+                (loss, probs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch)
+                return loss, probs, grads
+        else:
+            def grad_fn(params, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                return loss, None, grads
+        return grad_fn
+
+    def _append_sketch(self, health, probs, batch, axis=None):
+        """Concatenate the in-jit quality sketch onto a health vector;
+        identity when unarmed (the unarmed payload stays byte-identical).
+        ``axis`` sums per-shard sketches inside shard_map programs so the
+        replicated output covers the full global batch."""
+        qb = self._quality_bins
+        if qb is None:
+            return health
+        sk = quality_mod.quality_sketch(probs, batch["labels"], qb)
+        if axis is not None:
+            sk = jax.lax.psum(sk, axis)
+        return jnp.concatenate([health, sk])
+
     def _make_step(self):
-        loss_fn = self._make_loss_fn()
+        grad_fn = self._make_grad_fn()
         tx = self.tx
 
         if self.fused_adagrad:
@@ -309,8 +359,10 @@ class CTRTrainer:
             lr, eps = self.cfg.learning_rate, 1e-7
 
             def step(params, opt_state, batch):
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-                health = _health_pack(loss, optax.global_norm(grads))
+                loss, probs, grads = grad_fn(params, batch)
+                health = self._append_sketch(
+                    _health_pack(loss, optax.global_norm(grads)),
+                    probs, batch)
                 leaves_w, treedef = jax.tree_util.tree_flatten(params)
                 leaves_a = treedef.flatten_up_to(opt_state.accum)
                 leaves_g = treedef.flatten_up_to(grads)
@@ -334,8 +386,9 @@ class CTRTrainer:
             return step
 
         def step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-            health = _health_pack(loss, optax.global_norm(grads))
+            loss, probs, grads = grad_fn(params, batch)
+            health = self._append_sketch(
+                _health_pack(loss, optax.global_norm(grads)), probs, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optim_lib.apply_updates(params, updates)
             return params, opt_state, loss, health
@@ -353,7 +406,7 @@ class CTRTrainer:
         from lightctr_tpu.core.compat import shard_map
         from jax.sharding import PartitionSpec as P
 
-        loss_fn = self._make_loss_fn()
+        grad_fn = self._make_grad_fn()
         tx = self.tx
         mesh = self.mesh
         n = mesh.shape["data"]
@@ -362,7 +415,7 @@ class CTRTrainer:
         shard_len = Lpad // n
 
         def local_step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, probs, grads = grad_fn(params, batch)
             flat_g, _ = ravel_pytree(grads)
             if Lpad != L:
                 flat_g = jnp.pad(flat_g, (0, Lpad - L))
@@ -386,7 +439,9 @@ class CTRTrainer:
             p_shard = optim_lib.apply_updates(p_shard, updates)
             full = jax.lax.all_gather(p_shard, "data", tiled=True)[:L]
             loss = jax.lax.pmean(loss, "data")
-            return unravel(full), opt_state, loss, _health_pack(loss, gnorm)
+            health = self._append_sketch(
+                _health_pack(loss, gnorm), probs, batch, axis="data")
+            return unravel(full), opt_state, loss, health
 
         return shard_map(
             local_step,
@@ -408,7 +463,7 @@ class CTRTrainer:
 
         from lightctr_tpu.dist.collectives import _ring_all_reduce_local
 
-        loss_fn = self._make_loss_fn()
+        grad_fn = self._make_grad_fn()
         tx = self.tx
         mesh = self.mesh
         n = mesh.shape["data"]
@@ -419,7 +474,7 @@ class CTRTrainer:
         padded = self._ring_pad
 
         def local_step(params, state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, probs, grads = grad_fn(params, batch)
             flat, unravel = ravel_pytree(grads)
             length = flat.shape[0]
             if padded != length:
@@ -445,7 +500,9 @@ class CTRTrainer:
             params = optim_lib.apply_updates(params, updates)
             state = CompressedRingState(inner=inner,
                                         residual=new_res[None])
-            return params, state, loss, _health_pack(loss, gnorm)
+            health = self._append_sketch(
+                _health_pack(loss, gnorm), probs, batch, axis="data")
+            return params, state, loss, health
 
         from lightctr_tpu.core.compat import shard_map
 
@@ -595,12 +652,17 @@ class CTRTrainer:
         the verdict by the next recorded step (or on
         :meth:`flush_health`), at zero pipeline stalls."""
         hm = self.health
-        if hm is None or not health_mod.enabled():
-            return
-        sig = self._health_signals(batch)
-        if sig:
-            hm.observe(**sig)
-        if health is None or not hm.wants("loss", "grad_norm"):
+        on = hm is not None and health_mod.enabled()
+        if on:
+            sig = self._health_signals(batch)
+            if sig:
+                hm.observe(**sig)
+        # the quality tracker drains the SAME queued vector (its sketch
+        # tail), so an armed trainer feeds it even with health monitoring
+        # off — the queue discipline below is identical either way
+        want = (on and hm.wants("loss", "grad_norm")) \
+            or self.quality is not None
+        if health is None or not want:
             return
         pend = self._health_pending
         pend.append(health)
@@ -609,12 +671,20 @@ class CTRTrainer:
             if (hasattr(head, "is_ready") and not head.is_ready()
                     and len(pend) <= self._HEALTH_MAX_LAG):
                 break
-            self._observe_scalars(hm, pend.pop(0))
+            self._observe_scalars(hm if on else None, pend.pop(0))
 
-    @staticmethod
-    def _observe_scalars(hm, health) -> None:
+    def _observe_scalars(self, hm, health) -> None:
         vals = np.asarray(health, np.float32)  # the single host fetch
-        hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
+        if hm is not None:
+            hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
+        self._feed_quality(vals, 2)
+
+    def _feed_quality(self, vals: np.ndarray, head: int) -> None:
+        """Everything past the ``head`` scalars of a drained health
+        vector is the quality sketch (when armed): fold it into the
+        tracker — same single fetch, no extra device traffic."""
+        if self.quality is not None and vals.shape[0] > head:
+            self.quality.update(vals[head:])
 
     def flush_health(self) -> None:
         """Drain every queued health vector NOW, blocking on any still in
@@ -622,10 +692,11 @@ class CTRTrainer:
         running another step)."""
         hm = self.health
         pend, self._health_pending = self._health_pending, []
-        if hm is None or not health_mod.enabled():
+        on = hm is not None and health_mod.enabled()
+        if not on and self.quality is None:
             return
         for entry in pend:
-            self._observe_scalars(hm, entry)
+            self._observe_scalars(hm if on else None, entry)
 
     def arm_stepwatch(self, **kw) -> "stepwatch_mod.StepWatch":
         """Arm (or return) the step stall watchdog against this trainer's

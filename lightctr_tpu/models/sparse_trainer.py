@@ -118,6 +118,7 @@ import optax
 from lightctr_tpu import obs
 from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
 from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import quality as quality_mod
 from lightctr_tpu.ops.sparse_kernels import next_pow2 as _pow2_pad
 from lightctr_tpu.utils.profiling import annotate
 
@@ -226,6 +227,7 @@ class SparseTableCTRTrainer(CTRTrainer):
         error_feedback: Optional[bool] = None,
         dense_switch_margin: float = 1.0,
         hier_exchange=None,
+        quality_bins: Optional[int] = None,
     ):
         if not sparse_tables:
             raise ValueError("sparse_tables must name at least one table leaf")
@@ -334,7 +336,7 @@ class SparseTableCTRTrainer(CTRTrainer):
             params, logits_fn, cfg, l2_fn=l2_fn, fused_fn=fused_fn, mesh=mesh,
             param_shardings=param_shardings, compress_bits=compress_bits,
             compress_range=compress_range, compress_mode=compress_mode,
-            error_feedback=error_feedback,
+            error_feedback=error_feedback, quality_bins=quality_bins,
         )
         if self._hier:
             import jax as _jax
@@ -475,7 +477,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         return tables, dense, batch2, uids, rows
 
     def _make_step(self):
-        loss_fn = self._make_loss_fn()
+        armed = self._quality_bins is not None
+        loss_fn = self._make_loss_fn(with_probs=armed)
         tx = self.tx
         spec = self._spec
         lr, eps = self.cfg.learning_rate, self._eps
@@ -489,9 +492,15 @@ class SparseTableCTRTrainer(CTRTrainer):
             def loss_on(rows, dense):
                 return loss_fn({**dense, **rows}, batch2)
 
-            loss, (g_rows, g_dense) = jax.value_and_grad(
-                loss_on, argnums=(0, 1)
-            )(rows, dense)
+            if armed:
+                (loss, probs), (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1), has_aux=True
+                )(rows, dense)
+            else:
+                loss, (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1)
+                )(rows, dense)
+                probs = None
             # grad global norm over touched rows + dense leaves: the
             # health scalar (one reduction; fetched only when monitored)
             gnorm = optax.global_norm((g_rows, g_dense))
@@ -522,8 +531,10 @@ class SparseTableCTRTrainer(CTRTrainer):
                     )
 
             params = {**dense, **tables}
+            health = self._append_sketch(
+                _health_pack(loss, gnorm), probs, batch2)
             return (params, {"dense": new_dense_state, "accum": new_accum},
-                    loss, _health_pack(loss, gnorm))
+                    loss, health)
 
         return step
 
@@ -558,7 +569,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         )
         from lightctr_tpu.ops import sparse_kernels
 
-        loss_fn = self._make_loss_fn()
+        armed = self._quality_bins is not None
+        loss_fn = self._make_loss_fn(with_probs=armed)
         tx = self.tx
         spec = self._spec
         lr, eps = self.cfg.learning_rate, self._eps
@@ -612,9 +624,15 @@ class SparseTableCTRTrainer(CTRTrainer):
             def loss_on(rows, dense):
                 return loss_fn({**dense, **rows}, batch2)
 
-            loss, (g_rows, g_dense) = jax.value_and_grad(
-                loss_on, argnums=(0, 1)
-            )(rows, dense)
+            if armed:
+                (loss, probs), (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1), has_aux=True
+                )(rows, dense)
+            else:
+                loss, (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1)
+                )(rows, dense)
+                probs = None
             # replica losses are local means; their mean is the global mean
             loss = jax.lax.pmean(loss, "data")
 
@@ -825,6 +843,7 @@ class SparseTableCTRTrainer(CTRTrainer):
                 _health_pack(loss, jnp.sqrt(gn2)),
                 jax.lax.psum(over_total, "data").astype(jnp.float32)[None],
             ])
+            health = self._append_sketch(health, probs, batch2, axis="data")
             return params, new_state, loss, health
 
         state_spec = {"dense": P(), "accum": {k: P() for k in spec}}
@@ -885,7 +904,8 @@ class SparseTableCTRTrainer(CTRTrainer):
         )
         from lightctr_tpu.ops import sparse_kernels
 
-        loss_fn = self._make_loss_fn()
+        armed = self._quality_bins is not None
+        loss_fn = self._make_loss_fn(with_probs=armed)
         spec = self._spec
         groups = self._field_groups(spec)
         mesh = self.mesh
@@ -907,9 +927,15 @@ class SparseTableCTRTrainer(CTRTrainer):
             def loss_on(rows, dense):
                 return loss_fn({**dense, **rows}, batch2)
 
-            loss, (g_rows, g_dense) = jax.value_and_grad(
-                loss_on, argnums=(0, 1)
-            )(rows, dense)
+            if armed:
+                (loss, probs), (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1), has_aux=True
+                )(rows, dense)
+            else:
+                loss, (g_rows, g_dense) = jax.value_and_grad(
+                    loss_on, argnums=(0, 1)
+                )(rows, dense)
+                probs = None
             # dense grads + the per-replica mean loss ride ONE flat psum:
             # [sum over local replicas of grads..., sum of losses]
             flat, _ = ravel_pytree(g_dense)
@@ -961,9 +987,24 @@ class SparseTableCTRTrainer(CTRTrainer):
                                 bucket_cap, shard_cap, average=False,
                             )
             over = jax.lax.psum(over_total, "data")
+            if armed:
+                # quality sketch over this HOST's global batch (psum over
+                # the local mesh): rides the payload to program C, which
+                # appends it to the health vector — the DCN hop never
+                # sees it (each host's tracker covers its own stream; the
+                # cluster rollup merges them)
+                sketch = jax.lax.psum(
+                    quality_mod.quality_sketch(
+                        probs, batch2["labels"], self._quality_bins
+                    ),
+                    "data",
+                )
+                return out_ids, out_rows, dense_flat, over, sketch
             return out_ids, out_rows, dense_flat, over
 
         ospec = ({k: P() for k in spec}, {k: P() for k in spec}, P(), P())
+        if armed:
+            ospec = ospec + (P(),)
         return shard_map(
             local_step, mesh=mesh, in_specs=(P(), P("data")),
             out_specs=ospec, check_vma=False,
@@ -981,8 +1022,10 @@ class SparseTableCTRTrainer(CTRTrainer):
         tx = self.tx
         spec = self._spec
         lr, eps = self.cfg.learning_rate, self._eps
+        armed = self._quality_bins is not None
 
-        def apply_step(params, opt_state, payload, dense_mean, loss, over):
+        def _apply(params, opt_state, payload, dense_mean, loss, over,
+                   sketch):
             from lightctr_tpu.ops import sparse_kernels
 
             tables = {k: params[k] for k in spec}
@@ -1008,9 +1051,22 @@ class SparseTableCTRTrainer(CTRTrainer):
             health = jnp.stack([
                 loss, jnp.sqrt(gn2), over.astype(jnp.float32)
             ])
+            if sketch is not None:
+                health = jnp.concatenate([health, sketch])
             return ({**dense, **tables},
                     {"dense": new_dense_state, "accum": new_accum},
                     loss, health)
+
+        if armed:
+            def apply_step(params, opt_state, payload, dense_mean, loss,
+                           over, sketch):
+                return _apply(params, opt_state, payload, dense_mean,
+                              loss, over, sketch)
+        else:
+            def apply_step(params, opt_state, payload, dense_mean, loss,
+                           over):
+                return _apply(params, opt_state, payload, dense_mean,
+                              loss, over, None)
 
         return apply_step
 
@@ -1119,7 +1175,14 @@ class SparseTableCTRTrainer(CTRTrainer):
         else:
             self.telemetry.inc("trainer_rs_fallback_total")
             local = self._hier_local_ag()
-        out_ids, out_rows, dense_flat, over = local(params, batch)
+        if self._quality_bins is not None:
+            # the sketch stays a DEVICE array end to end: program A ->
+            # program C, appended to the health vector there — the
+            # orchestrator never fetches it
+            out_ids, out_rows, dense_flat, over, sketch = local(params, batch)
+        else:
+            out_ids, out_rows, dense_flat, over = local(params, batch)
+            sketch = None
 
         # -- the DCN hop: one merged payload per host.  All groups PUSH
         # before any pull: each round's barrier is crossed while later
@@ -1222,9 +1285,12 @@ class SparseTableCTRTrainer(CTRTrainer):
 
         if sw is not None:
             sw.mark("apply")
+        apply_args = (params, opt_state, payload, dense_mean,
+                      jnp.float32(loss), jnp.asarray(over))
+        if sketch is not None:
+            apply_args = apply_args + (sketch,)
         new_params, new_state, loss_out, health = self._hier_apply_j(
-            params, opt_state, payload, dense_mean,
-            jnp.float32(loss), jnp.asarray(over),
+            *apply_args
         )
         del loss_out  # the host already holds the float
         return new_params, new_state, loss, health
@@ -1385,15 +1451,20 @@ class SparseTableCTRTrainer(CTRTrainer):
         return self.exchange_policy, self.exchange_bytes_per_step
 
     def _observe_scalars(self, hm, health) -> None:
-        """The hybrid step's health vector carries a third slot: the
+        """The hybrid/hier step's health vector carries a third slot: the
         in-jit rs overflow count.  Nonzero means the host capacity check
         and the compiled program disagreed — gradient entries were
-        dropped; surface it loudly instead of silently."""
+        dropped; surface it loudly instead of silently.  Anything past
+        the head scalars is the quality sketch (when armed), so the
+        overflow slot is addressed by step family, not by length."""
         vals = np.asarray(health, np.float32)
-        hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
-        if vals.shape[0] > 2 and vals[2] > 0:
+        if hm is not None:
+            hm.observe(loss=float(vals[0]), grad_norm=float(vals[1]))
+        head = 3 if (self._hybrid_dp or self._hier) else 2
+        if head == 3 and vals.shape[0] > 2 and vals[2] > 0:
             self.telemetry.inc("trainer_rs_overflow_total", int(vals[2]))
             obs.emit_event("rs_overflow", count=int(vals[2]))
+        self._feed_quality(vals, head)
 
     def _exchange_byte_totals(self):
         """(sparse_bytes, rs_bytes, dense_bytes) each member transmits per

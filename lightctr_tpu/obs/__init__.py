@@ -42,6 +42,11 @@ Entry points
 ``cluster`` (submodule)
     cluster-wide telemetry rollup + straggler attribution — member-
     labeled merged ``/metrics`` and the ``/stragglerz`` verdict.
+``quality`` (submodule)
+    model-quality plane — in-jit calibration/AUC/logloss sketches on the
+    trainer health vector, label-free score/coverage drift for serving,
+    calibration/AUC-regression/drift detectors, and ``/qualityz``;
+    ``LIGHTCTR_QUALITY=1`` arms the trainer sketch.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -70,6 +75,7 @@ from lightctr_tpu.obs import health  # noqa: F401  (health monitors)
 from lightctr_tpu.obs import exporter  # noqa: F401  (HTTP ops endpoints)
 from lightctr_tpu.obs import stepwatch  # noqa: F401  (stall watchdog)
 from lightctr_tpu.obs import cluster  # noqa: F401  (cluster rollup)
+from lightctr_tpu.obs import quality  # noqa: F401  (model-quality plane)
 
 # LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
 # inherits the variable — the multi-process PS run's postmortem switch

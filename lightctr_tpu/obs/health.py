@@ -556,12 +556,16 @@ class HealthMonitor:
         self.flight_min_interval_s = float(flight_min_interval_s)
         self.observations = 0
         self._clock = clock
-        self._last_dump: Optional[float] = None
-        self._last_dump_attempt: Optional[float] = None
-        # trigger name of an anomaly dump that failed/coalesced: retried
+        # flight-dump rate limiting is PER TRIGGER (detector name), not
+        # per monitor: a quality detector tripping every minute on one
+        # drifting tenant must not consume the shared window and mask the
+        # NaN dump another detector owes
+        self._last_dump: Dict[str, float] = {}
+        self._last_dump_attempt: Dict[str, float] = {}
+        # trigger names of anomaly dumps that failed/coalesced: retried
         # on later observations while the verdict stays past the
         # threshold, so the promised at-anomaly-time bundle still lands
-        self._flight_pending: Optional[str] = None
+        self._flight_pending: Dict[str, bool] = {}
         self._lock = threading.Lock()
         self._states: Dict[str, _DetState] = {}
         self._signals: set = set()
@@ -704,13 +708,14 @@ class HealthMonitor:
         if transitions and new_agg != old_agg:
             trigger = max(transitions, key=lambda t: SEVERITY[t[2]])[0]
             self._emit_aggregate(old_agg, new_agg, trigger)
-        elif (self._flight_pending is not None
+        elif (self._flight_pending
               and self.flight_severity is not None
               and SEVERITY[new_agg] >= SEVERITY[self.flight_severity]):
-            # a dump owed from an earlier transition (coalesced with one
+            # dumps owed from earlier transitions (coalesced with one
             # in progress, or a transient write failure): retry while the
             # verdict still warrants it
-            self._maybe_flight(self._flight_pending)
+            for trigger in tuple(self._flight_pending):
+                self._maybe_flight(trigger)
 
     # -- emission ------------------------------------------------------------
 
@@ -754,24 +759,26 @@ class HealthMonitor:
         monitor.  A dump that coalesced with one already in progress (or
         failed transiently) is kept PENDING and retried on later
         observations — the rate limit only starts counting from a dump
-        that actually landed."""
+        that actually landed.  Both windows are keyed by ``trigger`` so
+        one noisy detector cannot exhaust the window for the others."""
         if not flight_mod.armed():
             return None
         now = self._clock()
-        if (self._last_dump is not None
-                and now - self._last_dump < self.flight_min_interval_s):
+        last = self._last_dump.get(trigger)
+        if last is not None and now - last < self.flight_min_interval_s:
+            self._flight_pending.pop(trigger, None)
             return None
-        if (self._last_dump_attempt is not None
-                and now - self._last_dump_attempt < self._FLIGHT_RETRY_S):
-            self._flight_pending = trigger
+        attempt = self._last_dump_attempt.get(trigger)
+        if attempt is not None and now - attempt < self._FLIGHT_RETRY_S:
+            self._flight_pending[trigger] = True
             return None
-        self._last_dump_attempt = now
+        self._last_dump_attempt[trigger] = now
         path = flight_mod.dump(f"health:{self.component}:{trigger}")
         if path is None:
-            self._flight_pending = trigger
+            self._flight_pending[trigger] = True
             return None
-        self._flight_pending = None
-        self._last_dump = now
+        self._flight_pending.pop(trigger, None)
+        self._last_dump[trigger] = now
         self.registry.inc(labeled("health_flight_dumps_total",
                                   component=self.component))
         return path

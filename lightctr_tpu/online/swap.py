@@ -96,6 +96,18 @@ class ModelSwapper:
     ``tolerance``: max absolute score divergence the gate accepts —
     budget it for the export codec (an int8-coded export of the CURRENT
     weights should pass; a corrupted one should not).
+
+    Quality gate (optional, on top of parity): pass ``quality_margin``
+    plus replay slices that carry ``labels`` and the gate additionally
+    sketches both models' replay scores (obs.quality accumulators) and
+    refuses a candidate whose calibration ratio is materially worse
+    than the incumbent's (``|log ratio|`` exceeding the incumbent's by
+    more than ``log1p(quality_margin)``) or whose sketch-AUC regresses
+    by more than ``auc_margin`` — a miscalibrated export (e.g. a
+    temperature-scaled head) parity-checks fine score-by-score under a
+    loose tolerance but is still the wrong model to promote.  The gate
+    arms only when the replay carries at least ``quality_min_count``
+    labeled examples.
     """
 
     def __init__(
@@ -105,6 +117,10 @@ class ModelSwapper:
         tolerance: float = 5e-3,
         pull_rows=None,
         registry=None,
+        quality_margin: Optional[float] = None,
+        auc_margin: float = 0.01,
+        quality_min_count: int = 256,
+        quality_bins: int = 512,
     ):
         from lightctr_tpu.obs.registry import default_registry
 
@@ -112,6 +128,12 @@ class ModelSwapper:
             raise ValueError("swap gate needs a non-empty replay slice")
         self.model = model
         self.tolerance = float(tolerance)
+        self.quality_margin = None if quality_margin is None \
+            else float(quality_margin)
+        self.auc_margin = float(auc_margin)
+        self.quality_min_count = int(quality_min_count)
+        self.quality_bins = int(quality_bins)
+        self.last_quality: Optional[Dict] = None
         self.registry = registry if registry is not None \
             else default_registry()
         self._lock = threading.Lock()
@@ -174,6 +196,12 @@ class ModelSwapper:
                 # load failure is a refusal, never a serving crash
                 return self._refuse(path, "load", error=repr(e))
             worst = 0.0
+            q_old = q_new = None
+            if self.quality_margin is not None:
+                from lightctr_tpu.obs import quality as quality_mod
+
+                q_old = quality_mod.QualityAccumulator(self.quality_bins)
+                q_new = quality_mod.QualityAccumulator(self.quality_bins)
             try:
                 for arrays, uids, rows in self._replay:
                     old = self._score(self.model, arrays, uids, rows)
@@ -181,6 +209,11 @@ class ModelSwapper:
                     if not np.all(np.isfinite(new)):
                         return self._refuse(path, "nonfinite")
                     worst = max(worst, float(np.abs(new - old).max()))
+                    if q_old is not None and "labels" in arrays:
+                        y = np.asarray(
+                            arrays["labels"], np.float32).reshape(-1)
+                        q_old.update_scores(np.asarray(old)[: len(y)], y)
+                        q_new.update_scores(np.asarray(new)[: len(y)], y)
             except Exception as e:
                 return self._refuse(path, "score", error=repr(e))
             self.last_diff = worst
@@ -190,6 +223,13 @@ class ModelSwapper:
             # is False — compare through isfinite so nothing slips past
             if not np.isfinite(worst) or worst > self.tolerance:
                 return self._refuse(path, "parity", max_abs_diff=worst)
+            if q_old is not None and q_old.count >= self.quality_min_count:
+                verdict = self._quality_verdict(q_old, q_new)
+                self.last_quality = verdict
+                if verdict["refuse"]:
+                    return self._refuse(path, "quality", **{
+                        k: v for k, v in verdict.items() if k != "refuse"
+                    })
             version = self.model.swap_params(cand.params)
             self.accepted += 1
             if obs_gate.enabled():
@@ -199,6 +239,48 @@ class ModelSwapper:
             _LOG.info("model swap accepted: %s (v%d, max|d|=%.2e)",
                       path, version, worst)
             return True
+
+    def _quality_verdict(self, q_old, q_new) -> Dict:
+        """Candidate-vs-incumbent quality comparison over the SAME replay
+        scores the parity gate just produced.  Calibration is compared in
+        log-ratio space (symmetric over/under-prediction); AUC through
+        the rank statistic over the sketch histograms."""
+        import math
+
+        def _dev(ratio: float) -> float:
+            if not np.isfinite(ratio) or ratio <= 0.0:
+                return float("inf")
+            return abs(math.log(ratio))
+
+        old_ratio = q_old.calibration_ratio()
+        new_ratio = q_new.calibration_ratio()
+        old_auc = q_old.auc()
+        new_auc = q_new.auc()
+        old_ece = q_old.ece()
+        new_ece = q_new.ece()
+        # two calibration probes: the global ratio (gross bias) in
+        # symmetric log space, and per-bucket ECE (shape miscalibration
+        # — a temperature-scaled head moves ECE while the ratio can sit
+        # still); ``quality_margin`` is the slack for both
+        cal_budget = _dev(old_ratio) + math.log1p(self.quality_margin)
+        cal_bad = _dev(new_ratio) > cal_budget
+        ece_bad = (np.isfinite(old_ece) and np.isfinite(new_ece)
+                   and new_ece > old_ece + self.quality_margin)
+        auc_bad = (np.isfinite(old_auc) and np.isfinite(new_auc)
+                   and new_auc < old_auc - self.auc_margin)
+        def _num(x):  # keep the event-log JSON strict-parseable
+            return float(x) if np.isfinite(x) else None
+
+        return {
+            "refuse": bool(cal_bad or ece_bad or auc_bad),
+            "count": int(q_old.count),
+            "incumbent_calibration": _num(old_ratio),
+            "candidate_calibration": _num(new_ratio),
+            "incumbent_ece": _num(old_ece),
+            "candidate_ece": _num(new_ece),
+            "incumbent_auc": _num(old_auc),
+            "candidate_auc": _num(new_auc),
+        }
 
     def _refuse(self, path: str, reason: str, **detail) -> bool:
         self.refusals[reason] = self.refusals.get(reason, 0) + 1
@@ -255,4 +337,5 @@ class ModelSwapper:
                 "last_path": self.last_path,
                 "model_version": self.model.version,
                 "tolerance": self.tolerance,
+                "last_quality": self.last_quality,
             }

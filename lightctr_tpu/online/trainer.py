@@ -77,6 +77,8 @@ class OnlineTrainer:
         export_every: int = 0,
         export_codec: str = "int8",
         registry=None,
+        quality=None,
+        drift=None,
     ):
         from lightctr_tpu.obs.registry import default_registry
 
@@ -115,6 +117,12 @@ class OnlineTrainer:
         self.exports = 0
         self.push_failures = 0
         self.last_loss: Optional[float] = None
+        # model-quality plane (obs.quality): ``quality`` consumes the
+        # per-step (probs, labels) pair for calibration/AUC sketches;
+        # ``drift`` consumes label-free scores + the already-deduped uid
+        # streams for coverage/score-distribution drift.  Both optional.
+        self.quality = quality
+        self.drift = drift
         self._grads_fn = None  # built lazily (jax import at step time)
 
     # -- jitted gradient programs -------------------------------------------
@@ -125,17 +133,26 @@ class OnlineTrainer:
 
         from lightctr_tpu.ops import losses as losses_lib
 
+        # quality/drift want the per-example probabilities from the SAME
+        # forward pass — aux-return them instead of re-running inference
+        aux = self.quality is not None or self.drift is not None
+
         if self.kind == "fm":
             from lightctr_tpu.models import fm
 
             def fm_loss(rows, batch):
                 params = {"w": rows[:, 0], "v": rows[:, 1:]}
                 z = fm.logits(params, batch)
-                return losses_lib.logistic_loss(
+                loss = losses_lib.logistic_loss(
                     z, batch["labels"], reduction="mean"
                 )
+                if aux:
+                    return loss, jax.nn.sigmoid(z)
+                return loss
 
-            self._grads_fn = jax.jit(jax.value_and_grad(fm_loss))
+            self._grads_fn = jax.jit(
+                jax.value_and_grad(fm_loss, has_aux=aux)
+            )
         else:
             from lightctr_tpu.models import widedeep
 
@@ -143,13 +160,18 @@ class OnlineTrainer:
                 params = {"w": w_rows, "embed": e_rows,
                           "fc1": fc1, "fc2": fc2}
                 z = widedeep.logits(params, batch)
-                return losses_lib.logistic_loss(
+                loss = losses_lib.logistic_loss(
                     z, batch["labels"], reduction="mean"
                 )
+                if aux:
+                    return loss, jax.nn.sigmoid(z)
+                return loss
 
             self._grads_fn = jax.jit(
-                jax.value_and_grad(wd_loss, argnums=(0, 1, 2, 3))
+                jax.value_and_grad(wd_loss, argnums=(0, 1, 2, 3),
+                                   has_aux=aux)
             )
+        self._aux = aux
         self._jnp = jnp
 
     # -- SSP pull with retry -------------------------------------------------
@@ -190,14 +212,16 @@ class OnlineTrainer:
                 "vals": mb["vals"], "mask": mb["mask"],
                 "labels": mb["labels"],
             }
-            loss, g = self._grads_fn(
+            out, g = self._grads_fn(
                 jnp.asarray(gathered),
                 {k: jnp.asarray(v) for k, v in batch.items()},
             )
+            loss, probs = out if self._aux else (out, None)
             ok = self.ps.push_arrays(
                 self.worker_id, u, np.asarray(g)[: len(u)],
                 worker_epoch=self.steps,
             )
+            self._feed_quality(probs, mb["labels"], {"fids": u})
         else:
             from lightctr_tpu.models.widedeep import field_representatives
 
@@ -222,12 +246,13 @@ class OnlineTrainer:
                 "vals": mb["vals"], "mask": mb["mask"],
                 "rep_mask": rep_mask, "labels": mb["labels"],
             }
-            loss, (g_w, g_e, g_fc1, g_fc2) = self._grads_fn(
+            out, (g_w, g_e, g_fc1, g_fc2) = self._grads_fn(
                 jnp.asarray(rows[iw, 0]), jnp.asarray(rows[ie, 1:]),
                 {k: jnp.asarray(v) for k, v in self.dense["fc1"].items()},
                 {k: jnp.asarray(v) for k, v in self.dense["fc2"].items()},
                 {k: jnp.asarray(v) for k, v in batch.items()},
             )
+            loss, probs = out if self._aux else (out, None)
             G = np.zeros((len(keys), self.row_dim), np.float32)
             G[iw[: len(uw)], 0] = np.asarray(g_w)[: len(uw)]
             G[ie[: len(ue)], 1:] = np.asarray(g_e)[: len(ue)]
@@ -235,6 +260,8 @@ class OnlineTrainer:
                 self.worker_id, keys, G, worker_epoch=self.steps,
             )
             self._apply_dense({"fc1": g_fc1, "fc2": g_fc2})
+            self._feed_quality(probs, mb["labels"],
+                               {"fids": uw, "rep_fids": ue})
         loss = float(loss)
         self.steps += 1
         self.examples += int(mb.get("row_mask", np.ones(b)).sum())
@@ -270,6 +297,24 @@ class OnlineTrainer:
                 )
 
     # -- dense export --------------------------------------------------------
+
+    def _feed_quality(self, probs, labels, fields) -> None:
+        """Feed the model-quality plane off this step's artifacts: the
+        aux probabilities (same forward pass as the gradient) and the
+        already-deduped uid streams the pull computed anyway."""
+        if probs is None:
+            return
+        try:
+            scores = np.asarray(probs, np.float32).reshape(-1)
+            if self.quality is not None:
+                self.quality.update_scores(
+                    scores, np.asarray(labels, np.float32).reshape(-1)
+                )
+            if self.drift is not None:
+                self.drift.observe(scores=scores, fields=fields)
+        except Exception:
+            # quality telemetry must never take down the training loop
+            _LOG.debug("quality feed failed", exc_info=True)
 
     def export(self) -> Optional[str]:
         """Publish the dense half now (widedeep only).  Never raises —

@@ -63,6 +63,7 @@ MAX_PREDICT_FRAME_BYTES = 16 * 1024 * 1024
 from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
+from lightctr_tpu.obs import quality as obs_quality
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import (
     MetricsRegistry,
@@ -130,6 +131,7 @@ class PredictionServer:
         slo_feed_every: int = 8,
         health: Optional[obs_health.HealthMonitor] = None,
         score_delay_s: float = 0.0,
+        drift: Optional["obs_quality.DriftMonitor"] = None,
     ):
         if model.row_leaves and ps is None:
             raise ValueError(
@@ -167,6 +169,13 @@ class PredictionServer:
             p99_slo_s=slo_p99_s, p50_slo_s=slo_p50_s,
         ))
         self.health = health
+        # model-quality drift (obs/quality.py): score-distribution +
+        # per-field coverage sketches off the scored batches; a monitor
+        # constructed without its own HealthMonitor inherits this
+        # server's, so a drift trip degrades THIS server's /healthz
+        self.drift = drift
+        if drift is not None and drift.monitor is None:
+            drift.bind_monitor(self.health)
         self._slo_feed_every = max(1, int(slo_feed_every))
         self._slo_prev_counts: Optional[List[int]] = None
         self._batches_scored = 0
@@ -436,6 +445,8 @@ class PredictionServer:
                         buckets=_BATCH_BUCKETS)
             reg.observe("serve_score_seconds", dt)
         self._batches_scored += 1
+        if self.drift is not None:
+            self._feed_drift(arrays, scores)
         if self._batches_scored % self._slo_feed_every == 0:
             self._feed_slo()
         if (self.ps is not None and self.version_poll_s
@@ -481,6 +492,24 @@ class PredictionServer:
                 rows[~present] = pulled
             cache.insert(miss, pulled)
         return self.model.score_rows(arrays, uids, rows)
+
+    # -- quality drift feed --------------------------------------------------
+
+    def _feed_drift(self, arrays: Dict, scores) -> None:
+        """Label-free quality sketches off data the scorer already holds:
+        the batch scores and the per-field id streams (deduped, the same
+        streams ``touched_uids`` folds for the PS path).  np.bincount per
+        field — never on the request path's critical lock."""
+        try:
+            fields: Dict[str, np.ndarray] = {}
+            for f in getattr(self.model, "id_fields", ()):
+                col = arrays.get(f)
+                if col is not None:
+                    fields[f] = np.unique(
+                        np.asarray(col).reshape(-1).astype(np.int64))
+            self.drift.observe(scores=np.asarray(scores), fields=fields)
+        except Exception:
+            _LOG.debug("drift feed failed", exc_info=True)
 
     # -- SLO feed -----------------------------------------------------------
 
@@ -601,6 +630,8 @@ class PredictionServer:
         with self._cond:
             self._cond.notify_all()
         obs_flight.unregister_registry(self._flight_name)
+        if self.drift is not None:
+            self.drift.close()
         if self._owns_health:
             self.health.close()
         try:

@@ -604,55 +604,62 @@ def test_every_ps_wire_op_has_a_latency_series_name():
 
 def test_every_health_detector_is_registered_and_series_declared():
     """No silent dark detectors: every ``*Detector`` class in obs/health.py
-    must declare literal ``name``/``signals`` class attributes and be
-    listed in ``KNOWN_DETECTORS``; and every gauge/counter series the
-    module writes (the first argument of each ``labeled(...)`` call) must
+    AND obs/quality.py (the model-quality plane registers its detectors
+    into the same ``KNOWN_DETECTORS`` at import) must declare literal
+    ``name``/``signals`` class attributes and be listed in
+    ``KNOWN_DETECTORS``; and every gauge/counter series obs/health.py
+    writes (the first argument of each ``labeled(...)`` call) must
     appear in ``HEALTH_SERIES`` — a detector whose metric is not declared
-    there would never make it into dashboards or docs."""
-    from lightctr_tpu.obs import health
+    there would never make it into dashboards or docs.  (quality.py's
+    series get the same treatment against ``QUALITY_SERIES`` in
+    tests/test_quality.py.)"""
+    from lightctr_tpu.obs import health, quality
 
-    src = (LIB_ROOT / "obs" / "health.py").read_text()
-    tree = ast.parse(src, filename="obs/health.py")
+    detectors = {}  # class name -> (module, detector name)
+    for module, fname in ((health, "health.py"), (quality, "quality.py")):
+        src = (LIB_ROOT / "obs" / fname).read_text()
+        tree = ast.parse(src, filename=f"obs/{fname}")
 
-    detectors = {}
-    labeled_series = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "labeled"
-                and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)):
-            labeled_series.add(node.args[0].value)
-        if not (isinstance(node, ast.ClassDef)
-                and node.name.endswith("Detector")
-                and node.name != "Detector"):
-            continue
-        attrs = {}
-        for stmt in node.body:
-            if (isinstance(stmt, ast.Assign)
-                    and len(stmt.targets) == 1
-                    and isinstance(stmt.targets[0], ast.Name)):
-                attrs[stmt.targets[0].id] = stmt.value
-        assert isinstance(attrs.get("name"), ast.Constant) and \
-            isinstance(attrs["name"].value, str) and attrs["name"].value, \
-            f"{node.name} must declare a literal class-level name"
-        sig = attrs.get("signals")
-        assert isinstance(sig, ast.Tuple) and sig.elts, \
-            f"{node.name} must declare a non-empty literal signals tuple"
-        detectors[node.name] = attrs["name"].value
+        labeled_series = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "labeled"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                labeled_series.add(node.args[0].value)
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name.endswith("Detector")
+                    and node.name != "Detector"):
+                continue
+            attrs = {}
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    attrs[stmt.targets[0].id] = stmt.value
+            assert isinstance(attrs.get("name"), ast.Constant) and \
+                isinstance(attrs["name"].value, str) and \
+                attrs["name"].value, \
+                f"{node.name} must declare a literal class-level name"
+            sig = attrs.get("signals")
+            assert isinstance(sig, ast.Tuple) and sig.elts, \
+                f"{node.name} must declare a non-empty literal signals tuple"
+            detectors[node.name] = (module, attrs["name"].value)
+        if module is health:
+            # every series written is declared, nothing declared is dead
+            assert labeled_series == set(health.HEALTH_SERIES), (
+                labeled_series, set(health.HEALTH_SERIES))
 
     assert detectors, "no Detector subclasses found (lint is miswired)"
-    names = set(detectors.values())
+    names = {dname for _, dname in detectors.values()}
     assert len(names) == len(detectors), "duplicate detector names"
     # every subclass is in the registry, and vice versa
     assert names == set(health.KNOWN_DETECTORS), (
         names, set(health.KNOWN_DETECTORS))
-    for cname, dname in detectors.items():
-        assert health.KNOWN_DETECTORS[dname] is getattr(health, cname)
-    # every series written is declared, and nothing declared is dead
-    assert labeled_series == set(health.HEALTH_SERIES), (
-        labeled_series, set(health.HEALTH_SERIES))
+    for cname, (module, dname) in detectors.items():
+        assert health.KNOWN_DETECTORS[dname] is getattr(module, cname)
 
     # and a tripped detector really lights its gauge + transition counter
     reg = obs.MetricsRegistry()

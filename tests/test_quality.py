@@ -1,0 +1,889 @@
+"""Model-quality observability plane (ISSUE 17): the in-jit quality
+sketch and its host accumulators (streaming calibration / rank-statistic
+AUC / logloss EWMA), the serving-side label-free DriftMonitor, the three
+quality detectors riding the PR-4 hysteresis machine, the ``/qualityz``
+route and cluster rollup, the report tooling (``metrics_report
+--quality``, the flight bundle's quality section), the per-trigger
+flight-dump windows, the <5% overhead guard WITH sketches armed, and the
+quality-gated model promotion (in-process and across a process
+boundary)."""
+
+import ast
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from lightctr_tpu import TrainConfig, obs, online, serve
+from lightctr_tpu.data.streaming import iter_libffm_batches
+from lightctr_tpu.dist.master import MasterService
+from lightctr_tpu.dist.ps_server import ParamServerService, PSClient
+from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.models import fm, widedeep
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.obs import exporter, flight, health, quality
+from lightctr_tpu.obs import trace as trace_mod
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_ROOT = Path(REPO_ROOT) / "lightctr_tpu"
+
+F, K = 256, 8
+ROW_DIM = 1 + K
+
+
+def _monitor(**kw):
+    kw.setdefault("registry", obs.MetricsRegistry())
+    kw.setdefault("flight_min_interval_s", 0.0)
+    return health.HealthMonitor(**kw)
+
+
+def _get(url, timeout=5.0):
+    """(status_code, parsed_json_or_text) tolerating HTTP error codes."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read()
+            code = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+    try:
+        return code, json.loads(body)
+    except json.JSONDecodeError:
+        return code, body.decode()
+
+
+def _calibrated_stream(rng, n, a=2.0, b=5.0):
+    """Scores from Beta(a, b) with labels drawn AT the score — a
+    perfectly calibrated scorer with a real ranking signal."""
+    p = rng.beta(a, b, size=n)
+    y = (rng.random(n) < p).astype(np.float64)
+    return p, y
+
+
+# -- the sketch --------------------------------------------------------------
+
+
+def test_device_sketch_matches_numpy_twin(rng):
+    """The jitted segment-sum sketch and the host bincount twin agree
+    bin-for-bin: both feeds fold into ONE accumulator contract."""
+    p = rng.random(513).astype(np.float32)
+    y = (rng.random(513) > 0.6).astype(np.float32)
+    dev = np.asarray(
+        jax.jit(lambda a, b: quality.quality_sketch(a, b, 32))(p, y))
+    host = quality.sketch_from_scores(p, y, 32)
+    assert dev.shape == (quality.sketch_width(32),)
+    np.testing.assert_allclose(dev, host, rtol=1e-4, atol=1e-3)
+    # row identities the stats lean on: counts sum to n, label row to
+    # the positives, prob row to the score mass
+    acc = quality.QualityAccumulator(32)
+    acc.update(dev)
+    assert acc.count == 513
+    assert abs(acc.pos_hist.sum() - float(y.sum())) < 1e-3
+
+
+def test_streaming_auc_within_0_005_of_exact(rng):
+    """Acceptance bar: the rank-statistic AUC off the binned sketch sits
+    within 0.005 of the exact pairwise AUC over the raw scores."""
+    n = 4096
+    p, y = _calibrated_stream(rng, n)
+    acc = quality.QualityAccumulator(quality.DEFAULT_BINS)
+    for chunk in np.array_split(np.arange(n), 8):  # streamed, not batch
+        acc.update_scores(p[chunk], y[chunk])
+    pos, neg = p[y > 0.5], p[y <= 0.5]
+    diff = pos[:, None] - neg[None, :]
+    exact = ((diff > 0).sum() + 0.5 * (diff == 0).sum()) / (
+        len(pos) * len(neg))
+    assert abs(acc.auc() - exact) < 0.005
+    # degenerate single-class windows answer nan, never crash
+    empty = quality.QualityAccumulator(16)
+    empty.update_scores(p[:8], np.ones(8))
+    assert math.isnan(empty.auc())
+
+
+def test_accumulator_calibration_ece_logloss_and_merge(rng):
+    n = 4000
+    p = rng.random(n)
+    y = (rng.random(n) < p).astype(np.float64)
+    a = quality.QualityAccumulator(128)
+    a.update_scores(p, y)
+    assert a.count == n and a.updates == 1
+    assert abs(a.calibration_ratio() - 1.0) < 0.1
+    assert a.ece() < 0.05
+    pc = np.clip(p, 1e-7, 1 - 1e-7)
+    ll = float(np.mean(-(y * np.log(pc) + (1 - y) * np.log1p(-pc))))
+    assert abs(a.logloss() - ll) < 1e-6
+    # temperature-scaling the head keeps the RANKING and (at a centered
+    # base rate) the GLOBAL ratio, but wrecks the per-bucket shape — the
+    # exact failure mode ece() exists to catch
+    z = np.log(pc / (1 - pc))
+    cold = 1.0 / (1.0 + np.exp(-z / 4.0))
+    a2 = quality.QualityAccumulator(128)
+    a2.update_scores(cold, y)
+    assert abs(a2.auc() - a.auc()) < 0.01
+    assert abs(a2.calibration_ratio() - 1.0) < 0.1
+    assert a2.ece() > a.ece() + 0.05
+    m = quality.QualityAccumulator(128)
+    m.merge(a)
+    m.merge(a2)
+    assert m.count == 2 * n and m.updates == 2
+    snap = a.snapshot()
+    assert snap["quality"] is True and snap["examples"] == n
+    assert snap["calibration"], "calibration table rides the snapshot"
+    a.reset()
+    assert a.count == 0 and math.isnan(a.calibration_ratio())
+
+
+def test_psi_sym_kl_and_fold_hist(rng):
+    ref = rng.integers(10, 100, size=32).astype(np.float64)
+    assert quality.psi(ref, ref * 3.0) < 1e-6  # scale-free
+    assert quality.symmetric_kl(ref, ref) < 1e-9
+    moved = np.zeros(32)
+    moved[:4] = ref.sum() / 4
+    assert quality.psi(ref, moved) > 0.5
+    assert quality.symmetric_kl(ref, moved) > 0.5
+    h = np.arange(12, dtype=np.float64)
+    folded = quality.fold_hist(h, 4)
+    assert folded.shape == (4,) and folded.sum() == h.sum()
+    ragged = quality.fold_hist(np.ones(10), 4)  # pads, keeps mass
+    assert ragged.sum() == 10.0
+
+
+def test_resolve_bins_explicit_beats_env(monkeypatch):
+    monkeypatch.delenv("LIGHTCTR_QUALITY", raising=False)
+    assert quality.resolve_bins() is None
+    assert quality.resolve_bins(24) == 24
+    assert quality.resolve_bins(0) is None
+    monkeypatch.setenv("LIGHTCTR_QUALITY", "16")
+    assert quality.resolve_bins() == 16
+    assert quality.resolve_bins(0) is None  # explicit off wins
+    monkeypatch.setenv("LIGHTCTR_QUALITY", "true")
+    assert quality.resolve_bins() == quality.DEFAULT_BINS
+    monkeypatch.setenv("LIGHTCTR_QUALITY", "0")
+    assert quality.resolve_bins() is None
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+def test_calibration_detector_bands():
+    det = quality.CalibrationDetector(tolerance=0.25, min_count=100)
+    sig = lambda r, n=1000: {"calibration": {"ratio": r, "count": n}}
+    assert det.check(sig(5.0, n=10))[0] == health.OK  # warmup skip
+    assert det.check(sig(1.1))[0] == health.OK
+    assert det.check(sig(1.4))[0] == health.DEGRADED
+    assert det.check(sig(1 / 1.4))[0] == health.DEGRADED  # symmetric
+    assert det.check(sig(0.55))[0] == health.UNHEALTHY
+    assert det.check(sig(float("nan")))[0] == health.UNHEALTHY
+    assert det.check(sig(-1.0))[0] == health.UNHEALTHY
+
+
+def test_auc_regression_detector_bands():
+    det = quality.AUCRegressionDetector(auc_margin=0.02,
+                                        logloss_margin=0.10, min_count=100)
+
+    def sig(auc=0.75, ll=0.5, n=1000):
+        return {"auc_quality": {"auc": auc, "baseline_auc": 0.75,
+                                "logloss_ewma": ll,
+                                "logloss_baseline": 0.5, "count": n}}
+
+    assert det.check(sig(n=10))[0] == health.OK  # warmup skip
+    assert det.check(sig())[0] == health.OK
+    assert det.check(sig(auc=0.72))[0] == health.DEGRADED
+    assert det.check(sig(auc=0.70))[0] == health.UNHEALTHY
+    assert det.check(sig(ll=0.575))[0] == health.DEGRADED
+    st, detail = det.check(sig(ll=0.65))
+    assert st == health.UNHEALTHY and detail["logloss_rel"] > 0.2
+
+
+def test_drift_detector_names_worst_field():
+    det = quality.DriftDetector(min_count=100)
+    sig = lambda fields, n=1000: {"drift": {"fields": fields, "count": n}}
+    assert det.check(sig({"score": 0.05}))[0] == health.OK
+    assert det.check(sig({"a": 0.3}, n=10))[0] == health.OK  # warmup
+    assert det.check(sig({}))[0] == health.OK  # nothing scored yet
+    st, detail = det.check(sig({"a": 0.3, "b": 0.1}))
+    assert st == health.DEGRADED and detail["worst_field"] == "a"
+    st, detail = det.check(sig({"a": 0.1, "uid": 0.9}))
+    assert st == health.UNHEALTHY and detail["worst_field"] == "uid"
+
+
+# -- series + detector hygiene (satellite lint) ------------------------------
+
+
+def test_quality_series_lint_both_directions():
+    """Every series quality.py emits is declared in QUALITY_SERIES and
+    every declared series is emitted — same both-directions AST contract
+    as the exchange/tier/stall series lints."""
+    tree = ast.parse((LIB_ROOT / "obs" / "quality.py").read_text())
+    emitted = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "labeled"):
+            arg = node.args[0]
+            assert isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str), \
+                "labeled() series names in quality.py must be literals"
+            emitted.add(arg.value)
+    declared = set(quality.QUALITY_SERIES)
+    assert len(declared) == len(quality.QUALITY_SERIES), "duplicate entry"
+    assert emitted - declared == set(), "emitted but not declared"
+    assert declared - emitted == set(), "declared but never emitted"
+
+
+# -- tracker + drift monitor -------------------------------------------------
+
+
+def test_tracker_rolls_windows_freezes_baseline_and_registers(rng):
+    reg = obs.MetricsRegistry()
+    qt = quality.QualityTracker(component="trk_t", num_bins=64,
+                                registry=reg, window_updates=2,
+                                min_window_count=10)
+    try:
+        p, y = _calibrated_stream(rng, 256)
+        qt.update_scores(p[:128], y[:128])
+        qt.update_scores(p[128:], y[128:])
+        assert qt.windows == 1 and qt.baseline is not None
+        base_auc = qt.baseline["auc"]
+        qt.update_scores(p[:128], y[:128])
+        qt.update_scores(p[128:], y[128:])
+        assert qt.windows == 2
+        assert qt.baseline["auc"] == base_auc  # frozen, not rolling
+        snap = reg.snapshot()
+        assert snap["counters"][obs.labeled(
+            "quality_examples_total", component="trk_t")] == 512
+        assert snap["counters"][obs.labeled(
+            "quality_windows_total", component="trk_t")] == 2
+        for g in ("quality_calibration_ratio", "quality_auc",
+                  "quality_logloss_ewma", "quality_logloss_baseline"):
+            assert obs.labeled(g, component="trk_t") in snap["gauges"], g
+        assert obs.labeled("quality_drift_score", component="trk_t",
+                           field="score") in snap["gauges"]
+        s = qt.snapshot()
+        assert s["quality"] is True and s["component"] == "trk_t"
+        assert s["windows"] == 2 and s["last_window"]["examples"] == 256
+        assert s["baseline"]["auc"] is not None
+        # ctor registered the /qualityz provider + the flight registry
+        assert "trk_t" in quality.quality_payload()["quality"]
+        assert "quality:trk_t" in flight.registered_registries()
+    finally:
+        qt.close()
+    assert "trk_t" not in quality.quality_payload()["quality"]
+    assert "quality:trk_t" not in flight.registered_registries()
+
+
+def test_drift_monitor_freezes_reference_then_scores_windows(rng):
+    reg = obs.MetricsRegistry()
+    hm = _monitor(component="dm_t", trip_after=1, recover_after=1)
+    dm = quality.DriftMonitor(component="dm_t_serve", score_bins=16,
+                              coverage_buckets=16, reference_examples=512,
+                              window_examples=512, monitor=hm, registry=reg)
+    try:
+        s0 = rng.beta(2, 5, 512)
+        dm.observe(scores=s0, fields={"fids": rng.integers(0, 1000, 512)})
+        assert dm.snapshot()["reference_frozen"] is True
+        # a stable window: same distributions, drift stays under the
+        # degraded band and the monitor stays ok
+        dm.observe(scores=rng.beta(2, 5, 512),
+                   fields={"fids": rng.integers(0, 1000, 512)})
+        assert dm.windows == 1
+        assert dm.last_scores["score"] < 0.2
+        assert dm.last_scores["fids"] < 0.2
+        assert hm.status() == health.OK
+        # collapsed uid vocabulary + inverted score shape: both fields
+        # blow past the unhealthy band, the detector names the worst
+        dm.observe(scores=rng.beta(8, 2, 512),
+                   fields={"fids": rng.integers(0, 4, 512)})
+        assert dm.windows == 2
+        assert dm.last_scores["fids"] > 0.5
+        assert hm.status() == health.UNHEALTHY
+        v = hm.verdict()["detectors"]["drift"]
+        assert v["detail"]["worst_field"] in ("fids", "score")
+        cov = reg.snapshot()["counters"][obs.labeled(
+            "quality_coverage_total", component="dm_t_serve", field="fids")]
+        assert cov == 3 * 512
+    finally:
+        dm.close()
+        hm.close()
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+def _toy_trainer(d=32, **kw):
+    params = {"w": np.zeros((d,), np.float32)}
+    return CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
+                      TrainConfig(learning_rate=0.1), **kw)
+
+
+def test_ctr_trainer_armed_sketch_feeds_tracker(rng):
+    d, n = 32, 128
+    batch = {
+        "x": rng.normal(size=(n, d)).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    tr = _toy_trainer(d, quality_bins=32)
+    assert tr.quality is not None
+    reg = obs.MetricsRegistry()
+    tr.quality.close()  # swap the ctor tracker for an isolated one
+    tr.quality = quality.QualityTracker(component="trainer_it", num_bins=32,
+                                        registry=reg, window_updates=8,
+                                        min_window_count=64)
+    try:
+        for _ in range(20):
+            tr.train_step(batch)
+        tr.flush_health()
+        # every step's sketch drained into the tracker (not just the
+        # health-gated subset): full example accounting
+        assert tr.quality.total.count == 20 * n
+        assert tr.quality.total.pos_hist.sum() == 20 * float(
+            batch["labels"].sum())
+        counters = reg.snapshot()["counters"]
+        assert counters[obs.labeled("quality_windows_total",
+                                    component="trainer_it")] == 2
+        assert counters[obs.labeled("quality_examples_total",
+                                    component="trainer_it")] == 16 * n
+    finally:
+        tr.quality.close()
+    # explicit 0 forces the sketch off: no tracker, PR-4 health payload
+    tr2 = _toy_trainer(d, quality_bins=0)
+    assert tr2.quality is None and tr2._quality_bins is None
+
+
+def test_env_var_arms_the_trainer_sketch(monkeypatch):
+    monkeypatch.setenv("LIGHTCTR_QUALITY", "16")
+    tr = _toy_trainer()
+    assert tr._quality_bins == 16 and tr.quality is not None
+    tr.quality.close()
+
+
+def test_trainer_overhead_under_5_percent_with_sketch_armed(rng):
+    """ISSUE 17 extension of the tier-1 overhead guard: the in-jit
+    quality sketch + per-step drain + host accumulator fold must stay
+    inside the SAME <5% budget the health plane already pays for — and
+    the sketch feed is asserted to have actually run (no passing by
+    silently skipping the quality path).
+
+    The sketch is a fixed O(batch) cost (one segment_sum + an 8 KB
+    fetch), so it is measured against a step whose per-row compute is
+    representative: at d=2560 one row costs ~2µs of matmul, the scale
+    of a small real CTR model — the d=256 toy the telemetry guard uses
+    would underprice the step by an order of magnitude and measure the
+    XLA CPU scatter, not the plane's overhead."""
+    d, n = 2560, 1024
+    batch = {
+        "x": rng.normal(size=(n, d)).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+
+    def build(armed):
+        tr = _toy_trainer(d, quality_bins=quality.DEFAULT_BINS
+                          if armed else 0)
+        hm = health.HealthMonitor(
+            component=f"quality_guard_{int(armed)}",
+            registry=obs.MetricsRegistry())
+        health.ensure_trainer_detectors(hm)
+        tr.health = hm
+        if armed:
+            tr.quality.close()
+            tr.quality = quality.QualityTracker(
+                component="overhead_q", num_bins=quality.DEFAULT_BINS,
+                monitor=hm, registry=obs.MetricsRegistry())
+        return tr, hm
+
+    tr_off, hm_off = build(False)
+    tr_on, hm_on = build(True)
+    obs.configure_event_log()  # fresh in-memory ring (no disk writes)
+    try:
+        with trace_mod.override_rate(0.0), obs.override(True):
+            for _ in range(5):  # compile + warm both programs
+                tr_off.train_step(batch)
+                tr_on.train_step(batch)
+
+            def run(tr, steps=30):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    tr.train_step(batch)
+                return time.perf_counter() - t0
+
+            t_off = min(run(tr_off) for _ in range(4))
+            t_on = min(run(tr_on) for _ in range(4))
+        tr_on.flush_health()
+        # the feed genuinely ran on the timed path: every sketched
+        # example of every step landed in the accumulator, and the
+        # monitor kept being fed alongside
+        assert tr_on.quality.total.count == (5 + 4 * 30) * n
+        assert hm_on.observations >= 4 * 30 - tr_on._HEALTH_MAX_LAG
+    finally:
+        tr_on.quality.close()
+        obs.configure_event_log()
+        hm_off.close()
+        hm_on.close()
+    assert t_on <= t_off * 1.05 + 0.005, (t_on, t_off)
+
+
+# -- the online trainer feed -------------------------------------------------
+
+
+def _write_fm_stream(path, rng, rows=512, nnz=4):
+    w_true = rng.normal(size=F)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            fids = rng.integers(1, F, size=nnz)
+            z = w_true[fids].sum()
+            y = int(1.0 / (1.0 + np.exp(-z)) > rng.random())
+            f.write(f"{y} " + " ".join(f"0:{d}:1.0" for d in fids) + "\n")
+
+
+def test_online_trainer_feeds_quality_and_drift(tmp_path, rng):
+    """The continuous trainer feeds the quality plane off artifacts it
+    already holds: the aux forward-pass probabilities into the tracker
+    and the deduped pull uids into the drift monitor."""
+    store = AsyncParamServer(dim=ROW_DIM, n_workers=1, seed=0)
+    svc = ParamServerService(store)
+    admin = PSClient(svc.address, ROW_DIM)
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    keys, rows0 = serve.fused_fm_rows(params)
+    admin.preload_arrays(keys, rows0)
+    p = str(tmp_path / "train.ffm")
+    _write_fm_stream(p, rng, rows=512)
+    reg = obs.MetricsRegistry()
+    qt = quality.QualityTracker(component="online", num_bins=64,
+                                registry=reg, window_updates=4,
+                                min_window_count=32)
+    dm = quality.DriftMonitor(component="online_serve", registry=reg,
+                              score_bins=16, coverage_buckets=16,
+                              reference_examples=128, window_examples=64)
+    tr = online.OnlineTrainer(admin, "fm", K, worker_id=0, registry=reg,
+                              quality=qt, drift=dm)
+    try:
+        for mb in iter_libffm_batches(p, 64, 4, loop=True):
+            tr.step(mb)
+            if tr.steps >= 12:
+                break
+        assert qt.total.count == 12 * 64
+        assert qt.windows == 3
+        assert dm.snapshot()["reference_frozen"] is True
+        counters = reg.snapshot()["counters"]
+        assert counters[obs.labeled("quality_examples_total",
+                                    component="online")] == 12 * 64
+        assert counters[obs.labeled("quality_coverage_total",
+                                    component="online_serve",
+                                    field="fids")] > 0
+    finally:
+        qt.close()
+        dm.close()
+        admin.close()
+        svc.close()
+
+
+# -- per-trigger flight windows (ISSUE 17 health.py change) ------------------
+
+
+class _TripA(health.Detector):
+    name = "trip_a"
+    signals = ("sig_a",)
+    trip_after = 1
+    recover_after = 1
+
+    def check(self, signals):
+        bad = bool(signals["sig_a"])
+        return (health.UNHEALTHY if bad else health.OK), {}
+
+
+class _TripB(_TripA):
+    name = "trip_b"
+    signals = ("sig_b",)
+
+    def check(self, signals):
+        bad = bool(signals["sig_b"])
+        return (health.UNHEALTHY if bad else health.OK), {}
+
+
+def test_flight_dump_rate_limit_is_per_trigger(tmp_path):
+    """One noisy detector must not exhaust the flight window for the
+    others: detector B tripping inside A's rate-limit window still gets
+    its anomaly-time bundle, while B re-tripping inside its OWN window
+    stays suppressed."""
+    t = [0.0]
+    reg = obs.MetricsRegistry()
+    hm = health.HealthMonitor(component="t_trigger", registry=reg,
+                              trip_after=1, recover_after=1,
+                              flight_min_interval_s=60.0,
+                              clock=lambda: t[0])
+    flight.install(str(tmp_path), catch_signals=False)
+    try:
+        hm.add_detector(_TripA())
+        hm.add_detector(_TripB())
+        dumps = lambda: reg.snapshot()["counters"].get(
+            obs.labeled("health_flight_dumps_total",
+                        component="t_trigger"), 0)
+        hm.observe(sig_a=False, sig_b=False)
+        hm.observe(sig_a=True, sig_b=False)  # A: ok -> unhealthy, dump 1
+        assert dumps() == 1
+        hm.observe(sig_a=False, sig_b=False)  # A recovers, aggregate ok
+        t[0] = 10.0  # well inside A's 60s window
+        hm.observe(sig_a=False, sig_b=True)  # B: its OWN window is fresh
+        assert dumps() == 2, "shared-window regression: B's dump eaten"
+        # B re-tripping inside B's window IS suppressed
+        hm.observe(sig_a=False, sig_b=False)
+        t[0] = 20.0
+        hm.observe(sig_a=False, sig_b=True)
+        assert dumps() == 2
+        # ...until the window lapses
+        hm.observe(sig_a=False, sig_b=False)
+        t[0] = 200.0
+        hm.observe(sig_a=False, sig_b=True)
+        assert dumps() == 3
+        bundles = sorted(Path(tmp_path).glob("flight-*.jsonl"))
+        assert len(bundles) == 3
+    finally:
+        flight.uninstall()
+        hm.close()
+
+
+# -- routes, rollup, report tooling ------------------------------------------
+
+
+def test_qualityz_route_serves_registered_providers(rng):
+    srv = exporter.OpsServer(port=0)
+    qt = quality.QualityTracker(component="qz_t", num_bins=16,
+                                registry=obs.MetricsRegistry(),
+                                window_updates=1, min_window_count=8)
+    try:
+        p, y = _calibrated_stream(rng, 64)
+        qt.update_scores(p, y)
+        code, body = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/qualityz")
+        assert code == 200
+        sect = body["quality"]["qz_t"]
+        assert sect["examples"] == 64 and sect["windows"] == 1
+    finally:
+        qt.close()
+        srv.close()
+
+
+def test_quality_rollup_extracts_members_and_worst_drift():
+    members = {
+        "shard_0": {"snapshot": {
+            "counters": {obs.labeled("quality_examples_total",
+                                     component="trainer"): 100},
+            "gauges": {obs.labeled("quality_drift_score",
+                                   component="serve", field="fids"): 0.7},
+        }},
+        "shard_1": {"snapshot": {
+            "gauges": {obs.labeled("quality_drift_score",
+                                   component="serve", field="score"): 0.2,
+                       "ps_store_pending_depth": 3.0},
+        }},
+        "shard_2": {"snapshot": {"counters": {"ps_pulls_total": 5}}},
+    }
+    roll = quality.quality_rollup(members)
+    assert set(roll["members"]) == {"shard_0", "shard_1"}
+    assert roll["worst_drift"] == {"member": "shard_0", "field": "fids",
+                                   "score": 0.7}
+    assert quality.quality_rollup({})["worst_drift"] is None
+
+
+def test_master_qualityz_rolls_up_scraped_members():
+    stores = [AsyncParamServer(dim=2, n_workers=1, seed=0)
+              for _ in range(2)]
+    svcs = [ParamServerService(s) for s in stores]
+    master = MasterService([s.address for s in svcs], period_s=0.05,
+                           scrape_period_s=30.0)
+    try:
+        stores[0].registry.inc(
+            obs.labeled("quality_examples_total", component="trainer"), 512)
+        stores[0].registry.gauge_set(
+            obs.labeled("quality_drift_score", component="serve",
+                        field="fids"), 0.83)
+        stores[1].registry.gauge_set(
+            obs.labeled("quality_drift_score", component="serve",
+                        field="score"), 0.05)
+        master.scrape_once()
+        qz = master.qualityz()
+        assert qz["worst_drift"]["member"] == "shard_0"
+        assert qz["worst_drift"]["field"] == "fids"
+        assert qz["members"]["shard_0"]["counters"][obs.labeled(
+            "quality_examples_total", component="trainer")] == 512
+        assert exporter.json_routes()["/qualityz"] == master.qualityz
+    finally:
+        master.close()
+        for s in svcs:
+            s.close()
+    assert exporter.json_routes().get("/qualityz") != master.qualityz
+
+
+def test_metrics_report_quality_summary(tmp_path, capsys):
+    import tools.metrics_report as metrics_report
+
+    snap = {
+        "counters": {
+            obs.labeled("quality_examples_total",
+                        component="trainer"): 4096,
+            obs.labeled("quality_windows_total", component="trainer"): 8,
+            obs.labeled("quality_coverage_total", component="serve",
+                        field="fids"): 1918,
+            "trainer_steps_total": 77,
+        },
+        "gauges": {
+            obs.labeled("quality_calibration_ratio",
+                        component="trainer"): 1.02,
+            obs.labeled("quality_auc", component="trainer"): 0.74,
+            obs.labeled("quality_logloss_ewma", component="trainer"): 0.52,
+            obs.labeled("quality_logloss_baseline",
+                        component="trainer"): 0.55,
+            obs.labeled("quality_drift_score", component="serve",
+                        field="fids"): 0.61,
+            obs.labeled("quality_drift_score", component="serve",
+                        field="score"): 0.11,
+        },
+    }
+    rep = metrics_report.summarize_quality(snap)
+    tr = rep["components"]["trainer"]
+    assert tr["examples"] == 4096 and tr["windows"] == 8
+    assert tr["calibration_ratio"] == 1.02 and tr["auc"] == 0.74
+    sv = rep["components"]["serve"]
+    assert sv["drift"] == {"fids": 0.61, "score": 0.11}
+    assert sv["coverage"] == {"fids": 1918}
+    assert rep["worst_drift"] == {"component": "serve", "field": "fids",
+                                  "score": 0.61}
+    # the CLI path accepts a stats() dump carrying the snapshot under
+    # "telemetry" (the /varz and MSG_STATS shapes)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps({"telemetry": snap}))
+    assert metrics_report.main(["--quality", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '"worst_drift"' in out and '"fids"' in out
+
+
+# -- acceptance: shift + flip trip the plane, control stays ok ---------------
+
+
+def test_quality_plane_acceptance_shift_and_flip(tmp_path, rng):
+    """ISSUE 17 acceptance: injected covariate shift + label flip trip
+    the DriftDetector and CalibrationDetector on the perturbed component
+    (-> /healthz 503 + an anomaly-time flight bundle whose quality
+    section trace_report can read) while an unperturbed control
+    component stays ok throughout."""
+    import tools.trace_report as trace_report
+
+    fdir = tmp_path / "flight"
+    srv = exporter.OpsServer(port=0)
+    flight.install(str(fdir), catch_signals=False)
+    obs.configure_event_log()
+    # auc_margin sits above the ~0.02 sampling std of a 512-example
+    # window AUC; the label flip inverts AUC by ~0.45, far past it
+    overrides = {"calibration": {"min_count": 256},
+                 "auc_regression": {"min_count": 256, "auc_margin": 0.08},
+                 "drift": {"min_count": 256}}
+    hm_bad = _monitor(component="qual_bad", trip_after=1, recover_after=1)
+    hm_ok = _monitor(component="qual_ok", trip_after=1, recover_after=1)
+    qt_bad = quality.QualityTracker(
+        component="qual_bad", num_bins=128, monitor=hm_bad,
+        registry=hm_bad.registry, window_updates=1, min_window_count=256,
+        detector_overrides=overrides)
+    qt_ok = quality.QualityTracker(
+        component="qual_ok", num_bins=128, monitor=hm_ok,
+        registry=hm_ok.registry, window_updates=1, min_window_count=256,
+        detector_overrides=overrides)
+    dm_bad = quality.DriftMonitor(
+        component="qual_bad_serve", score_bins=16, coverage_buckets=16,
+        reference_examples=512, window_examples=512, monitor=hm_bad,
+        registry=hm_bad.registry, detector_overrides=overrides)
+    dm_ok = quality.DriftMonitor(
+        component="qual_ok_serve", score_bins=16, coverage_buckets=16,
+        reference_examples=512, window_examples=512, monitor=hm_ok,
+        registry=hm_ok.registry, detector_overrides=overrides)
+
+    def healthy_batch(n=512):
+        p, y = _calibrated_stream(rng, n)
+        uids = rng.integers(0, 1000, size=n)
+        return p, y, uids
+
+    try:
+        # warmup: calibrated stream freezes the tracker baselines and
+        # the drift references on BOTH components
+        for _ in range(4):
+            p, y, u = healthy_batch()
+            qt_bad.update_scores(p, y)
+            dm_bad.observe(scores=p, fields={"uid": u})
+            p, y, u = healthy_batch()
+            qt_ok.update_scores(p, y)
+            dm_ok.observe(scores=p, fields={"uid": u})
+        assert hm_bad.status() == health.OK
+        assert hm_ok.status() == health.OK
+        assert dm_bad.snapshot()["reference_frozen"]
+
+        # perturb ONLY the bad component: labels flipped (calibration +
+        # AUC inversion), scores reshaped and uid vocabulary collapsed
+        # (covariate shift); the control keeps its healthy stream
+        for _ in range(2):
+            p, y, _ = healthy_batch()
+            qt_bad.update_scores(p, 1.0 - y)
+            dm_bad.observe(scores=rng.beta(8, 2, 512),
+                           fields={"uid": rng.integers(0, 4, size=512)})
+            p, y, u = healthy_batch()
+            qt_ok.update_scores(p, y)
+            dm_ok.observe(scores=p, fields={"uid": u})
+
+        v = hm_bad.verdict()
+        assert v["status"] == health.UNHEALTHY
+        assert v["detectors"]["calibration"]["status"] == health.UNHEALTHY
+        assert v["detectors"]["drift"]["status"] == health.UNHEALTHY
+        assert v["detectors"]["auc_regression"]["status"] != health.OK
+        assert hm_ok.status() == health.OK
+
+        # /healthz: 503 naming the tripped component, control visible ok
+        code, body = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/healthz")
+        assert code == 503
+        assert body["components"]["qual_bad"]["status"] == health.UNHEALTHY
+        assert body["components"]["qual_ok"]["status"] == health.OK
+
+        # /qualityz carries all four providers
+        code, qz = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/qualityz")
+        assert code == 200
+        for name in ("qual_bad", "qual_ok", "qual_bad_serve",
+                     "qual_ok_serve"):
+            assert name in qz["quality"], name
+
+        # the anomaly dump landed and its quality section is readable
+        bundles = sorted(fdir.glob("flight-*.jsonl"))
+        assert bundles, "no anomaly-time flight bundle"
+        rep = trace_report.summarize_flight(str(bundles[-1]))
+        assert rep["reason"].startswith("health:qual_bad:")
+        assert "quality:qual_bad" in rep["quality"]
+        assert rep["quality"]["quality:qual_bad"]["quality"] is True
+        assert rep["health"]["qual_bad"]["status"] == health.UNHEALTHY
+    finally:
+        for c in (qt_bad, qt_ok, dm_bad, dm_ok):
+            c.close()
+        hm_bad.close()
+        hm_ok.close()
+        flight.uninstall()
+        obs.configure_event_log()
+        srv.close()
+
+
+# -- quality-gated promotion -------------------------------------------------
+
+
+def _gate_fixture(rng, tmp_path):
+    """A widedeep serving model with a REAL ranking signal (non-zero wide
+    weights — init zeroes them) and a labeled replay slice drawn AT the
+    incumbent's scores, so the incumbent is calibrated by construction."""
+    params = widedeep.init(jax.random.PRNGKey(7), F, field_cnt=3,
+                           factor_dim=4)
+    np_params = {k: (np.asarray(v) if not isinstance(v, dict)
+                     else {kk: np.asarray(vv) for kk, vv in v.items()})
+                 for k, v in params.items()}
+    np_params["w"] = np.random.default_rng(42).normal(
+        0.0, 0.6, size=F).astype(np.float32)
+    model = serve.ServingModel("widedeep", np_params)
+    replay = []
+    for _ in range(4):
+        b = {
+            "fids": rng.integers(1, F, size=(64, 3)).astype(np.int32),
+            "vals": np.ones((64, 3), np.float32),
+            "rep_fids": rng.integers(1, F, size=(64, 3)).astype(np.int32),
+            "rep_mask": np.ones((64, 3), np.float32),
+        }
+        s = np.asarray(model.score(b))
+        b["labels"] = (rng.random(64) < s).astype(np.float32)
+        replay.append(b)
+    reg = obs.MetricsRegistry()
+    sw = online.ModelSwapper(model, replay, tolerance=0.9, registry=reg,
+                             quality_margin=0.05, auc_margin=0.01,
+                             quality_min_count=128)
+    return np_params, model, sw, reg
+
+
+def test_swap_gate_refuses_miscalibrated_candidate(tmp_path, rng):
+    """A temperature-scaled export parity-checks FINE under the loose
+    tolerance (scores move smoothly toward 0.5) but is the wrong model
+    to promote: the quality gate refuses it on ECE + sketch-AUC, while
+    an export of the live weights still swaps in."""
+    np_params, model, sw, reg = _gate_fixture(rng, tmp_path)
+    d = str(tmp_path)
+
+    good = online.publish_export(d, np_params, model="widedeep", step=1,
+                                 codec="fp32")
+    assert sw.offer(good) is True
+    assert model.version == 1
+    assert sw.last_quality is not None
+    assert sw.last_quality["refuse"] is False
+
+    cold = {k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in np_params.items()}
+    cold["w"] = np_params["w"] / 4.0
+    scaled = online.publish_export(d, cold, model="widedeep", step=2,
+                                   codec="fp32")
+    assert sw.offer(scaled) is False
+    assert model.version == 1  # the live model is untouched
+    st = sw.stats()
+    assert st["refusals"] == {"quality": 1}
+    lq = st["last_quality"]
+    assert lq["refuse"] is True and lq["count"] == 256
+    assert lq["candidate_ece"] > lq["incumbent_ece"]
+    assert lq["candidate_auc"] < lq["incumbent_auc"]
+    assert reg.snapshot()["counters"][obs.labeled(
+        "online_swap_refused_total", reason="quality")] == 1
+
+
+_CHILD_EXPORT_SCRIPT = """
+import sys
+sys.path.insert(0, %(root)r)
+import numpy as np
+flat = dict(np.load(%(base)r))
+params = {}
+for k, v in flat.items():
+    if "." in k:
+        top, leaf = k.split(".", 1)
+        params.setdefault(top, {})[leaf] = v
+    else:
+        params[k] = v
+params["w"] = params["w"] / 4.0  # the miscalibrated (cold) head
+from lightctr_tpu.online import swap
+path = swap.publish_export(%(dir)r, params, model="widedeep", step=7,
+                           codec="fp32")
+print("PUBLISHED", path)
+"""
+
+
+def test_swap_gate_refusal_crosses_process_boundary(tmp_path, rng):
+    """Acceptance: the miscalibrated export is PUBLISHED BY ANOTHER
+    PROCESS through the real artifact hand-off and refused by this one's
+    gate — the quality verdict lives entirely in the sketch contract,
+    not in shared in-process state."""
+    np_params, model, sw, reg = _gate_fixture(rng, tmp_path)
+    base = str(tmp_path / "base_params.npz")
+    flat = {}
+    for k, v in np_params.items():
+        if isinstance(v, dict):
+            for kk, vv in v.items():
+                flat[f"{k}.{kk}"] = vv
+        else:
+            flat[k] = v
+    np.savez(base, **flat)
+    script = _CHILD_EXPORT_SCRIPT % {
+        "root": REPO_ROOT, "base": base, "dir": str(tmp_path / "exports")}
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=180, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr
+    path = out.stdout.strip().split()[-1]
+    assert os.path.exists(path)
+    assert sw.offer(path) is False
+    assert sw.stats()["refusals"] == {"quality": 1}
+    assert sw.last_quality["refuse"] is True
+    assert model.version == 0  # never promoted

@@ -45,6 +45,11 @@ turns either into something readable:
       #    skew, scrape-down members listed — from a ClusterRollup
       #    members() dump, a {member: stats-or-snapshot} map, or a
       #    ShardedPSClient.stats() list
+  python -m tools.metrics_report --quality SNAPSHOT_JSON
+      # -> model-quality report (docs/OBSERVABILITY.md "Model-quality
+      #    plane"): per-component streaming calibration ratio,
+      #    sketch-AUC, logloss EWMA vs frozen baseline, per-field drift
+      #    scores, feature-coverage totals, worst-drift pointer
 """
 
 from __future__ import annotations
@@ -533,6 +538,64 @@ def summarize_online(doc) -> dict:
     return report
 
 
+def summarize_quality(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> model-quality report (docs/OBSERVABILITY.md
+    "Model-quality plane"): per-component streaming calibration ratio,
+    sketch-AUC, logloss EWMA vs frozen baseline, examples/windows
+    sketched, per-field drift scores, and feature-coverage totals.
+    Every series here is declared in
+    ``lightctr_tpu.obs.quality.QUALITY_SERIES`` (lint-enforced)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+
+    def _labels(name, prefix):
+        return dict(
+            part.split("=", 1)
+            for part in name[len(prefix) + 1:-1].replace('"', "").split(",")
+        )
+
+    comps: dict = {}
+
+    def _comp(labels):
+        return comps.setdefault(labels.get("component", "?"), {})
+
+    for prefix, key in (("quality_examples_total", "examples"),
+                        ("quality_windows_total", "windows")):
+        for name, val in counters.items():
+            if name.startswith(prefix + "{"):
+                _comp(_labels(name, prefix))[key] = int(val)
+    for prefix, key in (("quality_calibration_ratio", "calibration_ratio"),
+                        ("quality_auc", "auc"),
+                        ("quality_logloss_ewma", "logloss_ewma"),
+                        ("quality_logloss_baseline", "logloss_baseline")):
+        for name, val in gauges.items():
+            if name.startswith(prefix + "{"):
+                _comp(_labels(name, prefix))[key] = round(float(val), 6)
+    prefix = "quality_drift_score"
+    for name, val in gauges.items():
+        if name.startswith(prefix + "{"):
+            labels = _labels(name, prefix)
+            _comp(labels).setdefault("drift", {})[
+                labels.get("field", "?")] = round(float(val), 6)
+    prefix = "quality_coverage_total"
+    for name, val in counters.items():
+        if name.startswith(prefix + "{"):
+            labels = _labels(name, prefix)
+            _comp(labels).setdefault("coverage", {})[
+                labels.get("field", "?")] = int(val)
+    report: dict = {"components": {k: comps[k] for k in sorted(comps)}}
+    worst = None
+    for comp, entry in comps.items():
+        for field, score in entry.get("drift", {}).items():
+            if worst is None or score > worst["score"]:
+                worst = {"component": comp, "field": field, "score": score}
+    if worst is not None:
+        report["worst_drift"] = worst
+    return report
+
+
 def summarize_cluster(doc) -> dict:
     """Cluster rollup dump -> straggler/rollup report.  Accepts the
     :meth:`~lightctr_tpu.obs.cluster.ClusterRollup.members` dict, a bare
@@ -609,6 +672,11 @@ def main(argv=None):
                     help="cluster straggler report from a ClusterRollup "
                          "members() dump, {member: stats} map, or "
                          "ShardedPSClient.stats() list")
+    ap.add_argument("--quality", metavar="SNAPSHOT_JSON",
+                    help="summarize the model-quality plane (calibration "
+                         "ratio, sketch-AUC, logloss EWMA vs baseline, "
+                         "drift scores, feature coverage) from a registry "
+                         "snapshot or stats() dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -680,11 +748,21 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.quality:
+        with open(args.quality) as f:
+            doc = json.load(f)
+        report = summarize_quality(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
                  "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, "
-                 "--cluster MEMBERS_JSON, or --online SNAPSHOT_JSON")
+                 "--cluster MEMBERS_JSON, --quality SNAPSHOT_JSON, or "
+                 "--online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

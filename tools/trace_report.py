@@ -292,6 +292,17 @@ def summarize_flight(path: str) -> Dict:
             }
             for h in health
         }
+    # model-quality sketches ride the bundle as extra registries named
+    # quality:<component> whose snapshots self-mark with "quality": True
+    # — a calibration/AUC/drift postmortem needs the sketch state AT the
+    # dump, not whatever the live process has rolled to since
+    quality = {
+        m.get("registry", "?"): m.get("snapshot", {})
+        for m in metrics
+        if m.get("snapshot", {}).get("quality")
+    }
+    if quality:
+        report["quality"] = quality
     # surface the headline counters — the numbers a postmortem reads first
     for m in metrics:
         c = m.get("snapshot", {}).get("counters", {})
