@@ -84,6 +84,7 @@ from lightctr_tpu.dist.ps_server import (
     _recv_msg,
 )
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import resources as obs_resources
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import (
     MetricsRegistry,
@@ -611,6 +612,14 @@ class SparseReduceShard:
                 del self._rounds[(epoch, table)]
             return out
 
+    def memory_bytes(self) -> Dict[str, int]:
+        """One-call ``obs.resources.MemorySampler`` source: the live
+        round high-water mark lands in ``resource_memory_bytes{kind=
+        "<prefix>_peak_round"}`` next to host RSS and the tiered-store
+        tiers, budget-checkable by the memory_pressure detector."""
+        with self._lock:
+            return {"peak_round": int(self._peak_round_bytes)}
+
     def stats(self) -> Dict:
         with self._lock:
             out = dict(self._counts)
@@ -952,8 +961,15 @@ class HierExchangeClient:
         # in-flight frame futures `commit` joins
         self._pools: List[Optional[ThreadPoolExecutor]] = \
             [None] * self.n_shards
+        # (future, dispatch-stamp) pairs: commit's join turns the stamps
+        # into resource_queue_wait_seconds{queue=hier_stripe_inflight}
         self._inflight: List = []
         self._inflight_lock = threading.Lock()
+        # resource-plane face of the stripe pipelines (capacity-less:
+        # depth/wait series only — backpressure is the commit join)
+        self._inflight_iq = obs_resources.InstrumentedQueue(
+            "hier_stripe_inflight", registry=self.registry,
+            register=False)
         # chunk-fill accounting (rows shipped vs rows the dispatched
         # windows could hold) — the trainer's chunk telemetry reads these
         self.chunk_pushes_total = 0
@@ -1077,7 +1093,10 @@ class HierExchangeClient:
 
         fut = self._pool(s).submit(_send)
         with self._inflight_lock:
-            self._inflight.append(fut)
+            self._inflight.append((fut, time.monotonic()))
+            depth = len(self._inflight)
+        self._inflight_iq.note_enqueue()
+        self._inflight_iq.set_depth(depth)
 
     def commit(self) -> None:
         """Join every dispatched push frame — the commit half of the
@@ -1087,12 +1106,15 @@ class HierExchangeClient:
         with self._inflight_lock:
             pending, self._inflight = self._inflight, []
         err = None
-        for fut in pending:
+        for fut, t0 in pending:
             try:
                 fut.result()
+                self._inflight_iq.note_wait(time.monotonic() - t0)
             except BaseException as e:
                 if err is None:
                     err = e
+        if pending:
+            self._inflight_iq.set_depth(0)
         if err is not None:
             raise err
 

@@ -45,6 +45,7 @@ from lightctr_tpu.obs import exporter as obs_exporter
 from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
+from lightctr_tpu.obs import resources as obs_resources
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.cluster import ClusterRollup, attribute_stragglers
 from lightctr_tpu.obs.quality import quality_rollup
@@ -201,6 +202,8 @@ class MasterService:
         self._scrape_clients: dict = {}
         self._scrape_extra = [(str(n), tuple(a))
                               for n, a in (scrape_targets or [])]
+        self._scrape_iq: Optional[obs_resources.InstrumentedQueue] = None
+        self._ring_watch: Optional[obs_resources.EventRingWatch] = None
         if scrape_period_s is not None:
             if scrape_period_s <= 0:
                 raise ValueError("scrape_period_s must be positive")
@@ -213,12 +216,20 @@ class MasterService:
                     or "/stragglerz" in obs_exporter.json_routes():
                 logging.getLogger(__name__).warning(
                     "another cluster rollup is registered in this "
-                    "process; /stragglerz, /qualityz and /metrics now "
-                    "serve THIS master's view"
+                    "process; /stragglerz, /qualityz, /resourcez and "
+                    "/metrics now serve THIS master's view"
                 )
+            # sweep saturation telemetry: depth = members pending this
+            # sweep, wait = whole-sweep seconds (a sweep that stops
+            # fitting inside scrape_period_s shows up here first), plus
+            # the event ring's occupancy sampled once per sweep
+            self._scrape_iq = obs_resources.InstrumentedQueue(
+                "master_scrape", register=False)
+            self._ring_watch = obs_resources.EventRingWatch(register=False)
             obs_flight.register_registry("cluster", self.rollup)
             obs_exporter.register_json_route("/stragglerz", self.stragglerz)
             obs_exporter.register_json_route("/qualityz", self.qualityz)
+            obs_exporter.register_json_route("/resourcez", self.resourcez)
             self._scrape_thread = threading.Thread(
                 target=self._scrape_loop, name="master-scrape", daemon=True,
             )
@@ -871,7 +882,12 @@ class MasterService:
         socket timeout, never the admin lock."""
         if self.rollup is None:
             return
-        for name, addr in self._scrape_targets_now():
+        targets = self._scrape_targets_now()
+        t0 = time.monotonic()
+        if self._scrape_iq is not None:
+            self._scrape_iq.note_enqueue(len(targets))
+            self._scrape_iq.set_depth(len(targets))
+        for i, (name, addr) in enumerate(targets):
             c = self._scrape_clients.get(name)
             try:
                 if c is None:
@@ -887,6 +903,15 @@ class MasterService:
                         pass
                 self._scrape_clients[name] = None
                 self.rollup.mark_down(name, e)
+                # a down member is work this sweep refused to finish
+                if self._scrape_iq is not None:
+                    self._scrape_iq.note_drop()
+            if self._scrape_iq is not None:
+                self._scrape_iq.set_depth(len(targets) - i - 1)
+        if self._scrape_iq is not None:
+            self._scrape_iq.note_wait(time.monotonic() - t0)
+        if self._ring_watch is not None:
+            self._ring_watch.sample()
 
     def _scrape_loop(self) -> None:
         while not self._scrape_stop.wait(self.scrape_period_s):
@@ -914,6 +939,16 @@ class MasterService:
                              "(set scrape_period_s)"}
         return quality_rollup(self.rollup.members())
 
+    def resourcez(self) -> dict:
+        """Cluster-wide resource rollup — per-member ``resource_*``
+        series merged from the scraped snapshots plus the fullest-queue
+        and most-compiles verdicts, the ``/resourcez`` ops route's
+        payload on the master (obs/resources.py)."""
+        if self.rollup is None:
+            return {"error": "cluster scrape loop not armed "
+                             "(set scrape_period_s)"}
+        return obs_resources.resource_rollup(self.rollup.members())
+
     def close(self) -> None:
         self.monitor.stop()
         if self._scrape_thread is not None:
@@ -929,9 +964,18 @@ class MasterService:
             if obs_exporter.json_routes().get("/qualityz") \
                     == self.qualityz:
                 obs_exporter.unregister_json_route("/qualityz")
+            if obs_exporter.json_routes().get("/resourcez") \
+                    == self.resourcez:
+                obs_exporter.unregister_json_route("/resourcez")
             if obs_flight.registered_registries().get("cluster") \
                     is self.rollup:
                 obs_flight.unregister_registry("cluster")
+        if self._ring_watch is not None:
+            self._ring_watch.close()
+            self._ring_watch = None
+        if self._scrape_iq is not None:
+            self._scrape_iq.close()
+            self._scrape_iq = None
         for c in self._scrape_clients.values():
             if c is not None:
                 try:

@@ -76,6 +76,7 @@ from lightctr_tpu.embed.mmap_store import (
 )
 from lightctr_tpu.native import bindings
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import resources as obs_resources
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import MetricsRegistry, labeled
 
@@ -500,6 +501,12 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         self._prefetch_enabled = bool(prefetch)
         self._pf_thread = None
         self._pf_queue = None
+        # resource-plane face of the ticket queue: depth/drop/wait land in
+        # the store registry as resource_queue_* series (NOT tiered_* —
+        # the TIER_SERIES lint covers only this module's own emissions)
+        self._pf_iq = obs_resources.InstrumentedQueue(
+            "tiered_prefetch", capacity=2, registry=self.registry,
+            register=False)
         self._pf_cond = threading.Condition()
         self._pf_ticket = 0
         self._pf_completed = 0
@@ -606,7 +613,9 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             # (donation is a no-op copy where the backend declines it).
             cls._DEV_FNS = {
                 "gather": gather,
-                "scatter": jax.jit(scatter, donate_argnums=(0,)),
+                "scatter": obs_resources.track_jit(
+                    "tiered_dev_scatter",
+                    jax.jit(scatter, donate_argnums=(0,))),
             }
         return cls._DEV_FNS
 
@@ -728,7 +737,7 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             self._pf_ticket += 1
             ticket = self._pf_ticket
         try:
-            self._pf_queue.put_nowait((ticket, keys_arr))
+            self._pf_queue.put_nowait((ticket, keys_arr, time.monotonic()))
         except Exception:
             # double-buffer full: this batch reads synchronously.  The
             # ticket completes immediately so prefetch_wait never hangs.
@@ -736,7 +745,10 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
                 if ticket > self._pf_completed:
                     self._pf_completed = ticket
                 self._pf_cond.notify_all()
+            self._pf_iq.note_drop()
             return 0
+        self._pf_iq.note_enqueue()
+        self._pf_iq.set_depth(self._pf_queue.qsize())
         return ticket
 
     def prefetch_wait(self, ticket: Optional[int] = None,
@@ -778,7 +790,9 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             item = self._pf_queue.get()
             if item is None:
                 return
-            ticket, keys_arr = item
+            ticket, keys_arr, t_enq = item
+            self._pf_iq.note_wait(time.monotonic() - t_enq)
+            self._pf_iq.set_depth(self._pf_queue.qsize())
             try:
                 self._pf_stage_batch(keys_arr)
             except Exception:
@@ -2292,6 +2306,24 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
         tested against."""
         with self._lock:
             return int(len(self._all_keys_locked()))
+
+    def memory_bytes(self) -> Dict[str, int]:
+        """Resident bytes per tier, shaped for a
+        :class:`~lightctr_tpu.obs.resources.MemorySampler` source — the
+        dict fans out to ``resource_memory_bytes{kind=<name>_<tier>}``.
+        Hot/warm count ``[row || accum]`` fp32 pairs (dim*8 bytes/row,
+        the same arithmetic as ``tiered_bytes_resident``); cold is the
+        mmap log's file footprint; the device block doubles the hot
+        bytes when the pinned HBM copy exists."""
+        with self._lock:
+            out = {
+                "hot": self.hot_rows * self.dim * 8,
+                "warm": len(self._warm) * self.dim * 8,
+                "cold": int(self._cold.stats().get("file_bytes", 0)),
+            }
+            if self.device_hot:
+                out["device_block"] = self.hot_rows * self.dim * 8
+        return out
 
     def stats(self) -> Dict:
         """The flat store's stats shape + the per-tier ``store`` section

@@ -29,6 +29,7 @@ from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
 from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.obs import quality as quality_mod
+from lightctr_tpu.obs import resources as resources_mod
 from lightctr_tpu.obs import stepwatch as stepwatch_mod
 from lightctr_tpu.obs import trace as trace_mod
 from lightctr_tpu.utils.profiling import annotate
@@ -140,6 +141,7 @@ class CTRTrainer:
         fused_adagrad: bool = False,
         zero_sharded: bool = False,
         quality_bins: Optional[int] = None,
+        resources: Optional[bool] = None,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -261,6 +263,18 @@ class CTRTrainer:
         # feed and marks phases (input/exec/exchange/apply) as the step
         # moves, so a trip names where it is stuck.
         self.stepwatch = stepwatch_mod.maybe_from_env(self.health)
+        # resource watch (obs/resources.py): when armed (ctor arg or
+        # LIGHTCTR_RESOURCES) a per-trainer CompileTracker polls this
+        # trainer's live jit cache-entry counts every few steps and feeds
+        # the recompile-storm detector — a shape leak (unpadded batch
+        # tails churning the ladder) becomes a /healthz trip instead of a
+        # silent retrace-per-step slowdown.
+        self.resources: Optional[resources_mod.CompileTracker] = None
+        if resources_mod.resolve_armed(resources):
+            self.resources = resources_mod.CompileTracker(
+                component="trainer", registry=self.telemetry,
+                monitor=self.health,
+            )
         self._steps_seen = 0
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
@@ -268,6 +282,9 @@ class CTRTrainer:
         self._step = jax.jit(self._build_step(), donate_argnums=(0, 1))
         self._logits_j = jax.jit(self.logits_fn)
         self._scan_cache: Dict[int, Callable] = {}
+        if self.resources is not None:
+            self.resources.track("trainer_step", self._step)
+            self.resources.track("trainer_logits", self._logits_j)
 
     def _build_step(self):
         """The training step: plain (XLA inserts psum for sharded batches),
@@ -634,6 +651,8 @@ class CTRTrainer:
             examples=n, **self._step_event_fields(),
         )
         self._feed_health(batch, health)
+        if self.resources is not None:
+            self.resources.note_step()
         if self.stepwatch is not None:
             self.stepwatch.step_completed(dt)
 

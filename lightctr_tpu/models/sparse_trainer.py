@@ -348,6 +348,12 @@ class SparseTableCTRTrainer(CTRTrainer):
             # the base ctor jitted _build_step()'s program; the hier step
             # is a HOST orchestrator around two jitted programs instead
             self._step = self._hier_step
+            if self.resources is not None:
+                # the pow2-padded hier program family: cache-entry growth
+                # here is the ladder warming (expected) or a shape leak
+                # (the recompile-storm detector's case)
+                self.resources.track("hier_local_step", self._hier_local_j)
+                self.resources.track("hier_apply_step", self._hier_apply_j)
         # table trainers also watch per-table touched-uid skew (the same
         # id streams the sparse exchange dedups — hot/dead detection)
         if self.health is not None:

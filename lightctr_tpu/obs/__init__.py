@@ -47,6 +47,12 @@ Entry points
     trainer health vector, label-free score/coverage drift for serving,
     calibration/AUC-regression/drift detectors, and ``/qualityz``;
     ``LIGHTCTR_QUALITY=1`` arms the trainer sketch.
+``resources`` (submodule)
+    resource & saturation plane — jit recompile/cache tracking, queue
+    depth/capacity/wait telemetry, memory-pressure accounting;
+    recompile-storm/queue-saturation/memory-pressure detectors and
+    ``/resourcez``; ``LIGHTCTR_RESOURCES=1`` arms the trainer compile
+    watch.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -76,6 +82,7 @@ from lightctr_tpu.obs import exporter  # noqa: F401  (HTTP ops endpoints)
 from lightctr_tpu.obs import stepwatch  # noqa: F401  (stall watchdog)
 from lightctr_tpu.obs import cluster  # noqa: F401  (cluster rollup)
 from lightctr_tpu.obs import quality  # noqa: F401  (model-quality plane)
+from lightctr_tpu.obs import resources  # noqa: F401  (resource/saturation plane)
 
 # LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
 # inherits the variable — the multi-process PS run's postmortem switch

@@ -38,6 +38,7 @@ import numpy as np
 
 from lightctr_tpu.obs import events as events_mod
 from lightctr_tpu.obs import gate as obs_gate
+from lightctr_tpu.obs import resources as obs_resources
 
 _LOG = logging.getLogger(__name__)
 
@@ -150,8 +151,12 @@ class OnlineTrainer:
                     return loss, jax.nn.sigmoid(z)
                 return loss
 
-            self._grads_fn = jax.jit(
-                jax.value_and_grad(fm_loss, has_aux=aux)
+            # the online loop pads ids to one fixed width precisely so
+            # this cache holds ONE program — the process compile tracker
+            # makes a width leak a recompile_storm trip, not a mystery
+            self._grads_fn = obs_resources.track_jit(
+                "online_grads_fm",
+                jax.jit(jax.value_and_grad(fm_loss, has_aux=aux)),
             )
         else:
             from lightctr_tpu.models import widedeep
@@ -167,9 +172,10 @@ class OnlineTrainer:
                     return loss, jax.nn.sigmoid(z)
                 return loss
 
-            self._grads_fn = jax.jit(
-                jax.value_and_grad(wd_loss, argnums=(0, 1, 2, 3),
-                                   has_aux=aux)
+            self._grads_fn = obs_resources.track_jit(
+                "online_grads_widedeep",
+                jax.jit(jax.value_and_grad(wd_loss, argnums=(0, 1, 2, 3),
+                                           has_aux=aux)),
             )
         self._aux = aux
         self._jnp = jnp

@@ -145,8 +145,15 @@ class ServingModel:
                 full[leaf] = sub[:, 0] if squeeze else sub
             return sigmoid(self.logits_fn(full, batch))
 
-        self._jit_local = jax.jit(_score_local)
-        self._jit_rows = jax.jit(_score_rows)
+        # the pow2-padded scorer ladders: registered with the process
+        # compile tracker so /resourcez shows their live cache-entry
+        # counts and a shape leak trips the recompile-storm detector
+        from lightctr_tpu.obs import resources as obs_resources
+
+        self._jit_local = obs_resources.track_jit(
+            f"serve_score_local_{kind}", jax.jit(_score_local))
+        self._jit_rows = obs_resources.track_jit(
+            f"serve_score_rows_{kind}", jax.jit(_score_rows))
 
     # -- dense hot-swap ------------------------------------------------------
 

@@ -64,6 +64,7 @@ from lightctr_tpu.obs import flight as obs_flight
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import health as obs_health
 from lightctr_tpu.obs import quality as obs_quality
+from lightctr_tpu.obs import resources as obs_resources
 from lightctr_tpu.obs import trace as obs_trace
 from lightctr_tpu.obs.registry import (
     MetricsRegistry,
@@ -176,6 +177,14 @@ class PredictionServer:
         self.drift = drift
         if drift is not None and drift.monitor is None:
             drift.bind_monitor(self.health)
+        # resource plane (obs/resources.py): micro-batch queue saturation
+        # telemetry — depth/capacity against the row bound, per-request
+        # queue wait; a sustained-full queue degrades /healthz BEFORE
+        # admission control starts shedding
+        self._rq = obs_resources.InstrumentedQueue(
+            f"{self._flight_name}_queue", capacity=self.queue_cap,
+            registry=self.registry, monitor=self.health,
+        )
         self._slo_feed_every = max(1, int(slo_feed_every))
         self._slo_prev_counts: Optional[List[int]] = None
         self._batches_scored = 0
@@ -222,11 +231,20 @@ class PredictionServer:
         item = _Pending(arrays, n, now, now + self.deadline_s)
         with self._cond:
             if self._queue_rows + n > self.queue_cap:
-                return None
-            self._queue.append(item)
-            self._queue_rows += n
-            self._cond.notify()
-        return item
+                depth, admitted = self._queue_rows, False
+            else:
+                self._queue.append(item)
+                self._queue_rows += n
+                depth, admitted = self._queue_rows, True
+                self._cond.notify()
+        # resource telemetry outside the queue lock: the saturation feed
+        # can trigger a flight dump, which must not block admission
+        if admitted:
+            self._rq.note_enqueue(n)
+        else:
+            self._rq.note_drop(n)
+        self._rq.set_depth(depth)
+        return item if admitted else None
 
     def _shed(self, reason: str, n: int = 1) -> None:
         if obs_gate.enabled():
@@ -350,10 +368,15 @@ class PredictionServer:
                 batch.append(self._queue.pop(0))
                 rows += item.n
             self._queue_rows -= rows
+            depth = self._queue_rows
             if obs_gate.enabled():
-                self.registry.gauge_set("serve_queue_rows",
-                                        self._queue_rows)
-            return batch
+                self.registry.gauge_set("serve_queue_rows", depth)
+        if batch:
+            now = time.monotonic()
+            for item in batch:
+                self._rq.note_wait(now - item.t_in)
+        self._rq.set_depth(depth)
+        return batch
 
     @staticmethod
     def _concat(items: List[_Pending]) -> Dict:
@@ -630,6 +653,7 @@ class PredictionServer:
         with self._cond:
             self._cond.notify_all()
         obs_flight.unregister_registry(self._flight_name)
+        self._rq.close()
         if self.drift is not None:
             self.drift.close()
         if self._owns_health:

@@ -1,0 +1,763 @@
+"""Resource & saturation observability plane (ISSUE 18): the process
+compile tracker over live jit caches, queue depth/capacity/wait
+telemetry, memory-pressure accounting, the recompile-storm /
+queue-saturation / memory-pressure detectors riding the PR-4 hysteresis
+machine, the ``/resourcez`` route and cluster rollup, the report tooling
+(``metrics_report --resources``, the flight bundle's resources section),
+the <5% overhead guard WITH the plane armed, the perf-regression
+trajectory (``tools/bench_history.py``), and the two acceptance paths:
+a shape-churning loop trips the storm detector (503 + flight bundle)
+while the pow2-padded control stays ok, and a slow-scorer serve burst
+trips the saturation detector BEFORE admission control sheds."""
+
+import ast
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from lightctr_tpu import TrainConfig, obs, serve
+from lightctr_tpu.models import fm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.obs import exporter, flight, health, resources
+from lightctr_tpu.obs import trace as trace_mod
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_ROOT = Path(REPO_ROOT) / "lightctr_tpu"
+
+F, K = 256, 8
+
+
+def _monitor(**kw):
+    kw.setdefault("registry", obs.MetricsRegistry())
+    kw.setdefault("flight_min_interval_s", 0.0)
+    return health.HealthMonitor(**kw)
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read()
+            code = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+    try:
+        return code, json.loads(body)
+    except json.JSONDecodeError:
+        return code, body.decode()
+
+
+def _toy_trainer(d=32, **kw):
+    params = {"w": np.zeros((d,), np.float32)}
+    return CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
+                      TrainConfig(learning_rate=0.1), **kw)
+
+
+# -- series lint (the TIER/QUALITY_SERIES contract) --------------------------
+
+
+def test_every_resource_series_is_declared_and_emitted():
+    """No dark resource series: every ``resource_*`` metric
+    obs/resources.py EMITS (a literal first argument of a registry
+    ``inc``/``gauge_set``/``observe`` call, directly or through
+    ``labeled(...)``) must be declared in ``RESOURCE_SERIES`` — and
+    every declared series must actually be emitted.  Wiring files
+    (serve/server.py, embed/tiered.py, dist/hier.py, dist/master.py) go
+    through the helpers here, so this one lint covers the family."""
+    src = (LIB_ROOT / "obs" / "resources.py").read_text()
+    tree = ast.parse(src, filename="obs/resources.py")
+
+    emitted = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "gauge_set", "observe")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                and arg.func.id == "labeled" and arg.args):
+            arg = arg.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("resource_"):
+            emitted.add(arg.value)
+
+    declared = set(resources.RESOURCE_SERIES)
+    assert emitted, "no resource_* emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "resource_* series emitted but missing from RESOURCE_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "RESOURCE_SERIES declares series never emitted "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(resources.RESOURCE_SERIES) == len(declared), \
+        "duplicate names in RESOURCE_SERIES"
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+def test_recompile_storm_detector_warmup_band_and_hard_band():
+    det = resources.RecompileStormDetector(
+        warmup_steps=4, max_per_step=0.5, hard_factor=2.0, min_steps=2)
+
+    def sig(total, steps, compiles, per_fn=None):
+        return {"recompile": {"total_steps": total, "steps": steps,
+                              "compiles": compiles,
+                              "per_fn": per_fn or {}}}
+
+    # the pow2 ladder legitimately compiles during warmup
+    st, detail = det.check(sig(3, 3, 3))
+    assert st == health.OK and detail["skipped"] == "warmup"
+    # short window: no verdict
+    st, detail = det.check(sig(10, 1, 1))
+    assert st == health.OK and detail["skipped"] == "window"
+    # steady state under the band
+    st, detail = det.check(sig(20, 16, 2))
+    assert st == health.OK and detail["rate"] == 0.125
+    # past the band: degraded, naming the worst offender
+    st, detail = det.check(sig(40, 8, 6, per_fn={"a": 1, "b": 5}))
+    assert st == health.DEGRADED and detail["worst_fn"] == "b"
+    # past the hard band: unhealthy
+    st, detail = det.check(sig(60, 8, 9))
+    assert st == health.UNHEALTHY and detail["rate"] > 1.0
+
+
+def test_queue_saturation_detector_requires_sustained_fill():
+    det = resources.QueueSaturationDetector(
+        degraded_fill=0.8, unhealthy_fill=0.95, sustain=3, min_capacity=2)
+
+    def sig(queue, depth, cap):
+        return {"queue_saturation": {"queue": queue, "depth": depth,
+                                     "capacity": cap}}
+
+    # tiny queues never judged
+    st, detail = det.check(sig("tiny", 1, 1))
+    assert st == health.OK and detail["skipped"] == "capacity"
+    # one full observation is micro-batching working, not saturation
+    assert det.check(sig("q", 10, 10))[0] == health.OK
+    assert det.check(sig("q", 9, 10))[0] == health.OK
+    # a dip resets the streak: three MORE fulls needed
+    assert det.check(sig("q", 2, 10))[0] == health.OK
+    assert det.check(sig("q", 9, 10))[0] == health.OK
+    assert det.check(sig("q", 9, 10))[0] == health.OK
+    st, detail = det.check(sig("q", 9, 10))
+    assert st == health.DEGRADED
+    assert detail["sustained_queue"] == "q" and detail["sustained"] == 3
+    # sustained past the unhealthy band upgrades the verdict
+    for _ in range(2):
+        det.check(sig("q", 10, 10))
+    st, _ = det.check(sig("q", 10, 10))
+    assert st == health.UNHEALTHY
+    # independent queues keep independent streaks
+    assert det.check(sig("other", 1, 10))[0] == health.UNHEALTHY
+
+
+def test_memory_pressure_detector_judges_only_budgeted_kinds():
+    det = resources.MemoryPressureDetector(degraded=0.85, unhealthy=0.95)
+    st, detail = det.check({"memory_pressure": {
+        "bytes": {"host_rss": 10**9}, "budgets": {}}})
+    assert st == health.OK and detail["skipped"] == "no budgets"
+    st, _ = det.check({"memory_pressure": {
+        "bytes": {"host_rss": 50, "tiered_hot": 10},
+        "budgets": {"host_rss": 100, "tiered_hot": 100}}})
+    assert st == health.OK
+    st, detail = det.check({"memory_pressure": {
+        "bytes": {"host_rss": 50, "tiered_hot": 90},
+        "budgets": {"host_rss": 100, "tiered_hot": 100}}})
+    assert st == health.DEGRADED and detail["worst_kind"] == "tiered_hot"
+    st, detail = det.check({"memory_pressure": {
+        "bytes": {"host_rss": 99}, "budgets": {"host_rss": 100}}})
+    assert st == health.UNHEALTHY and detail["fraction"] == 0.99
+
+
+# -- the compile tracker -----------------------------------------------------
+
+
+def test_compile_tracker_counts_cache_growth_and_feeds_monitor():
+    reg = obs.MetricsRegistry()
+    hm = _monitor(component="ct_unit", trip_after=1, recover_after=1)
+    tr = resources.CompileTracker(
+        component="ct_unit", registry=reg, monitor=hm, poll_every=0,
+        detector_overrides={"recompile_storm": {
+            "warmup_steps": 0, "max_per_step": 0.5, "min_steps": 1}})
+    f = jax.jit(lambda x: x * 2.0)
+    tr.track("f", f)
+    try:
+        with obs.override(True):
+            for i in range(3):  # a NEW shape every step: the storm
+                f(np.zeros((i + 1,), np.float32))
+                tr.note_step()
+            sig = tr.poll()
+        assert sig["per_fn"]["f"] == 3 and sig["steps"] == 3
+        # real backend compiles surfaced via the jax.monitoring hook
+        assert sig["backend"] >= 3
+        snap = reg.snapshot()
+        assert snap["counters"][obs.labeled(
+            "resource_jit_compiles_total", fn="f")] == 3
+        assert snap["gauges"][obs.labeled(
+            "resource_jit_cache_entries", fn="f")] == 3
+        assert snap["counters"]["resource_backend_compiles_total"] >= 3
+        assert snap["histograms"]["resource_compile_seconds"]["count"] >= 3
+        # rate 1.0/step > band -> the monitor saw it
+        v = hm.verdict()
+        assert v["detectors"]["recompile_storm"]["status"] == health.DEGRADED
+        # flight + /resourcez lifecycle
+        assert "resources:ct_unit" in flight.registered_registries()
+        assert "ct_unit" in resources.resource_payload()["resources"]
+        s = tr.snapshot()
+        assert s["resources"] is True and s["fns"]["f"]["compiles"] == 3
+    finally:
+        tr.close()
+        hm.close()
+    assert "resources:ct_unit" not in flight.registered_registries()
+    assert "ct_unit" not in resources.resource_payload()["resources"]
+
+
+def test_track_jit_registers_with_the_process_tracker():
+    g = resources.track_jit("unit_g", jax.jit(lambda x: x + 1))
+    try:
+        assert float(g(1.0)) == 2.0  # the wrapper is returned unchanged
+        snap = resources.default_tracker().snapshot()
+        assert "unit_g" in snap["fns"]
+    finally:
+        resources.default_tracker().untrack("unit_g")
+
+
+# -- instrumented queues + event ring ----------------------------------------
+
+
+def test_instrumented_queue_series_and_saturation_feed():
+    reg = obs.MetricsRegistry()
+    hm = _monitor(component="iq_unit", trip_after=1, recover_after=1)
+    q = resources.InstrumentedQueue(
+        "unit_q", capacity=4, registry=reg, monitor=hm,
+        detector_overrides={"queue_saturation": {
+            "degraded_fill": 0.7, "sustain": 2}})
+    try:
+        with obs.override(True):
+            q.note_enqueue(3)
+            q.set_depth(2)
+            assert hm.status() == health.OK
+            q.set_depth(4)
+            q.set_depth(4)  # sustained past the band
+            q.note_wait(0.01)
+            q.note_drop()
+        assert q.fill() == 1.0
+        snap = reg.snapshot()
+        assert snap["gauges"][obs.labeled(
+            "resource_queue_depth", queue="unit_q")] == 4
+        assert snap["gauges"][obs.labeled(
+            "resource_queue_capacity", queue="unit_q")] == 4
+        assert snap["counters"][obs.labeled(
+            "resource_queue_enqueued_total", queue="unit_q")] == 3
+        assert snap["counters"][obs.labeled(
+            "resource_queue_dropped_total", queue="unit_q")] == 1
+        assert snap["histograms"][obs.labeled(
+            "resource_queue_wait_seconds", queue="unit_q")]["count"] == 1
+        v = hm.verdict()
+        assert v["detectors"]["queue_saturation"]["status"] \
+            == health.UNHEALTHY
+        p = q.payload()
+        assert p["resources"] is True and p["fill"] == 1.0
+        assert "queue:unit_q" in resources.resource_payload()["resources"]
+    finally:
+        q.close()
+        hm.close()
+    assert "queue:unit_q" not in resources.resource_payload()["resources"]
+
+
+def test_event_ring_watch_folds_overwrites_into_drops():
+    log = obs.EventLog(capacity=4)
+    w = resources.EventRingWatch(log=log, name="unit_ring",
+                                 registry=obs.MetricsRegistry(),
+                                 register=False)
+    try:
+        with obs.override(True):
+            for i in range(7):  # 3 past capacity: oldest overwritten
+                log.emit("tick", i=i)
+            w.sample()
+        p = w.queue.payload()
+        assert p["capacity"] == 4 and p["depth"] == len(log.records())
+        assert p["dropped"] == log.dropped > 0
+    finally:
+        w.close()
+
+
+# -- memory sampler ----------------------------------------------------------
+
+
+def test_memory_sampler_sources_budgets_and_detector():
+    reg = obs.MetricsRegistry()
+    hm = _monitor(component="mem_unit", trip_after=1, recover_after=1)
+    ms = resources.MemorySampler(
+        registry=reg, monitor=hm, budgets={"blob": 100.0},
+        name="mem_unit", register=False)
+    ms.add_source("blob", lambda: 96)
+    # dict sources fan out per kind (the tiered store's tiers)
+    ms.add_source("tiered", lambda: {"hot": 10, "warm": 20})
+    ms.add_source("broken", lambda: 1 / 0)  # skipped, never raises
+    try:
+        with obs.override(True):
+            flat = ms.sample()
+        assert flat["blob"] == 96 and flat["tiered_hot"] == 10
+        assert flat["tiered_warm"] == 20 and flat["host_rss"] > 0
+        assert "broken" not in flat
+        snap = reg.snapshot()
+        assert snap["gauges"][obs.labeled(
+            "resource_memory_bytes", kind="blob")] == 96
+        assert snap["gauges"][obs.labeled(
+            "resource_memory_budget_bytes", kind="blob")] == 100
+        v = hm.verdict()
+        assert v["detectors"]["memory_pressure"]["status"] \
+            == health.UNHEALTHY
+        assert v["detectors"]["memory_pressure"]["detail"]["worst_kind"] \
+            == "blob"
+        p = ms.payload()
+        assert p["resources"] is True and p["bytes"]["blob"] == 96
+    finally:
+        ms.close()
+        hm.close()
+
+
+def test_tiered_store_prefetch_queue_and_memory_source(rng):
+    from lightctr_tpu.embed.tiered import TieredEmbeddingStore
+
+    store = TieredEmbeddingStore(dim=8, hot_rows=16)
+    try:
+        keys = rng.integers(0, 1000, size=32).astype(np.int64)
+        with obs.override(True):
+            t = store.dispatch_prefetch(keys)
+            assert t > 0 and store.prefetch_wait(t, timeout=10.0)
+        p = store._pf_iq.payload()
+        assert p["enqueued"] >= 1 and p["waits"] >= 1
+        snap = store.registry.snapshot()
+        assert obs.labeled("resource_queue_capacity",
+                           queue="tiered_prefetch") in snap["gauges"]
+        mb = store.memory_bytes()
+        assert mb["hot"] == 16 * 8 * 8 and "warm" in mb and "cold" in mb
+        # the store is a one-call MemorySampler source
+        ms = resources.MemorySampler(registry=obs.MetricsRegistry(),
+                                     include_host=False, register=False)
+        ms.add_source("tiered", store.memory_bytes)
+        with obs.override(True):
+            flat = ms.sample()
+        assert flat["tiered_hot"] == mb["hot"]
+        ms.close()
+    finally:
+        store.close()
+
+
+def test_reduce_shard_peak_round_is_a_memory_source():
+    from lightctr_tpu.dist.hier import SparseReduceShard
+
+    shard = SparseReduceShard(n_hosts=1)
+    mb = shard.memory_bytes()
+    assert mb == {"peak_round": 0}
+    assert mb["peak_round"] == shard.stats()["peak_round_bytes"]
+    ms = resources.MemorySampler(registry=obs.MetricsRegistry(),
+                                 include_host=False, register=False)
+    ms.add_source("shard", shard.memory_bytes)
+    with obs.override(True):
+        assert ms.sample()["shard_peak_round"] == 0
+    ms.close()
+
+
+# -- cluster rollup ----------------------------------------------------------
+
+
+def test_resource_rollup_points_at_fullest_queue_and_most_compiles():
+    members = {
+        "a": {"snapshot": {
+            "gauges": {obs.labeled("resource_queue_depth", queue="q"): 9,
+                       obs.labeled("resource_queue_capacity", queue="q"): 10},
+            "counters": {obs.labeled("resource_jit_compiles_total",
+                                     fn="f"): 2}}},
+        "b": {"snapshot": {
+            "gauges": {obs.labeled("resource_queue_depth", queue="q"): 1,
+                       obs.labeled("resource_queue_capacity", queue="q"): 10},
+            "counters": {obs.labeled("resource_jit_compiles_total",
+                                     fn="f"): 7}}},
+        "quiet": {"snapshot": {"gauges": {"trainer_loss": 0.5},
+                               "counters": {}}},
+    }
+    out = resources.resource_rollup(members)
+    assert out["worst_saturation"] == {"member": "a", "queue": "q",
+                                       "fill": 0.9}
+    assert out["most_compiles"] == {"member": "b", "compiles": 7}
+    assert "quiet" not in out["members"]  # no resource series there
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+def test_trainer_arms_tracker_by_ctor_and_env(monkeypatch, rng):
+    d, n = 32, 64
+    batch = {"x": rng.normal(size=(n, d)).astype(np.float32),
+             "labels": (rng.random(n) > 0.5).astype(np.float32)}
+    tr = _toy_trainer(d, resources=True)
+    assert tr.resources is not None
+    try:
+        snap = tr.resources.snapshot()
+        assert {"trainer_step", "trainer_logits"} <= set(snap["fns"])
+        with obs.override(True):
+            for _ in range(3):
+                tr.train_step(batch)
+        assert tr.resources.snapshot()["steps"] == 3
+    finally:
+        tr.resources.close()
+    # default dark; env arms it
+    tr2 = _toy_trainer(d)
+    assert tr2.resources is None
+    monkeypatch.setenv("LIGHTCTR_RESOURCES", "1")
+    tr3 = _toy_trainer(d)
+    assert tr3.resources is not None
+    tr3.resources.close()
+
+
+def test_trainer_overhead_under_5_percent_with_resource_plane_armed(rng):
+    """ISSUE 18 re-run of the tier-1 overhead guard: the compile tracker
+    (note_step + cache polling), per-step queue telemetry, and the
+    resource detectors must stay inside the SAME <5% budget — with
+    feed-ran assertions, so the guard cannot pass by silently skipping
+    the plane (the ISSUE 17 contract, one plane further out)."""
+    d, n = 2560, 1024
+    batch = {
+        "x": rng.normal(size=(n, d)).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+
+    def build(armed):
+        tr = _toy_trainer(d, resources=armed)
+        hm = health.HealthMonitor(
+            component=f"res_guard_{int(armed)}",
+            registry=obs.MetricsRegistry())
+        health.ensure_trainer_detectors(hm)
+        tr.health = hm
+        iq = None
+        if armed:
+            tr.resources.bind_monitor(hm)
+            iq = resources.InstrumentedQueue(
+                "res_guard_q", capacity=64, registry=hm.registry,
+                monitor=hm, register=False)
+        return tr, hm, iq
+
+    tr_off, hm_off, _ = build(False)
+    tr_on, hm_on, iq = build(True)
+    obs.configure_event_log()  # fresh in-memory ring (no disk writes)
+    try:
+        with trace_mod.override_rate(0.0), obs.override(True):
+            def step(tr, i):
+                tr.train_step(batch)
+                if tr is tr_on:
+                    # the serve/_admit-shaped per-step queue telemetry
+                    iq.set_depth(i % 32)
+                    iq.note_enqueue()
+                    iq.note_wait(1e-4)
+
+            for i in range(5):  # compile + warm both programs
+                step(tr_off, i)
+                step(tr_on, i)
+
+            def run(tr, steps=30):
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    step(tr, i)
+                return time.perf_counter() - t0
+
+            t_off = min(run(tr_off) for _ in range(4))
+            t_on = min(run(tr_on) for _ in range(4))
+        tr_on.flush_health()
+        # the plane genuinely ran on the timed path: every step counted,
+        # the tracker polled into the monitor, the queue observed waits
+        assert tr_on.resources.snapshot()["steps"] == 5 + 4 * 30
+        v = hm_on.verdict()
+        assert v["detectors"]["recompile_storm"]["checks"] >= 1
+        assert v["detectors"]["queue_saturation"]["checks"] >= 5 + 4 * 30
+        assert iq.payload()["waits"] == 5 + 4 * 30
+        assert hm_on.status() == health.OK  # armed, not tripped
+    finally:
+        tr_on.resources.close()
+        obs.configure_event_log()
+        hm_off.close()
+        hm_on.close()
+    assert t_on <= t_off * 1.05 + 0.005, (t_on, t_off)
+
+
+# -- acceptance: shape churn trips the storm, pow2 padding stays ok ----------
+
+
+def test_recompile_storm_acceptance_healthz_flight_and_control(tmp_path):
+    """ISSUE 18 acceptance: a shape-churning loop (the unpadded-batch
+    leak) trips the RecompileStormDetector — real /healthz 503 + an
+    anomaly-time flight bundle whose resources section trace_report can
+    read — while a pow2-padded control loop compiles its two-rung ladder
+    during warmup and stays OK throughout."""
+    import tools.trace_report as trace_report
+
+    fdir = tmp_path / "flight"
+    srv = exporter.OpsServer(port=0)
+    flight.install(str(fdir), catch_signals=False)
+    obs.configure_event_log()
+    overrides = {"recompile_storm": {
+        "warmup_steps": 2, "max_per_step": 0.3, "hard_factor": 1.5,
+        "min_steps": 2}}
+    hm_storm = _monitor(component="res_storm", trip_after=1,
+                        recover_after=100)
+    hm_ok = _monitor(component="res_padded", trip_after=1,
+                     recover_after=100)
+    tr_storm = resources.CompileTracker(
+        component="res_storm", registry=hm_storm.registry,
+        monitor=hm_storm, poll_every=0, detector_overrides=overrides)
+    tr_ok = resources.CompileTracker(
+        component="res_padded", registry=hm_ok.registry, monitor=hm_ok,
+        poll_every=0, detector_overrides=overrides)
+    churn = jax.jit(lambda x: (x * x).sum())
+    padded = jax.jit(lambda x: (x + 1.0).sum())
+    tr_storm.track("churn_step", churn)
+    tr_ok.track("padded_step", padded)
+    try:
+        with obs.override(True):
+            for i in range(8):
+                # storm: a NEW row count every step (no padding)
+                churn(np.zeros((3 + i, 2), np.float32))
+                tr_storm.note_step()
+                # control: the same traffic pow2-padded to a 2-rung ladder
+                padded(np.zeros((8 if i % 2 else 16,), np.float32))
+                tr_ok.note_step()
+                if (i + 1) % 2 == 0:
+                    tr_storm.poll()
+                    tr_ok.poll()
+
+        v = hm_storm.verdict()
+        assert v["status"] == health.UNHEALTHY
+        assert v["detectors"]["recompile_storm"]["status"] \
+            == health.UNHEALTHY
+        assert v["detectors"]["recompile_storm"]["detail"]["worst_fn"] \
+            == "churn_step"
+        ok = hm_ok.verdict()
+        assert ok["status"] == health.OK
+        assert tr_ok.snapshot()["fns"]["padded_step"]["cache_entries"] == 2
+
+        # /healthz: a real 503 naming the storming component
+        code, body = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/healthz")
+        assert code == 503
+        assert body["components"]["res_storm"]["status"] == health.UNHEALTHY
+        assert body["components"]["res_padded"]["status"] == health.OK
+
+        # /resourcez carries both trackers' compile state
+        code, rz = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/resourcez")
+        assert code == 200
+        assert rz["resources"]["res_storm"]["fns"][
+            "churn_step"]["compiles"] >= 6
+        assert rz["resources"]["res_padded"]["fns"][
+            "padded_step"]["compiles"] == 2
+
+        # the anomaly dump landed; its resources section is readable
+        bundles = sorted(fdir.glob("flight-*.jsonl"))
+        assert bundles, "no anomaly-time flight bundle"
+        rep = trace_report.summarize_flight(str(bundles[-1]))
+        assert rep["reason"].startswith("health:res_storm:")
+        assert "resources:res_storm" in rep["resources"]
+        assert rep["resources"]["resources:res_storm"]["resources"] is True
+        assert rep["health"]["res_storm"]["status"] == health.UNHEALTHY
+    finally:
+        tr_storm.close()
+        tr_ok.close()
+        hm_storm.close()
+        hm_ok.close()
+        flight.uninstall()
+        obs.configure_event_log()
+        srv.close()
+
+
+# -- acceptance: serve saturation degrades BEFORE shedding -------------------
+
+
+def test_serve_queue_saturation_trips_before_shed(rng):
+    """ISSUE 18 acceptance: a burst into a slow-scorer server fills the
+    micro-batch queue past the band for several admissions — the
+    QueueSaturationDetector degrades the verdict — while the burst stays
+    UNDER queue_cap, so ``serve_shed_total`` never moves: the detector
+    fires BEFORE admission control starts refusing work."""
+    import threading
+
+    params = fm.init(jax.random.PRNGKey(5), F, K)
+    hm = _monitor(component="serve_sat", trip_after=1, recover_after=100)
+    # pre-installed tuned detector: the server's ensure keeps it
+    hm.add_detector(resources.QueueSaturationDetector(
+        degraded_fill=0.4, unhealthy_fill=2.0, sustain=2),
+        recover_after=100)  # latch DEGRADED through the queue drain
+    srv = serve.PredictionServer(
+        serve.ServingModel("fm", params), max_batch=4, max_wait_us=100,
+        queue_cap=32, deadline_ms=20000, score_delay_s=0.2, health=hm,
+    )
+    ops = exporter.OpsServer(port=0)
+
+    def _batch(r, n):
+        return {"fids": r.integers(1, F, size=(n, 4)).astype(np.int32),
+                "vals": np.ones((n, 4), np.float32)}
+
+    try:
+        with obs.override(True):
+            warm = serve.PredictClient(srv.address)
+            warm.predict(_batch(rng, 1))  # compile outside the burst
+            warm.close()
+
+            def one(i):
+                cli = serve.PredictClient(srv.address)
+                try:
+                    cli.predict(_batch(np.random.default_rng(i), 2))
+                finally:
+                    cli.close()
+
+            # 12 x 2 = 24 rows: past 0.4 * 32 = 12.8, under cap 32
+            ts = [threading.Thread(target=one, args=(i,))
+                  for i in range(12)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+        v = hm.verdict()
+        det = v["detectors"]["queue_saturation"]
+        assert det["status"] == health.DEGRADED  # latched through drain
+        assert det["transitions"] >= 1
+        assert det["detail"]["queue"] == f"{srv._flight_name}_queue"
+        # ...and NOT ONE row was shed: saturation fired first
+        counters = srv.registry.snapshot()["counters"]
+        assert not any(k.startswith("serve_shed") for k in counters), \
+            counters
+        assert counters[obs.labeled(
+            "resource_queue_enqueued_total",
+            queue=f"{srv._flight_name}_queue")] >= 25
+        # the queue is a /resourcez provider on this process
+        code, rz = _get(
+            f"http://{ops.address[0]}:{ops.address[1]}/resourcez")
+        assert code == 200
+        assert f"queue:{srv._flight_name}_queue" in rz["resources"]
+    finally:
+        srv.close()
+        ops.close()
+        hm.close()
+
+
+# -- report tooling ----------------------------------------------------------
+
+
+def test_metrics_report_resources_golden(tmp_path, capsys):
+    import tools.metrics_report as metrics_report
+
+    reg = obs.MetricsRegistry()
+    with obs.override(True):
+        tr = resources.CompileTracker(component="rep", registry=reg,
+                                      poll_every=0)
+        f = jax.jit(lambda x: x - 1.0)
+        tr.track("rep_fn", f)
+        for i in range(2):
+            f(np.zeros((i + 2,), np.float32))
+            tr.note_step()
+        tr.poll()
+        q = resources.InstrumentedQueue("rep_q", capacity=8, registry=reg,
+                                        register=False)
+        q.set_depth(6)
+        q.note_enqueue(10)
+        q.note_drop(1)
+        q.note_wait(0.004)
+        ms = resources.MemorySampler(registry=reg, budgets={"blob": 200.0},
+                                     include_host=False, register=False)
+        ms.add_source("blob", lambda: 150)
+        ms.sample()
+    tr.close()
+    ms.close()
+
+    snap = reg.snapshot()
+    rep = metrics_report.summarize_resources(snap)
+    assert rep["jit"]["fns"]["rep_fn"] == {"compiles": 2,
+                                           "cache_entries": 2}
+    assert rep["jit"]["backend_compiles"] >= 2
+    assert rep["queues"]["rep_q"]["fill"] == 0.75
+    assert rep["queues"]["rep_q"]["dropped"] == 1
+    assert rep["queues"]["rep_q"]["wait"]["count"] == 1
+    assert rep["fullest_queue"] == {"queue": "rep_q", "fill": 0.75}
+    assert rep["memory"]["blob"] == {"bytes": 150, "budget_bytes": 200,
+                                     "fraction": 0.75}
+    # the CLI path accepts the MSG_STATS/varz "telemetry" wrapper
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps({"telemetry": snap}))
+    assert metrics_report.main(["--resources", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '"fullest_queue"' in out and '"rep_fn"' in out
+
+
+# -- perf-regression trajectory ----------------------------------------------
+
+
+def test_bench_history_fold_and_gate(tmp_path):
+    import tools.bench_history as bench_history
+
+    hist = str(tmp_path / "HIST.jsonl")
+
+    def art(name, value):
+        p = tmp_path / name
+        p.write_text(json.dumps({"parsed": {
+            "metric": "train_examples_per_sec", "value": value,
+            "unit": "examples/s"}}))
+        return str(p)
+
+    bench_history.fold_artifact(art("r1.json", 100.0), hist, run="r1")
+    bench_history.fold_artifact(art("r2.json", 110.0), hist, run="r2")
+    rep = bench_history.gate_history(hist, max_regress=0.2)
+    assert rep["ok"] and rep["checked"] == 1 and not rep["failures"]
+    # a 50% throughput collapse fails the gate, naming the key
+    bench_history.fold_artifact(art("r3.json", 52.0), hist, run="r3")
+    rep = bench_history.gate_history(hist, max_regress=0.2)
+    assert not rep["ok"]
+    f = rep["failures"][0]
+    assert f["metric"] == "train_examples_per_sec"
+    assert f["direction"] == "higher" and f["trailing_median"] == 105.0
+    # generic artifacts fold their numeric leaves; direction-unknown
+    # metrics are tracked but never gated
+    g = tmp_path / "g.json"
+    g.write_text(json.dumps({"cells": [{"p99_ms": 4.0, "mystery": 7}]}))
+    rows = bench_history.fold_artifact(str(g), hist)
+    assert {r["metric"] for r in rows} == {"p99_ms", "mystery"}
+    assert all(r["cell"] == "cells.0" for r in rows)
+    assert bench_history.metric_direction("mystery") == 0
+    assert bench_history.metric_direction("p99_ms") == -1
+    assert bench_history.metric_direction("rows_per_s") == 1
+    # the CLI: fold returns 0, gate returns 1 on the regression above
+    assert bench_history.main(["gate", "--history", hist]) == 1
+    # fold_and_gate is the bench tools' hook
+    rep2 = bench_history.fold_and_gate(str(g), hist)
+    assert rep2["folded"] == 2 and "failures" in rep2
+
+
+def test_seeded_bench_history_trajectory_passes_the_gate():
+    """The committed BENCH_HISTORY.jsonl (seeded from BENCH_r01..r05 and
+    the subsystem bench artifacts) gates clean: the recorded trainer
+    trajectory improves monotonically, and single-run keys are skipped,
+    not judged."""
+    import tools.bench_history as bench_history
+
+    hist = os.path.join(REPO_ROOT, "BENCH_HISTORY.jsonl")
+    assert os.path.exists(hist), "seeded BENCH_HISTORY.jsonl missing"
+    rows = bench_history.read_history(hist)
+    assert len(rows) > 100
+    runs = {r["run"] for r in rows if r["bench"] == "trainer"}
+    assert {"r01", "r02", "r03", "r04", "r05"} <= runs
+    rep = bench_history.gate_history(hist)
+    assert rep["ok"], rep["failures"]
+    assert rep["checked"] >= 1

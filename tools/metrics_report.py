@@ -50,6 +50,11 @@ turns either into something readable:
       #    plane"): per-component streaming calibration ratio,
       #    sketch-AUC, logloss EWMA vs frozen baseline, per-field drift
       #    scores, feature-coverage totals, worst-drift pointer
+  python -m tools.metrics_report --resources SNAPSHOT_JSON
+      # -> resource/saturation report (docs/OBSERVABILITY.md "Resource &
+      #    saturation plane"): per-fn jit compile counts + live cache
+      #    ladders, per-queue depth/capacity/fill with queued-wait
+      #    percentiles, memory bytes vs budgets, fullest-queue pointer
 """
 
 from __future__ import annotations
@@ -596,6 +601,89 @@ def summarize_quality(doc) -> dict:
     return report
 
 
+def summarize_resources(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> resource/saturation report (docs/OBSERVABILITY.md
+    "Resource & saturation plane"): per-fn jit compile counts and live
+    cache-entry ladders, per-queue depth/capacity/fill with queued-wait
+    percentiles, and the memory byte/budget table.  Every series here is
+    declared in ``lightctr_tpu.obs.resources.RESOURCE_SERIES``
+    (lint-enforced)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+
+    def _labels(name, prefix):
+        return dict(
+            part.split("=", 1)
+            for part in name[len(prefix) + 1:-1].replace('"', "").split(",")
+        )
+
+    report: dict = {}
+    compiles: dict = {}
+    for name, val in counters.items():
+        if name.startswith("resource_jit_compiles_total{"):
+            fn = _labels(name, "resource_jit_compiles_total").get("fn", "?")
+            compiles.setdefault(fn, {})["compiles"] = int(val)
+    for name, val in gauges.items():
+        if name.startswith("resource_jit_cache_entries{"):
+            fn = _labels(name, "resource_jit_cache_entries").get("fn", "?")
+            compiles.setdefault(fn, {})["cache_entries"] = int(val)
+    jit = {"fns": {k: compiles[k] for k in sorted(compiles)}}
+    if "resource_backend_compiles_total" in counters:
+        jit["backend_compiles"] = int(
+            counters["resource_backend_compiles_total"])
+    if "resource_compile_seconds" in hists:
+        jit["compile_time"] = _hist_summary(hists["resource_compile_seconds"])
+    if jit["fns"] or len(jit) > 1:
+        report["jit"] = jit
+    queues: dict = {}
+
+    def _queue(labels):
+        return queues.setdefault(labels.get("queue", "?"), {})
+
+    for prefix, key in (("resource_queue_depth", "depth"),
+                        ("resource_queue_capacity", "capacity")):
+        for name, val in gauges.items():
+            if name.startswith(prefix + "{"):
+                _queue(_labels(name, prefix))[key] = int(val)
+    for prefix, key in (("resource_queue_enqueued_total", "enqueued"),
+                        ("resource_queue_dropped_total", "dropped")):
+        for name, val in counters.items():
+            if name.startswith(prefix + "{"):
+                _queue(_labels(name, prefix))[key] = int(val)
+    prefix = "resource_queue_wait_seconds"
+    for name, hist in hists.items():
+        if name.startswith(prefix + "{"):
+            _queue(_labels(name, prefix))["wait"] = _hist_summary(hist)
+    worst = None
+    for qname, entry in queues.items():
+        cap = entry.get("capacity", 0)
+        if cap:
+            entry["fill"] = round(entry.get("depth", 0) / cap, 4)
+            if worst is None or entry["fill"] > worst["fill"]:
+                worst = {"queue": qname, "fill": entry["fill"]}
+    if queues:
+        report["queues"] = {k: queues[k] for k in sorted(queues)}
+    if worst is not None:
+        report["fullest_queue"] = worst
+    memory: dict = {}
+    for prefix, key in (("resource_memory_bytes", "bytes"),
+                        ("resource_memory_budget_bytes", "budget_bytes")):
+        for name, val in gauges.items():
+            if name.startswith(prefix + "{"):
+                kind = _labels(name, prefix).get("kind", "?")
+                memory.setdefault(kind, {})[key] = int(val)
+    for kind, entry in memory.items():
+        if entry.get("budget_bytes"):
+            entry["fraction"] = round(
+                entry.get("bytes", 0) / entry["budget_bytes"], 4)
+    if memory:
+        report["memory"] = {k: memory[k] for k in sorted(memory)}
+    return report
+
+
 def summarize_cluster(doc) -> dict:
     """Cluster rollup dump -> straggler/rollup report.  Accepts the
     :meth:`~lightctr_tpu.obs.cluster.ClusterRollup.members` dict, a bare
@@ -677,6 +765,11 @@ def main(argv=None):
                          "ratio, sketch-AUC, logloss EWMA vs baseline, "
                          "drift scores, feature coverage) from a registry "
                          "snapshot or stats() dump")
+    ap.add_argument("--resources", metavar="SNAPSHOT_JSON",
+                    help="summarize the resource/saturation plane (jit "
+                         "compiles + cache ladders, queue depth/fill with "
+                         "wait percentiles, memory bytes vs budgets) from "
+                         "a registry snapshot or stats() dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -757,12 +850,21 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.resources:
+        with open(args.resources) as f:
+            doc = json.load(f)
+        report = summarize_resources(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
                  "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, "
-                 "--cluster MEMBERS_JSON, --quality SNAPSHOT_JSON, or "
-                 "--online SNAPSHOT_JSON")
+                 "--cluster MEMBERS_JSON, --quality SNAPSHOT_JSON, "
+                 "--resources SNAPSHOT_JSON, or --online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

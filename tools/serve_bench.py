@@ -211,6 +211,10 @@ def main(argv=None):
                     help="freshness-age SLO (seconds) the push churn "
                          "cell's p99 is judged against")
     ap.add_argument("--out", default="SERVE_BENCH.json")
+    ap.add_argument("--history", default=None,
+                    help="fold the artifact into this BENCH_HISTORY.jsonl "
+                         "and gate on trailing-median regressions "
+                         "(tools/bench_history.py)")
     args = ap.parse_args(argv)
 
     import tempfile
@@ -541,6 +545,15 @@ def main(argv=None):
     with open(args.out, "w") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
+    if args.history:
+        # the perf-regression trajectory (tools/bench_history.py): a run
+        # that regresses >20% past its own trailing median fails HERE,
+        # not three PRs later in a human's diff
+        import bench_history
+        gate = bench_history.fold_and_gate(args.out, args.history)
+        print(json.dumps({"bench_history_gate": gate}, indent=1))
+        if not gate["ok"]:
+            return 1
     return 0 if report["ok"] else 1
 
 

@@ -253,6 +253,10 @@ def main(argv=None):
                          "window the fault pipeline overlaps into")
     ap.add_argument("--out", default="TIERED_BENCH.json",
                     help="also write the artifact here ('-' = stdout only)")
+    ap.add_argument("--history", default=None,
+                    help="fold the artifact into this BENCH_HISTORY.jsonl "
+                         "and gate on trailing-median regressions "
+                         "(tools/bench_history.py)")
     args = ap.parse_args(argv)
 
     workdir = tempfile.mkdtemp(prefix="tiered_bench_")
@@ -304,6 +308,15 @@ def main(argv=None):
         with open(args.out, "w") as f:
             f.write(json.dumps(report, indent=1) + "\n")
     print(json.dumps(report, indent=1))
+    if args.history and args.out and args.out != "-":
+        # the perf-regression trajectory (tools/bench_history.py): a run
+        # that regresses >20% past its own trailing median fails HERE,
+        # not three PRs later in a human's diff
+        import bench_history
+        gate = bench_history.fold_and_gate(args.out, args.history)
+        print(json.dumps({"bench_history_gate": gate}, indent=1))
+        if not gate["ok"]:
+            return 1
     return 0 if report["ok"] else 1
 
 

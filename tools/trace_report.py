@@ -303,6 +303,17 @@ def summarize_flight(path: str) -> Dict:
     }
     if quality:
         report["quality"] = quality
+    # the resource plane rides the same way: registries named
+    # resources:<component> (compile trackers) whose snapshots self-mark
+    # with "resources": True — the jit-cache/queue/memory state AT the
+    # dump is what a recompile-storm or saturation postmortem reads
+    resources = {
+        m.get("registry", "?"): m.get("snapshot", {})
+        for m in metrics
+        if m.get("snapshot", {}).get("resources")
+    }
+    if resources:
+        report["resources"] = resources
     # surface the headline counters — the numbers a postmortem reads first
     for m in metrics:
         c = m.get("snapshot", {}).get("counters", {})
