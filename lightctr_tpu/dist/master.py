@@ -40,6 +40,7 @@ from lightctr_tpu.dist.ps_server import (
     PSClient,
 )
 from lightctr_tpu.embed.async_ps import AsyncParamServer
+from lightctr_tpu.obs import device as obs_device
 from lightctr_tpu.obs import emit_event
 from lightctr_tpu.obs import exporter as obs_exporter
 from lightctr_tpu.obs import flight as obs_flight
@@ -216,8 +217,8 @@ class MasterService:
                     or "/stragglerz" in obs_exporter.json_routes():
                 logging.getLogger(__name__).warning(
                     "another cluster rollup is registered in this "
-                    "process; /stragglerz, /qualityz, /resourcez and "
-                    "/metrics now serve THIS master's view"
+                    "process; /stragglerz, /qualityz, /resourcez, "
+                    "/devicez and /metrics now serve THIS master's view"
                 )
             # sweep saturation telemetry: depth = members pending this
             # sweep, wait = whole-sweep seconds (a sweep that stops
@@ -230,6 +231,7 @@ class MasterService:
             obs_exporter.register_json_route("/stragglerz", self.stragglerz)
             obs_exporter.register_json_route("/qualityz", self.qualityz)
             obs_exporter.register_json_route("/resourcez", self.resourcez)
+            obs_exporter.register_json_route("/devicez", self.devicez)
             self._scrape_thread = threading.Thread(
                 target=self._scrape_loop, name="master-scrape", daemon=True,
             )
@@ -949,6 +951,17 @@ class MasterService:
                              "(set scrape_period_s)"}
         return obs_resources.resource_rollup(self.rollup.members())
 
+    def devicez(self) -> dict:
+        """Cluster-wide device rollup — per-member ``device_*`` program/
+        census/donation series merged from the scraped snapshots plus the
+        lowest-utilization, donation-miss and biggest-live-buffer
+        verdicts, the ``/devicez`` ops route's payload on the master
+        (obs/device.py)."""
+        if self.rollup is None:
+            return {"error": "cluster scrape loop not armed "
+                             "(set scrape_period_s)"}
+        return obs_device.device_rollup(self.rollup.members())
+
     def close(self) -> None:
         self.monitor.stop()
         if self._scrape_thread is not None:
@@ -967,6 +980,9 @@ class MasterService:
             if obs_exporter.json_routes().get("/resourcez") \
                     == self.resourcez:
                 obs_exporter.unregister_json_route("/resourcez")
+            if obs_exporter.json_routes().get("/devicez") \
+                    == self.devicez:
+                obs_exporter.unregister_json_route("/devicez")
             if obs_flight.registered_registries().get("cluster") \
                     is self.rollup:
                 obs_flight.unregister_registry("cluster")

@@ -75,6 +75,7 @@ from lightctr_tpu.embed.mmap_store import (
     sorted_insert,
 )
 from lightctr_tpu.native import bindings
+from lightctr_tpu.obs import device as obs_device
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import resources as obs_resources
 from lightctr_tpu.obs import trace as obs_trace
@@ -613,9 +614,15 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             # (donation is a no-op copy where the backend declines it).
             cls._DEV_FNS = {
                 "gather": gather,
-                "scatter": obs_resources.track_jit(
+                # aliasing verified by the device plane when armed — a
+                # declined donation here is per-write HBM doubling on
+                # exactly the pinned block (obs/device.py)
+                "scatter": obs_device.verify_donation(
                     "tiered_dev_scatter",
-                    jax.jit(scatter, donate_argnums=(0,))),
+                    obs_resources.track_jit(
+                        "tiered_dev_scatter",
+                        jax.jit(scatter, donate_argnums=(0,))),
+                    donate_argnums=(0,)),
             }
         return cls._DEV_FNS
 
@@ -1083,8 +1090,11 @@ class TieredEmbeddingStore(SSPGateMixin, WriteLogMixin):
             scatter = self._dev_fns()["scatter"]
             sp, pp = self._pad_scatter(slots, payload)
             s32 = jnp.asarray(sp)
-            self._devW = scatter(
-                self._devW, s32, jnp.asarray(pp[:, : self.dim]))
+            rows_j = jnp.asarray(pp[:, : self.dim])
+            # specs captured before the call — the block is donated in
+            obs_device.offer("tiered_dev_scatter", scatter,
+                             (self._devW, s32, rows_j))
+            self._devW = scatter(self._devW, s32, rows_j)
             self._devA = scatter(
                 self._devA, s32, jnp.asarray(pp[:, self.dim:]))
             return

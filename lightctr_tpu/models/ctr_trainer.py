@@ -27,6 +27,7 @@ import optax
 
 from lightctr_tpu import obs
 from lightctr_tpu import optim as optim_lib
+from lightctr_tpu.obs import device as device_mod
 from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.obs import quality as quality_mod
 from lightctr_tpu.obs import resources as resources_mod
@@ -142,6 +143,7 @@ class CTRTrainer:
         zero_sharded: bool = False,
         quality_bins: Optional[int] = None,
         resources: Optional[bool] = None,
+        device: Optional[bool] = None,
     ):
         self.cfg = cfg
         self.logits_fn = logits_fn
@@ -275,6 +277,28 @@ class CTRTrainer:
                 component="trainer", registry=self.telemetry,
                 monitor=self.health,
             )
+        # device plane (obs/device.py): when armed (ctor arg or
+        # LIGHTCTR_DEVICE) a per-trainer ProgramCatalog records the step
+        # program's arg specs (cost/memory analysis reads happen at scrape
+        # time, never on the step path) and a LiveBufferCensus samples
+        # jax.live_arrays() with the trainer state tagged; the process
+        # donation watch binds to this trainer's registry/monitor so
+        # verify_donation misses trip the donation_miss detector here.
+        self.device: Optional[device_mod.ProgramCatalog] = None
+        self.device_census: Optional[device_mod.LiveBufferCensus] = None
+        if device_mod.resolve_armed(device):
+            self.device = device_mod.ProgramCatalog(
+                component="trainer", registry=self.telemetry,
+                monitor=self.health,
+            )
+            self.device_census = device_mod.LiveBufferCensus(
+                registry=self.telemetry, monitor=self.health,
+                name="trainer",
+            )
+            self.device_census.register_tag(
+                "trainer_state", lambda: (self.params, self.opt_state))
+            device_mod.default_donation_watch().bind(
+                registry=self.telemetry, monitor=self.health)
         self._steps_seen = 0
         self.opt_state = self._init_opt_state(self.params)  # inherits shardings
         # donate (params, opt_state): the old trees are dead after each step,
@@ -653,6 +677,16 @@ class CTRTrainer:
         self._feed_health(batch, health)
         if self.resources is not None:
             self.resources.note_step()
+        if self.device is not None:
+            # specs-only registration (first call wins), EWMA time fold,
+            # and the census counter — no analysis compile rides a step
+            self.device.offer("trainer_step", self._step,
+                              (self.params, self.opt_state, batch))
+            self.device.note_step(dt, "trainer_step")
+            self.device_census.maybe_sample()
+        # armed profiler captures advance at step boundaries (one global
+        # + one flag read when idle)
+        device_mod.profile_step()
         if self.stepwatch is not None:
             self.stepwatch.step_completed(dt)
 

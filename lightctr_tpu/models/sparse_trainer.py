@@ -117,6 +117,7 @@ import optax
 
 from lightctr_tpu import obs
 from lightctr_tpu.models.ctr_trainer import CTRTrainer, _health_pack
+from lightctr_tpu.obs import device as obs_device
 from lightctr_tpu.obs import health as health_mod
 from lightctr_tpu.obs import quality as quality_mod
 from lightctr_tpu.ops.sparse_kernels import next_pow2 as _pow2_pad
@@ -1185,8 +1186,12 @@ class SparseTableCTRTrainer(CTRTrainer):
             # the sketch stays a DEVICE array end to end: program A ->
             # program C, appended to the health vector there — the
             # orchestrator never fetches it
+            if self.device is not None:
+                self.device.offer("hier_local_step", local, (params, batch))
             out_ids, out_rows, dense_flat, over, sketch = local(params, batch)
         else:
+            if self.device is not None:
+                self.device.offer("hier_local_step", local, (params, batch))
             out_ids, out_rows, dense_flat, over = local(params, batch)
             sketch = None
 
@@ -1295,6 +1300,11 @@ class SparseTableCTRTrainer(CTRTrainer):
                       jnp.float32(loss), jnp.asarray(over))
         if sketch is not None:
             apply_args = apply_args + (sketch,)
+        if self.device is not None:
+            # the hier step itself is a host orchestrator; its two jitted
+            # halves are the analyzable device programs
+            self.device.offer("hier_apply_step", self._hier_apply_j,
+                              apply_args)
         new_params, new_state, loss_out, health = self._hier_apply_j(
             *apply_args
         )
@@ -1768,6 +1778,11 @@ class TieredDeviceEmbedding:
 
             donate = (0, 1) if jax.default_backend() == "tpu" else ()
             fn = jax.jit(f, donate_argnums=donate)
+            # device-plane aliasing check (obs/device.py): a donated
+            # table buffer that silently COPIED instead of aliasing
+            # doubles HBM — no-op wrapper unless LIGHTCTR_DEVICE armed
+            fn = obs_device.verify_donation(
+                f"merge_apply_{key[0]}x{key[1]}", fn, donate_argnums=donate)
             self._fused[key] = fn
         return fn
 
@@ -1815,8 +1830,13 @@ class TieredDeviceEmbedding:
             inv_p = np.full(mp, sp - 1, np.int32)
             inv_p[:m] = seg_of[inv]
             w, a = store.device_tables()
-            w2, a2, ssq = self._fused_fn((sp, mp))(
-                w, a, jnp.asarray(uids_p), rows_p, jnp.asarray(inv_p))
+            fused = self._fused_fn((sp, mp))
+            uids_j, inv_j = jnp.asarray(uids_p), jnp.asarray(inv_p)
+            # register the fused program with the process catalog (specs
+            # captured BEFORE the call — the tables are donated into it)
+            obs_device.offer(f"merge_apply_{sp}x{mp}", fused,
+                             (w, a, uids_j, rows_p, inv_j))
+            w2, a2, ssq = fused(w, a, uids_j, rows_p, inv_j)
             store.adopt_device_tables(
                 w2, a2, touched_slots=hs,
                 expect_res_epoch=ticket["res_epoch"])

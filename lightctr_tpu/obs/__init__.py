@@ -53,6 +53,13 @@ Entry points
     recompile-storm/queue-saturation/memory-pressure detectors and
     ``/resourcez``; ``LIGHTCTR_RESOURCES=1`` arms the trainer compile
     watch.
+``device`` (submodule)
+    device & compiled-program plane — HLO cost/memory analytics with
+    roofline utilization per jit program, ``jax.live_arrays()`` census,
+    donation-aliasing verification, on-demand/anomaly-coupled
+    ``jax.profiler`` capture (``POST /profilez``);
+    ``hbm_pressure``/``donation_miss`` detectors and ``/devicez``;
+    ``LIGHTCTR_DEVICE=1`` arms the trainer catalog + census.
 
 See docs/OBSERVABILITY.md for metric names and the event schema.
 """
@@ -83,6 +90,7 @@ from lightctr_tpu.obs import stepwatch  # noqa: F401  (stall watchdog)
 from lightctr_tpu.obs import cluster  # noqa: F401  (cluster rollup)
 from lightctr_tpu.obs import quality  # noqa: F401  (model-quality plane)
 from lightctr_tpu.obs import resources  # noqa: F401  (resource/saturation plane)
+from lightctr_tpu.obs import device  # noqa: F401  (device/compiled-program plane)
 
 # LIGHTCTR_FLIGHT=<dir> arms the crash recorder in every process that
 # inherits the variable — the multi-process PS run's postmortem switch
@@ -90,6 +98,9 @@ flight.maybe_install_from_env()
 # LIGHTCTR_OPS_PORT=<port> serves /metrics /varz /healthz /tracez /flightz
 # in every process that inherits it (0 auto-assigns; telemetry-off wins)
 exporter.maybe_install_from_env()
+# LIGHTCTR_PROFILE_AUTO=1 couples the profiler trigger to anomaly
+# transitions (stall/memory_pressure/hbm_pressure one-shot captures)
+device.maybe_auto_capture_from_env()
 
 import logging as _logging
 

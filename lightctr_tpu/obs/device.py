@@ -1,0 +1,1267 @@
+"""Device & compiled-program observability plane: HLO cost/memory
+analytics, live-buffer census, donation verification, and on-demand
+profiler capture.
+
+PR 18 instrumented the *host* machine (jit caches, queues, RSS); this
+module instruments the *device* side the north star is argued against —
+what a compiled program costs, where HBM goes, and whether the zero-copy
+promises (buffer donation) actually held.  Four families:
+
+- **compiled-program analytics** — :class:`ProgramCatalog` registers the
+  jit sites the :class:`~lightctr_tpu.obs.resources.CompileTracker`
+  already knows (trainer step variants, serve scorers, tiered device
+  scatter/gather, online grad programs) and reads each executable's
+  ``cost_analysis()`` / ``memory_analysis()``: FLOPs, bytes accessed,
+  argument/output/temp/alias memory.  From observed step times it
+  derives arithmetic intensity and a roofline-style achieved-vs-peak
+  utilization gauge against :data:`PEAK_SPECS` (per-TPU-generation
+  peaks).  Backends without analyses or peak specs (CPU) degrade to
+  ``"unavailable"`` — never fake numbers.
+- **live-buffer census** — :class:`LiveBufferCensus` samples
+  ``jax.live_arrays()``, bucketing bytes by (shape, dtype, registered
+  source tag); per-tag budgets feed an ``hbm_pressure``
+  detector through the same budget machinery as the resources plane's
+  :class:`~lightctr_tpu.obs.resources.MemoryPressureDetector`.
+- **donation verification** — :func:`verify_donation` wraps a donated
+  jit callable and compares donated input buffer pointers against the
+  output buffers: a donated buffer that did NOT alias is silent memory
+  doubling (the exact failure the tiered scatter and ``merge_apply``
+  donate to avoid) → ``donation_miss`` detector + counters.
+- **profiler capture** — :class:`ProfileTrigger` arms
+  ``jax.profiler`` for the next N steps via ``POST /profilez``
+  (409 when the profiler is absent, 429 inside the rate window, bounded
+  capture dir) and can auto-arm a one-shot capture when ``stall`` /
+  ``memory_pressure`` / ``hbm_pressure`` trips
+  (:func:`install_auto_capture`, ``LIGHTCTR_PROFILE_AUTO=1``).
+
+Catalog, census, donation watch and trigger are ``/devicez`` providers
+and ``device:*`` flight registries (snapshots self-mark ``device`` so
+flight bundles and ``trace_report --flight`` carry a device section);
+the master rolls the cluster up via :func:`device_rollup`.
+``LIGHTCTR_DEVICE=1`` arms the per-trainer catalog + census
+(:func:`resolve_armed`); everything is gated on the obs switch so the
+disabled hot path stays the PR-2 fast path.
+
+Honesty rules: analyses are read from the *compiled* executable (one
+extra off-hot-path compile from recorded arg specs, at first scrape —
+never on the step path); a backend that exposes no cost/memory analysis
+or has no peak spec reports ``"unavailable"`` rather than a guessed
+utilization; the census never invents a tag (untagged bytes stay
+``untagged``); a donation check that cannot read buffer pointers skips
+rather than reporting a false alias.
+
+See docs/OBSERVABILITY.md "Device plane".
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from lightctr_tpu.obs import events as events_mod
+from lightctr_tpu.obs import exporter as exporter_mod
+from lightctr_tpu.obs import flight as flight_mod
+from lightctr_tpu.obs import gate
+from lightctr_tpu.obs import health as health_mod
+from lightctr_tpu.obs import resources as resources_mod
+from lightctr_tpu.obs.registry import MetricsRegistry, default_registry, labeled
+
+_LOG = logging.getLogger("lightctr.obs.device")
+
+# Every series this plane emits (both-directions AST lint in
+# tests/test_device.py, same contract as RESOURCE/QUALITY/HEALTH_SERIES).
+# All device_* emissions live in THIS module — wiring call sites go
+# through the classes below, so the lint covers the whole family.
+DEVICE_SERIES = (
+    "device_program_flops",            # gauge, {program} — compiled HLO FLOPs
+    "device_program_bytes_accessed",   # gauge, {program} — HLO bytes touched
+    "device_program_intensity",        # gauge, {program} — flops/byte
+    "device_program_utilization",      # gauge, {program} — achieved/peak
+    "device_program_memory_bytes",     # gauge, {program, kind} — arg/out/temp
+    "device_program_time_seconds",     # histogram, {program} — observed step
+    "device_live_buffer_bytes",        # gauge, {tag} — census bytes
+    "device_live_buffer_count",        # gauge, {tag} — census array count
+    "device_live_budget_bytes",        # gauge, {tag} — census budget
+    "device_donation_checks_total",    # counter, {program}
+    "device_donation_miss_total",      # counter, {program} — failed aliasing
+    "device_profile_captures_total",   # counter — landed profiler captures
+    "device_profile_refused_total",    # counter, {reason} — arm refusals
+)
+
+#: (device_kind substring, (peak FLOP/s, peak HBM bytes/s)) per chip —
+#: matched in order (more specific first) against ``device_kind.lower()``;
+#: kinds with no entry (CPU, unknown accelerators) report utilization as
+#: unavailable rather than against a made-up peak.
+PEAK_SPECS: Tuple[Tuple[str, Tuple[float, float]], ...] = (
+    ("tpu v6", (918e12, 1640e9)),
+    ("tpu v5 lite", (197e12, 819e9)),
+    ("tpu v5e", (197e12, 819e9)),
+    ("tpu v5p", (459e12, 2765e9)),
+    ("tpu v5", (459e12, 2765e9)),
+    ("tpu v4", (275e12, 1200e9)),
+    ("tpu v3", (123e12, 900e9)),
+    ("tpu v2", (45e12, 600e9)),
+)
+
+
+def peak_spec(device_kind: Optional[str]) -> Optional[Tuple[float, float]]:
+    """The (peak FLOP/s, peak HBM B/s) pair for a ``device_kind`` string,
+    or None when the kind has no published spec (the honest CPU path)."""
+    if not device_kind:
+        return None
+    kind = str(device_kind).lower()
+    for key, spec in PEAK_SPECS:
+        if key in kind:
+            return spec
+    return None
+
+
+def resolve_armed(explicit: Optional[bool] = None) -> bool:
+    """Whether the per-trainer device plane is armed: an explicit ctor
+    argument wins; otherwise ``LIGHTCTR_DEVICE`` (``1``/``true`` arms,
+    unset/falsy leaves it off — zero per-step cost when dark)."""
+    if explicit is not None:
+        return bool(explicit)
+    v = os.environ.get("LIGHTCTR_DEVICE", "").strip().lower()
+    return v not in ("", "0", "false", "off", "no")
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+class HbmPressureDetector(resources_mod.MemoryPressureDetector):
+    """Census bytes past their per-tag budget fraction — literally the
+    resources plane's :class:`MemoryPressureDetector` judging the
+    ``hbm_pressure`` signal the census feeds, so the budget semantics
+    (tags with no budget tracked but never judged, worst fraction wins)
+    stay identical across host and device memory."""
+
+    name = "hbm_pressure"
+    signals = ("hbm_pressure",)
+
+    def check(self, signals):
+        return super().check({"memory_pressure": signals["hbm_pressure"]})
+
+
+class DonationMissDetector(health_mod.Detector):
+    """A donated buffer that failed to alias: the call still computed the
+    right answer, but the input was copied instead of reused — silent
+    memory doubling on exactly the buffers (embedding tables, optimizer
+    state) donation was supposed to keep single.  A miss is structural
+    (the compiled program either aliases or it does not), so one miss
+    trips immediately; the latest verdict per program is tracked, so a
+    re-jitted replacement that aliases again recovers."""
+
+    name = "donation_miss"
+    signals = ("donation",)
+    trip_after = 1
+    recover_after = 1
+
+    def __init__(self):
+        # program -> consecutive misses since it last aliased
+        self._missing: Dict[str, int] = {}
+
+    def check(self, signals):
+        d = signals["donation"]
+        prog = str(d.get("program", "?"))
+        if d.get("miss"):
+            self._missing[prog] = self._missing.get(prog, 0) + 1
+        else:
+            self._missing.pop(prog, None)
+        if self._missing:
+            worst = max(self._missing.items(), key=lambda kv: kv[1])
+            return health_mod.DEGRADED, {
+                "programs": sorted(self._missing),
+                "worst_program": worst[0],
+                "misses": int(sum(self._missing.values())),
+            }
+        return health_mod.OK, {"programs": []}
+
+
+DEVICE_DETECTORS = (HbmPressureDetector, DonationMissDetector)
+health_mod.KNOWN_DETECTORS.update(
+    {cls.name: cls for cls in DEVICE_DETECTORS})
+
+
+def ensure_device_detectors(monitor: health_mod.HealthMonitor,
+                            **overrides) -> None:
+    """Install the device detectors on ``monitor`` (idempotent)."""
+    for cls in DEVICE_DETECTORS:
+        monitor.ensure_detector(cls(**overrides.get(cls.name, {})))
+
+
+# -- /devicez provider registry ----------------------------------------------
+
+_providers: Dict[str, Callable[[], Dict]] = {}
+_providers_lock = threading.Lock()
+
+
+def device_payload() -> Dict:
+    """The ``/devicez`` JSON body: every registered provider's payload."""
+    with _providers_lock:
+        items = list(_providers.items())
+    out: Dict = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as e:  # one broken provider must not 500 the route
+            out[name] = {"error": str(e)}
+    return {"device": out}
+
+
+def register_provider(name: str, fn: Callable[[], Dict]) -> None:
+    """Register a ``/devicez`` section provider and (lazily) the route."""
+    with _providers_lock:
+        _providers[name] = fn
+    exporter_mod.register_json_route("/devicez", device_payload)
+
+
+def unregister_provider(name: str) -> None:
+    with _providers_lock:
+        _providers.pop(name, None)
+
+
+# -- compiled-program analytics ----------------------------------------------
+
+
+def _tree_leaves(tree) -> List:
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _spec_tree(tree):
+    """Replace array leaves with ShapeDtypeStructs: the cheap, lifetime-
+    safe record ``offer`` keeps (never the arrays — a catalog must not
+    pin training state live)."""
+    import jax
+
+    def spec(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def _cost_dict(compiled) -> Dict:
+    """``cost_analysis()`` normalized: jax returns a dict when lowered
+    from concrete arrays but a one-element list when lowered from
+    ShapeDtypeStructs — accept both."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+_MEMORY_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes")
+
+
+def _memory_dict(compiled) -> Dict[str, int]:
+    """``memory_analysis()`` fields as a plain dict, plus a
+    ``peak_estimate`` (argument + output + temp − alias: aliased output
+    bytes share their donated input's allocation)."""
+    ma = compiled.memory_analysis()
+    out: Dict[str, int] = {}
+    for f in _MEMORY_FIELDS:
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f.replace("_size_in_bytes", "")] = int(v)
+    if all(k in out for k in ("argument", "output", "temp")):
+        out["peak_estimate"] = max(
+            0, out["argument"] + out["output"] + out["temp"]
+            - out.get("alias", 0))
+    return out
+
+
+def _backend_kind() -> Tuple[Optional[str], Optional[str]]:
+    try:
+        import jax
+        devs = jax.devices()
+        kind = getattr(devs[0], "device_kind", None) if devs else None
+        return jax.default_backend(), kind
+    except Exception:
+        return None, None
+
+
+class ProgramCatalog:
+    """Cost/memory analytics for the compiled programs behind registered
+    jit sites.
+
+    ``offer(name, fn, args)`` records a jit wrapper and the arg specs of
+    one real call (first offer per name wins — one dict check per step
+    afterwards); ``analyze()`` later lowers+compiles from those specs and
+    reads ``cost_analysis()`` / ``memory_analysis()``.  The analysis
+    compile happens at most once per program and only on an explicit
+    read (``payload``/``analyze`` — a ``/devicez`` scrape, a report),
+    NEVER on the step path; flight snapshots serve whatever is cached.
+    ``note_step(dt, program)`` folds observed wall time into an EWMA so
+    utilization (achieved FLOP/s vs :func:`peak_spec`) stays live; on a
+    backend with no peak spec (CPU) utilization is ``None`` —
+    unavailable, not fake.
+
+    Registers as a ``device:<component>`` flight registry and a
+    ``/devicez`` provider; ``close()`` unregisters both.
+    """
+
+    def __init__(self, component: str = "process",
+                 registry: Optional[MetricsRegistry] = None,
+                 monitor: Optional[health_mod.HealthMonitor] = None,
+                 poll_every: int = 32, max_programs: int = 64,
+                 peak_flops: Optional[float] = None,
+                 peak_hbm_bps: Optional[float] = None,
+                 detector_overrides: Optional[Dict] = None):
+        self.component = str(component)
+        self.registry = registry if registry is not None else default_registry()
+        self.poll_every = int(poll_every)
+        self.max_programs = int(max_programs)
+        self.monitor = monitor
+        if monitor is not None:
+            ensure_device_detectors(monitor, **dict(detector_overrides or {}))
+        self.backend, self.device_kind = _backend_kind()
+        if peak_flops is not None or peak_hbm_bps is not None:
+            self.peak: Optional[Tuple[float, float]] = (
+                float(peak_flops or 0.0), float(peak_hbm_bps or 0.0))
+        else:
+            self.peak = peak_spec(self.device_kind)
+        self._lock = threading.Lock()
+        self._programs: Dict[str, Dict] = {}
+        self._steps = 0
+        flight_mod.register_registry(f"device:{self.component}", self)
+        register_provider(self.component, self.payload)
+        # a catalog implies someone wants the device plane: make sure the
+        # POST /profilez trigger exists on this process's ops server
+        profile_trigger()
+
+    def close(self) -> None:
+        flight_mod.unregister_registry(f"device:{self.component}")
+        unregister_provider(self.component)
+
+    # -- registration --------------------------------------------------------
+
+    def offer(self, name: str, fn, args=(), kwargs=None) -> None:
+        """Record a jit site and one call's arg specs.  First offer per
+        name wins, so the per-step cost after that is one dict lookup.
+        A callable without ``.lower`` (a host-side orchestrator like the
+        hier sparse step) registers as unanalyzable rather than raising —
+        honest "unavailable" beats a crash in a call path."""
+        name = str(name)
+        if name in self._programs:  # lock-free fast path (benign race)
+            return
+        with self._lock:
+            if name in self._programs:
+                return
+            if len(self._programs) >= self.max_programs:
+                return
+            rec: Dict = {"fn": fn, "specs": None, "kwspecs": None,
+                         "analysis": None, "error": None,
+                         "steps": 0, "ewma_s": None}
+            if not callable(getattr(fn, "lower", None)):
+                rec["error"] = "not lowerable (host-side orchestrator)"
+            else:
+                try:
+                    rec["specs"] = tuple(_spec_tree(a) for a in args)
+                    rec["kwspecs"] = {
+                        k: _spec_tree(v) for k, v in (kwargs or {}).items()}
+                except Exception as e:
+                    rec["error"] = f"spec capture failed: {e}"
+            self._programs[name] = rec
+
+    def register_compiled(self, name: str, compiled) -> None:
+        """Register an already-compiled executable directly (AOT paths,
+        tests): skips the lower/compile step entirely."""
+        name = str(name)
+        with self._lock:
+            rec = self._programs.setdefault(
+                name, {"fn": None, "specs": None, "kwspecs": None,
+                       "analysis": None, "error": None,
+                       "steps": 0, "ewma_s": None})
+        analysis = self._read_analyses(compiled)
+        with self._lock:
+            rec["analysis"], rec["error"] = analysis, None
+        self._publish(name)
+
+    # -- feed ----------------------------------------------------------------
+
+    def note_step(self, seconds: float, program: str) -> None:
+        """Per-step hook: fold one observed wall time for ``program``
+        into its EWMA + histogram; refresh the utilization gauge every
+        ``poll_every`` steps from CACHED analysis (plain arithmetic —
+        the analysis compile never rides this path)."""
+        program = str(program)
+        dt = float(seconds)
+        due = False
+        with self._lock:
+            rec = self._programs.get(program)
+            if rec is not None:
+                rec["steps"] += 1
+                prev = rec["ewma_s"]
+                rec["ewma_s"] = dt if prev is None else 0.9 * prev + 0.1 * dt
+            self._steps += 1
+            if (self.poll_every > 0 and rec is not None
+                    and rec["analysis"] is not None
+                    and rec["steps"] % self.poll_every == 0):
+                due = True
+        if not gate.enabled():
+            return
+        self.registry.observe(
+            labeled("device_program_time_seconds", program=program), dt)
+        if due:
+            self._publish(program)
+
+    # -- analysis ------------------------------------------------------------
+
+    def _read_analyses(self, compiled) -> Dict:
+        analysis: Dict = {"available": False}
+        try:
+            cd = _cost_dict(compiled)
+            flops = cd.get("flops")
+            ba = cd.get("bytes accessed")
+            analysis["flops"] = None if flops is None else float(flops)
+            analysis["bytes_accessed"] = None if ba is None else float(ba)
+            if flops and ba:
+                analysis["intensity"] = float(flops) / float(ba)
+            analysis["available"] = True
+        except Exception as e:
+            analysis["cost_error"] = str(e)
+        try:
+            analysis["memory"] = _memory_dict(compiled)
+            analysis["available"] = True
+        except Exception as e:
+            analysis["memory_error"] = str(e)
+        return analysis
+
+    def analyze(self, name: Optional[str] = None,
+                force: bool = False) -> Dict[str, Dict]:
+        """Lower+compile each offered program from its recorded specs and
+        read the analyses (cached after the first success; ``force``
+        re-reads).  Explicit-read path only — scrapes, reports, tests."""
+        with self._lock:
+            names = [name] if name is not None else list(self._programs)
+        out: Dict[str, Dict] = {}
+        for n in names:
+            with self._lock:
+                rec = self._programs.get(n)
+                if rec is None:
+                    continue
+                done = rec["analysis"] is not None and not force
+                fn, specs, kwspecs = rec["fn"], rec["specs"], rec["kwspecs"]
+                err = rec["error"]
+            if done:
+                out[n] = rec["analysis"]
+                continue
+            if err is not None or specs is None:
+                out[n] = {"available": False, "unavailable": err or "no specs"}
+                continue
+            try:
+                # one extra backend compile, outside the step path (the
+                # AOT lower() does not reuse the jit cache entry)
+                compiled = fn.lower(*specs, **(kwspecs or {})).compile()
+                analysis = self._read_analyses(compiled)
+            except Exception as e:
+                analysis = {"available": False, "unavailable": str(e)}
+                with self._lock:
+                    rec["error"] = str(e)
+            with self._lock:
+                if analysis.get("available"):
+                    rec["analysis"] = analysis
+            out[n] = analysis
+            if analysis.get("available"):
+                self._publish(n)
+        return out
+
+    def _utilization(self, rec: Dict) -> Dict[str, Optional[float]]:
+        """Achieved FLOP/s / bandwidth from the EWMA step time, and
+        compute utilization against the peak spec — all None when the
+        analysis, timing, or peak is missing (unavailable, never fake)."""
+        analysis = rec.get("analysis") or {}
+        ewma = rec.get("ewma_s")
+        out: Dict[str, Optional[float]] = {
+            "achieved_flops_per_s": None, "achieved_bytes_per_s": None,
+            "utilization": None, "bandwidth_utilization": None}
+        if not ewma or ewma <= 0.0 or not analysis.get("available"):
+            return out
+        flops, ba = analysis.get("flops"), analysis.get("bytes_accessed")
+        if flops:
+            out["achieved_flops_per_s"] = flops / ewma
+        if ba:
+            out["achieved_bytes_per_s"] = ba / ewma
+        if self.peak is not None:
+            pf, pb = self.peak
+            if flops and pf > 0.0:
+                out["utilization"] = (flops / ewma) / pf
+            if ba and pb > 0.0:
+                out["bandwidth_utilization"] = (ba / ewma) / pb
+        return out
+
+    def _publish(self, name: str) -> None:
+        """Gauge refresh for one analyzed program (only values that
+        exist — an unavailable metric publishes nothing)."""
+        if not gate.enabled():
+            return
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None or rec["analysis"] is None:
+                return
+            analysis = dict(rec["analysis"])
+            util = self._utilization(rec)
+        reg = self.registry
+        if analysis.get("flops") is not None:
+            reg.gauge_set(labeled("device_program_flops", program=name),
+                          analysis["flops"])
+        if analysis.get("bytes_accessed") is not None:
+            reg.gauge_set(
+                labeled("device_program_bytes_accessed", program=name),
+                analysis["bytes_accessed"])
+        if analysis.get("intensity") is not None:
+            reg.gauge_set(labeled("device_program_intensity", program=name),
+                          analysis["intensity"])
+        if util["utilization"] is not None:
+            reg.gauge_set(
+                labeled("device_program_utilization", program=name),
+                util["utilization"])
+        for kind, v in (analysis.get("memory") or {}).items():
+            reg.gauge_set(
+                labeled("device_program_memory_bytes", program=name,
+                        kind=kind), v)
+
+    # -- reads (flight duck-type + /devicez section) -------------------------
+
+    def snapshot(self, reset: bool = False) -> Dict:
+        """Cached state only — safe inside a flight dump (no compiles)."""
+        with self._lock:
+            programs = {
+                name: {
+                    "analyzed": rec["analysis"] is not None,
+                    "error": rec["error"],
+                    "steps": rec["steps"],
+                    "ewma_seconds": (None if rec["ewma_s"] is None
+                                     else round(rec["ewma_s"], 6)),
+                    "analysis": rec["analysis"],
+                    **self._utilization(rec),
+                }
+                for name, rec in sorted(self._programs.items())
+            }
+            return {
+                "device": True,
+                "component": self.component,
+                "backend": self.backend,
+                "device_kind": self.device_kind,
+                "peak": (None if self.peak is None
+                         else {"flops_per_s": self.peak[0],
+                               "hbm_bytes_per_s": self.peak[1]}),
+                "steps": self._steps,
+                "programs": programs,
+            }
+
+    def payload(self) -> Dict:
+        """The ``/devicez`` section: an explicit read, so analyses that
+        are still pending run now (one compile per program, once)."""
+        self.analyze()
+        return self.snapshot()
+
+
+_default_lock = threading.Lock()
+_default_catalog: Optional[ProgramCatalog] = None
+
+
+def default_catalog() -> ProgramCatalog:
+    """The process-wide program catalog (production call-site ``offer``
+    sugar registers into it; a trainer-owned catalog keeps its own set).
+    Lazy."""
+    global _default_catalog
+    with _default_lock:
+        if _default_catalog is None:
+            _default_catalog = ProgramCatalog(component="process")
+        return _default_catalog
+
+
+def reset_default_catalog() -> None:
+    """Drop the process catalog (tests)."""
+    global _default_catalog
+    with _default_lock:
+        if _default_catalog is not None:
+            _default_catalog.close()
+            _default_catalog = None
+
+
+def offer(name: str, fn, args=(), kwargs=None) -> None:
+    """Call-site sugar: record a jit site with the process catalog when
+    the device plane is armed (``LIGHTCTR_DEVICE``); a cheap no-op
+    otherwise — safe on serve/online call paths."""
+    c = _default_catalog
+    if c is None:
+        if not resolve_armed(None):
+            return
+        c = default_catalog()
+    c.offer(name, fn, args, kwargs)
+
+
+# -- live-buffer census ------------------------------------------------------
+
+
+class LiveBufferCensus:
+    """Sampler over ``jax.live_arrays()``: bytes bucketed by
+    (shape, dtype, registered source tag).
+
+    Tags are zero-arg suppliers returning an array/pytree (``lambda:
+    (self.params, self.opt_state)``) — matched by object identity at
+    sample time, so the census holds no references between samples and a
+    swapped tree is re-resolved, not pinned.  Arrays no supplier claims
+    stay ``untagged`` (never invented).  Per-tag budgets (plus
+    ``total``) feed the ``hbm_pressure`` detector through the same
+    worst-fraction machinery as the resources plane.  ``maybe_sample()``
+    is the per-step hook — a counter bump with a full sample every
+    ``sample_every`` calls."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 monitor: Optional[health_mod.HealthMonitor] = None,
+                 budgets: Optional[Dict[str, float]] = None,
+                 name: str = "census", sample_every: int = 16,
+                 top_k: int = 8, register: bool = True,
+                 detector_overrides: Optional[Dict] = None):
+        self.name = str(name)
+        self.registry = registry if registry is not None else default_registry()
+        self.monitor = monitor
+        if monitor is not None:
+            ensure_device_detectors(monitor, **dict(detector_overrides or {}))
+        self.sample_every = int(sample_every)
+        self.top_k = int(top_k)
+        self._lock = threading.Lock()
+        self._suppliers: Dict[str, Callable] = {}
+        self.budgets: Dict[str, float] = {
+            str(k): float(v) for k, v in (budgets or {}).items()}
+        self._calls = 0
+        self._last: Dict = {}
+        self._registered = bool(register)
+        if self._registered:
+            flight_mod.register_registry(f"device:census:{self.name}", self)
+            register_provider(f"census:{self.name}", self.payload)
+
+    def close(self) -> None:
+        if self._registered:
+            flight_mod.unregister_registry(f"device:census:{self.name}")
+            unregister_provider(f"census:{self.name}")
+            self._registered = False
+
+    def register_tag(self, tag: str, supplier: Callable) -> None:
+        """``supplier()`` returns the array/pytree whose leaves belong to
+        ``tag`` (resolved fresh every sample)."""
+        with self._lock:
+            self._suppliers[str(tag)] = supplier
+
+    def remove_tag(self, tag: str) -> None:
+        with self._lock:
+            self._suppliers.pop(str(tag), None)
+
+    def set_budget(self, tag: str, budget_bytes: Optional[float]) -> None:
+        with self._lock:
+            if budget_bytes is None:
+                self.budgets.pop(str(tag), None)
+            else:
+                self.budgets[str(tag)] = float(budget_bytes)
+
+    def maybe_sample(self) -> None:
+        with self._lock:
+            self._calls += 1
+            due = (self.sample_every > 0
+                   and self._calls % self.sample_every == 0)
+        if due:
+            self.sample()
+
+    def sample(self) -> Dict:
+        """Walk the live arrays, publish the per-tag gauges, feed the
+        ``hbm_pressure`` signal.  Returns the census summary."""
+        try:
+            import jax
+            arrays = jax.live_arrays()
+        except Exception as e:
+            with self._lock:
+                self._last = {"available": False, "error": str(e)}
+            return dict(self._last)
+        with self._lock:
+            suppliers = dict(self._suppliers)
+            budgets = dict(self.budgets)
+        id_to_tag: Dict[int, str] = {}
+        for tag, fn in suppliers.items():
+            try:
+                for leaf in _tree_leaves(fn()):
+                    id_to_tag[id(leaf)] = tag
+            except Exception:
+                _LOG.debug("census supplier %r failed", tag, exc_info=True)
+        tags: Dict[str, List[float]] = {}
+        buckets: Dict[Tuple[str, str, str], List[float]] = {}
+        total = 0.0
+        count = 0
+        for a in arrays:
+            try:
+                deleted = a.is_deleted()
+            except Exception:
+                deleted = False
+            if deleted:
+                continue
+            try:
+                nb = float(a.nbytes)
+            except Exception:
+                continue
+            tag = id_to_tag.get(id(a), "untagged")
+            total += nb
+            count += 1
+            t = tags.setdefault(tag, [0.0, 0])
+            t[0] += nb
+            t[1] += 1
+            key = (tag, str(tuple(getattr(a, "shape", ()))),
+                   str(getattr(a, "dtype", "?")))
+            b = buckets.setdefault(key, [0.0, 0])
+            b[0] += nb
+            b[1] += 1
+        per_tag_bytes = {tag: int(v[0]) for tag, v in tags.items()}
+        per_tag_bytes["total"] = int(total)
+        top = [
+            {"tag": k[0], "shape": k[1], "dtype": k[2],
+             "bytes": int(v[0]), "count": int(v[1])}
+            for k, v in sorted(buckets.items(),
+                               key=lambda kv: -kv[1][0])[:self.top_k]
+        ]
+        if gate.enabled():
+            reg = self.registry
+            for tag, v in tags.items():
+                reg.gauge_set(labeled("device_live_buffer_bytes", tag=tag),
+                              int(v[0]))
+                reg.gauge_set(labeled("device_live_buffer_count", tag=tag),
+                              int(v[1]))
+            reg.gauge_set(labeled("device_live_buffer_bytes", tag="total"),
+                          int(total))
+            reg.gauge_set(labeled("device_live_buffer_count", tag="total"),
+                          count)
+            for tag, b in budgets.items():
+                reg.gauge_set(labeled("device_live_budget_bytes", tag=tag), b)
+        summary = {
+            "available": True,
+            "total_bytes": int(total),
+            "arrays": count,
+            "tags": {tag: {"bytes": int(v[0]), "count": int(v[1])}
+                     for tag, v in sorted(tags.items())},
+            "top": top,
+            "budgets": budgets,
+        }
+        with self._lock:
+            self._last = summary
+        # monitor feed OUTSIDE the lock: a trip can trigger a flight dump
+        # that reads this census's own snapshot()
+        if (self.monitor is not None and budgets
+                and self.monitor.wants("hbm_pressure")):
+            self.monitor.observe(hbm_pressure={
+                "bytes": per_tag_bytes, "budgets": budgets})
+        return summary
+
+    def snapshot(self, reset: bool = False) -> Dict:
+        with self._lock:
+            return {"device": True, "census": self.name, **self._last}
+
+    def payload(self) -> Dict:
+        return self.snapshot()
+
+
+# -- donation verification ---------------------------------------------------
+
+
+class DonationWatch:
+    """Counters + health feed for donation aliasing checks.
+
+    :func:`verify_donation` wrappers report here; the watch publishes
+    ``device_donation_checks_total`` / ``device_donation_miss_total``
+    per program and feeds the ``donation`` signal to the
+    :class:`DonationMissDetector`."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 monitor: Optional[health_mod.HealthMonitor] = None,
+                 name: str = "donation", register: bool = True):
+        self.name = str(name)
+        self.registry = registry if registry is not None else default_registry()
+        self.monitor = monitor
+        if monitor is not None:
+            ensure_device_detectors(monitor)
+        self._lock = threading.Lock()
+        self._programs: Dict[str, List[int]] = {}
+        self._registered = bool(register)
+        if self._registered:
+            flight_mod.register_registry(f"device:{self.name}", self)
+            register_provider(self.name, self.payload)
+
+    def close(self) -> None:
+        if self._registered:
+            flight_mod.unregister_registry(f"device:{self.name}")
+            unregister_provider(self.name)
+            self._registered = False
+
+    def bind(self, registry: Optional[MetricsRegistry] = None,
+             monitor: Optional[health_mod.HealthMonitor] = None) -> None:
+        """Late wiring for the process-default watch (a trainer arms the
+        device plane after the wrap sites were built — latest wins)."""
+        if registry is not None:
+            self.registry = registry
+        if monitor is not None:
+            self.monitor = monitor
+            ensure_device_detectors(monitor)
+
+    def note(self, program: str, aliased: bool, donated: int = 0) -> None:
+        program = str(program)
+        with self._lock:
+            c = self._programs.setdefault(program, [0, 0])
+            c[0] += 1
+            if not aliased:
+                c[1] += 1
+        if gate.enabled():
+            self.registry.inc(
+                labeled("device_donation_checks_total", program=program))
+            if not aliased:
+                self.registry.inc(
+                    labeled("device_donation_miss_total", program=program))
+        if self.monitor is not None and self.monitor.wants("donation"):
+            self.monitor.observe(donation={
+                "program": program, "miss": not aliased,
+                "donated": int(donated)})
+
+    def snapshot(self, reset: bool = False) -> Dict:
+        with self._lock:
+            return {
+                "device": True,
+                "donation": True,
+                "programs": {
+                    name: {"checks": c[0], "misses": c[1]}
+                    for name, c in sorted(self._programs.items())
+                },
+            }
+
+    def payload(self) -> Dict:
+        return self.snapshot()
+
+
+_watch_lock = threading.Lock()
+_default_watch: Optional[DonationWatch] = None
+
+
+def default_donation_watch() -> DonationWatch:
+    """The process-wide donation watch (wrap-site sugar; a trainer binds
+    its registry/monitor in at arm time).  Lazy."""
+    global _default_watch
+    with _watch_lock:
+        if _default_watch is None:
+            _default_watch = DonationWatch()
+        return _default_watch
+
+
+def reset_default_donation_watch() -> None:
+    """Drop the process donation watch (tests)."""
+    global _default_watch
+    with _watch_lock:
+        if _default_watch is not None:
+            _default_watch.close()
+            _default_watch = None
+
+
+def verify_donation(program: str, fn, donate_argnums=(),
+                    watch: Optional[DonationWatch] = None,
+                    sample_every: int = 8):
+    """Wrap a donated jit callable with aliasing verification.
+
+    Every ``sample_every``-th call records the donated input leaves'
+    ``unsafe_buffer_pointer()`` before the call and checks each appears
+    among the output leaves' pointers after — a donated buffer whose
+    pointer is nowhere in the outputs was silently copied (donation
+    declined), which is a ``donation_miss``.  Pointer reads sync the
+    arrays, hence the sampling; a read that fails skips the check rather
+    than reporting a false verdict.
+
+    Returns ``fn`` UNCHANGED when the device plane is disarmed and no
+    explicit ``watch`` is given (the dark path stays zero-cost), or when
+    there is nothing donated to verify.  ``.lower`` / ``._cache_size``
+    pass through so the wrapper still registers with the program catalog
+    and compile tracker."""
+    if watch is None and not resolve_armed(None):
+        return fn
+    donate = tuple(int(i) for i in (donate_argnums or ()))
+    if not donate:
+        return fn
+    program = str(program)
+    every = max(1, int(sample_every))
+    state = {"n": 0}
+
+    def wrapped(*args, **kwargs):
+        state["n"] += 1
+        ptrs = None
+        if (state["n"] - 1) % every == 0:
+            try:
+                ptrs = [leaf.unsafe_buffer_pointer()
+                        for i in donate if i < len(args)
+                        for leaf in _tree_leaves(args[i])]
+            except Exception:
+                ptrs = None
+        out = fn(*args, **kwargs)
+        if ptrs:
+            try:
+                out_ptrs = set()
+                for leaf in _tree_leaves(out):
+                    p = getattr(leaf, "unsafe_buffer_pointer", None)
+                    if callable(p):
+                        out_ptrs.add(p())
+                missed = [p for p in ptrs if p not in out_ptrs]
+            except Exception:
+                missed = None
+            if missed is not None:
+                w = watch if watch is not None else default_donation_watch()
+                w.note(program, aliased=not missed, donated=len(ptrs))
+        return out
+
+    for attr in ("lower", "_cache_size"):
+        a = getattr(fn, attr, None)
+        if a is not None:
+            setattr(wrapped, attr, a)
+    wrapped.__wrapped__ = fn
+    wrapped.__name__ = getattr(fn, "__name__", program)
+    return wrapped
+
+
+# -- profiler capture --------------------------------------------------------
+
+#: detectors whose bad transition auto-arms a one-shot capture
+AUTO_CAPTURE_TRIGGERS = ("stall", "memory_pressure", "hbm_pressure")
+
+
+class ProfileTrigger:
+    """On-demand ``jax.profiler`` capture over the next N steps.
+
+    ``POST /profilez[?steps=N]`` (or :meth:`arm`) requests a capture;
+    the trace starts at the next :func:`profile_step` boundary and stops
+    N step boundaries later, so a capture covers whole steps.  Refusals
+    are clean and typed: 409 when ``jax.profiler`` is unavailable
+    (:func:`~lightctr_tpu.utils.profiling.profiler_available`), 409 when
+    a capture is already armed/active, 429 inside the rate window
+    (``min_interval_s`` since the last arm — the flight-dump discipline).
+    The capture dir is bounded: only the newest ``max_captures`` are
+    kept.  Anomaly coupling: :func:`install_auto_capture` arms a
+    one-shot capture when a :data:`AUTO_CAPTURE_TRIGGERS` detector goes
+    bad (``LIGHTCTR_PROFILE_AUTO=1`` at obs import)."""
+
+    def __init__(self, base_dir: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 min_interval_s: Optional[float] = None,
+                 max_captures: int = 4, default_steps: int = 3,
+                 register: bool = True,
+                 clock: Callable[[], float] = time.monotonic):
+        if base_dir is None:
+            base_dir = os.environ.get("LIGHTCTR_PROFILE_DIR")
+        if base_dir is None:
+            import tempfile
+            base_dir = os.path.join(tempfile.gettempdir(),
+                                    "lightctr_profiles")
+        self.base_dir = str(base_dir)
+        self.registry = registry if registry is not None else default_registry()
+        if min_interval_s is None:
+            try:
+                min_interval_s = float(
+                    os.environ.get("LIGHTCTR_PROFILE_MIN_S", "60"))
+            except ValueError:
+                min_interval_s = 60.0
+        self.min_interval_s = float(min_interval_s)
+        self.max_captures = int(max_captures)
+        self.default_steps = int(default_steps)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._armed_steps: Optional[int] = None
+        self._remaining = 0
+        self._active_dir: Optional[str] = None
+        self._reason: Optional[str] = None
+        self._last_arm: Optional[float] = None
+        self._captures: List[Dict] = []
+        self._seq = 0
+        # fast flag: profile_step() reads this before taking any lock
+        self._engaged = False
+        self._registered = bool(register)
+        if self._registered:
+            exporter_mod.register_post_route("/profilez", self.handle_post)
+            register_provider("profile", self.payload)
+
+    def close(self) -> None:
+        with self._lock:
+            active = self._active_dir is not None
+            self._armed_steps, self._remaining = None, 0
+            self._engaged = False
+        if active:
+            self._stop_trace()
+        if self._registered:
+            exporter_mod.unregister_post_route("/profilez")
+            unregister_provider("profile")
+            self._registered = False
+
+    # -- arming --------------------------------------------------------------
+
+    def available(self) -> Tuple[bool, str]:
+        from lightctr_tpu.utils import profiling
+        return profiling.profiler_available()
+
+    def _refuse(self, reason: str, detail: Dict) -> Tuple[bool, Dict]:
+        if gate.enabled():
+            self.registry.inc(
+                labeled("device_profile_refused_total", reason=reason))
+        return False, {"refused": reason, **detail}
+
+    def arm(self, steps: Optional[int] = None,
+            reason: str = "ops") -> Tuple[bool, Dict]:
+        """Request a capture of the next ``steps`` steps.  Returns
+        ``(ok, info)``; a refusal never raises — the auto-arm path runs
+        inside health emission."""
+        n = self.default_steps if not steps else int(steps)
+        n = max(1, min(n, 1000))
+        ok, why = self.available()
+        if not ok:
+            return self._refuse("unavailable", {"detail": why})
+        now = self._clock()
+        with self._lock:
+            if self._armed_steps is not None or self._active_dir is not None:
+                return self._refuse("busy", {"detail": "capture in progress"})
+            if (self._last_arm is not None
+                    and now - self._last_arm < self.min_interval_s):
+                return self._refuse("rate_limited", {
+                    "retry_after_s": round(
+                        self.min_interval_s - (now - self._last_arm), 3)})
+            self._last_arm = now
+            self._armed_steps = n
+            self._reason = str(reason)
+            self._engaged = True
+        events_mod.emit("profile_arm", steps=n, reason=str(reason))
+        return True, {"steps": n, "reason": str(reason),
+                      "dir": self.base_dir}
+
+    # -- step feed -----------------------------------------------------------
+
+    def engaged(self) -> bool:
+        return self._engaged
+
+    def on_step(self) -> None:
+        """Step-boundary hook: start an armed capture, count down an
+        active one, stop+finalize when it has covered its steps."""
+        with self._lock:
+            if self._armed_steps is not None and self._active_dir is None:
+                n, reason = self._armed_steps, self._reason
+                self._armed_steps = None
+                self._seq += 1
+                cap_dir = os.path.join(self.base_dir,
+                                       f"capture-{self._seq:04d}")
+                start, stop = True, False
+            elif self._active_dir is not None:
+                self._remaining -= 1
+                start = False
+                stop = self._remaining <= 0
+                cap_dir, n, reason = self._active_dir, 0, self._reason
+            else:
+                self._engaged = False
+                return
+        if start:
+            try:
+                os.makedirs(cap_dir, exist_ok=True)
+                import jax
+                jax.profiler.start_trace(cap_dir)
+            except Exception as e:
+                _LOG.warning("profiler capture failed to start: %s", e)
+                events_mod.emit("profile_capture", dir=cap_dir,
+                                error=str(e), reason=reason)
+                with self._lock:
+                    self._engaged = False
+                if gate.enabled():
+                    self.registry.inc(labeled(
+                        "device_profile_refused_total", reason="start_failed"))
+                return
+            with self._lock:
+                self._active_dir = cap_dir
+                self._remaining = n
+            return
+        if stop:
+            self._stop_trace()
+
+    def _stop_trace(self) -> None:
+        with self._lock:
+            cap_dir, reason = self._active_dir, self._reason
+            self._active_dir, self._reason = None, None
+            self._engaged = False
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            _LOG.warning("profiler capture failed to stop: %s", e)
+        files = 0
+        if cap_dir:
+            for _root, _dirs, names in os.walk(cap_dir):
+                files += len(names)
+        if gate.enabled():
+            self.registry.inc("device_profile_captures_total")
+        events_mod.emit("profile_capture", dir=cap_dir, files=files,
+                        reason=reason)
+        with self._lock:
+            self._captures.append({"dir": cap_dir, "files": files,
+                                   "reason": reason})
+            evict = [c["dir"] for c in self._captures[:-self.max_captures]]
+            self._captures = self._captures[-self.max_captures:]
+        # bounded capture dir: drop the oldest landed captures
+        for old in evict:
+            try:
+                shutil.rmtree(old, ignore_errors=True)
+            except Exception:
+                pass
+
+    # -- surfaces ------------------------------------------------------------
+
+    def handle_post(self, query: Dict[str, list]) -> Tuple[int, Dict]:
+        """The ``POST /profilez`` handler (exporter post route)."""
+        steps = None
+        try:
+            steps = int(query.get("steps", ["0"])[0]) or None
+        except (ValueError, IndexError):
+            steps = None
+        ok, info = self.arm(steps=steps, reason="ops:profilez")
+        if ok:
+            return 200, {"armed": info}
+        code = {"unavailable": 409, "busy": 409,
+                "rate_limited": 429}.get(info.get("refused"), 409)
+        return code, {"error": f"profile capture refused: "
+                               f"{info.get('refused')}", **info}
+
+    def payload(self) -> Dict:
+        with self._lock:
+            return {
+                "device": True,
+                "dir": self.base_dir,
+                "armed_steps": self._armed_steps,
+                "active": self._active_dir,
+                "remaining": self._remaining,
+                "min_interval_s": self.min_interval_s,
+                "captures": list(self._captures),
+            }
+
+    def snapshot(self, reset: bool = False) -> Dict:
+        return self.payload()
+
+
+_trigger_lock = threading.Lock()
+_trigger: Optional[ProfileTrigger] = None
+
+
+def profile_trigger(**kwargs) -> ProfileTrigger:
+    """The process profiler trigger (lazy; kwargs only apply to the
+    creating call)."""
+    global _trigger
+    with _trigger_lock:
+        if _trigger is None:
+            _trigger = ProfileTrigger(**kwargs)
+        return _trigger
+
+
+def reset_profile_trigger() -> None:
+    """Drop the process trigger (tests)."""
+    global _trigger
+    with _trigger_lock:
+        if _trigger is not None:
+            _trigger.close()
+            _trigger = None
+
+
+def profile_step() -> None:
+    """Per-step hook every trainer calls unconditionally: one global +
+    one flag read when no capture is armed (the common case)."""
+    t = _trigger
+    if t is not None and t._engaged:
+        t.on_step()
+
+
+def _on_anomaly(component: str, detector: str, prev: str, new: str,
+                detail: Dict) -> None:
+    if detector not in AUTO_CAPTURE_TRIGGERS:
+        return
+    if health_mod.SEVERITY.get(new, 0) <= health_mod.SEVERITY[health_mod.OK]:
+        return
+    ok, info = profile_trigger().arm(
+        reason=f"auto:{component}:{detector}")
+    if not ok:
+        _LOG.debug("auto profile capture refused: %s", info)
+
+
+def install_auto_capture() -> None:
+    """Arm anomaly-coupled capture: a bad ``stall`` / ``memory_pressure``
+    / ``hbm_pressure`` transition one-shot-arms the profiler (refusals
+    log at debug; the rate window applies)."""
+    health_mod.register_anomaly_listener(_on_anomaly)
+
+
+def uninstall_auto_capture() -> None:
+    health_mod.unregister_anomaly_listener(_on_anomaly)
+
+
+def maybe_auto_capture_from_env() -> None:
+    """``LIGHTCTR_PROFILE_AUTO=1`` installs the anomaly auto-capture
+    hook (obs/__init__ calls this once at import)."""
+    v = os.environ.get("LIGHTCTR_PROFILE_AUTO", "").strip().lower()
+    if v not in ("", "0", "false", "off", "no"):
+        install_auto_capture()
+
+
+# -- cluster rollup extraction ----------------------------------------------
+
+
+def device_rollup(members: Dict[str, Dict]) -> Dict:
+    """Extract the per-member device series from a cluster rollup dump.
+
+    ``members`` is ``ClusterRollup.members()``-shaped.  Returns
+    per-member ``device_*`` gauges/counters plus cluster verdicts: the
+    lowest compute utilization program (``lowest_utilization`` — the
+    first place to look when a host lags), the member with donation
+    misses (``donation_misses``), and the biggest live-buffer tag
+    (``biggest_live``)."""
+    from lightctr_tpu.obs.quality import _parse_labels
+
+    out: Dict = {"members": {}, "lowest_utilization": None,
+                 "donation_misses": None, "biggest_live": None}
+    lowest: Optional[Tuple[str, str, float]] = None
+    misses: Optional[Tuple[str, str, float]] = None
+    biggest: Optional[Tuple[str, str, float]] = None
+    for member, entry in sorted((members or {}).items()):
+        snap = (entry or {}).get("snapshot") or {}
+        rec: Dict = {"gauges": {}, "counters": {}}
+        for kind in ("gauges", "counters"):
+            for series, value in (snap.get(kind) or {}).items():
+                name, labels = _parse_labels(series)
+                if not name.startswith("device_"):
+                    continue
+                rec[kind][series] = value
+                if name == "device_program_utilization":
+                    prog = labels.get("program", "?")
+                    if lowest is None or float(value) < lowest[2]:
+                        lowest = (member, prog, float(value))
+                elif name == "device_donation_miss_total":
+                    prog = labels.get("program", "?")
+                    if float(value) > 0 and (
+                            misses is None or float(value) > misses[2]):
+                        misses = (member, prog, float(value))
+                elif name == "device_live_buffer_bytes":
+                    tag = labels.get("tag", "?")
+                    if tag != "total" and (
+                            biggest is None or float(value) > biggest[2]):
+                        biggest = (member, tag, float(value))
+        if rec["gauges"] or rec["counters"]:
+            out["members"][member] = rec
+    if lowest is not None:
+        out["lowest_utilization"] = {"member": lowest[0],
+                                     "program": lowest[1],
+                                     "utilization": round(lowest[2], 6)}
+    if misses is not None:
+        out["donation_misses"] = {"member": misses[0], "program": misses[1],
+                                  "misses": int(misses[2])}
+    if biggest is not None:
+        out["biggest_live"] = {"member": biggest[0], "tag": biggest[1],
+                               "bytes": int(biggest[2])}
+    return out

@@ -19,7 +19,9 @@ and ``master`` processes alike:
 
 Services can add JSON routes of their own with :func:`register_json_route`
 (the master's cluster rollup serves ``/stragglerz`` this way — the
-straggler-attribution verdict, docs/OBSERVABILITY.md).
+straggler-attribution verdict, docs/OBSERVABILITY.md) and POST routes
+with :func:`register_post_route` (the device plane's ``POST /profilez``
+profiler trigger).
 
 Arming: ``LIGHTCTR_OPS_PORT=<port>`` starts the server at obs import in
 every process that inherits the variable (port ``0`` auto-assigns — the
@@ -89,6 +91,36 @@ def unregister_json_route(path: str) -> None:
 def json_routes() -> Dict[str, Callable[[], Dict]]:
     with _routes_lock:
         return dict(_json_routes)
+
+
+# POST routes: handler(query) -> (http_status, json_body).  The device
+# plane's profiler trigger mounts ``POST /profilez`` this way — same
+# replace-on-reregister semantics as the GET routes.
+_post_routes: Dict[str, Callable[[Dict[str, list]], Tuple[int, Dict]]] = {}
+
+
+def register_post_route(
+        path: str,
+        handler: Callable[[Dict[str, list]], Tuple[int, Dict]]) -> None:
+    """Serve ``handler(query) -> (status, body)`` for ``POST path`` on
+    every ops server in this process.  ``query`` is the parsed query
+    string (``parse_qs`` shape); raising yields a 500."""
+    path = "/" + str(path).strip("/")
+    if path in _BUILTIN_ROUTES:
+        raise ValueError(f"{path!r} is a built-in ops route")
+    with _routes_lock:
+        _post_routes[path] = handler
+
+
+def unregister_post_route(path: str) -> None:
+    path = "/" + str(path).strip("/")
+    with _routes_lock:
+        _post_routes.pop(path, None)
+
+
+def post_routes() -> Dict[str, Callable]:
+    with _routes_lock:
+        return dict(_post_routes)
 
 
 # -- payload builders (module-level: tools/tests reuse them) -----------------
@@ -217,7 +249,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         try:
-            path = urlsplit(self.path).path.rstrip("/")
+            url = urlsplit(self.path)
+            path = url.path.rstrip("/")
             if path == "/flightz":
                 if not flight_mod.armed():
                     # an unarmed process has no bundle destination; the
@@ -235,7 +268,13 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._reply_json(200, {"bundle": bundle})
             else:
-                self._reply_json(404, {"error": f"no route {path!r}"})
+                with _routes_lock:
+                    handler = _post_routes.get(path)
+                if handler is not None:
+                    code, body = handler(parse_qs(url.query))
+                    self._reply_json(code, body)
+                else:
+                    self._reply_json(404, {"error": f"no route {path!r}"})
         except Exception:
             _LOG.debug("ops handler failed", exc_info=True)
             try:

@@ -77,6 +77,35 @@ def worst(statuses) -> str:
     return out
 
 
+# -- anomaly listeners -------------------------------------------------------
+#
+# Process-wide hooks fired on every EFFECTIVE detector transition (after
+# hysteresis), outside the monitor lock: fn(component, detector, prev,
+# new, detail).  The device plane's anomaly-coupled profiler capture
+# subscribes here; listeners must never raise into observe() — failures
+# are swallowed at debug level.
+
+_anomaly_lock = threading.Lock()
+_anomaly_listeners: list = []
+
+
+def register_anomaly_listener(fn: Callable) -> None:
+    with _anomaly_lock:
+        if fn not in _anomaly_listeners:
+            _anomaly_listeners.append(fn)
+
+
+def unregister_anomaly_listener(fn: Callable) -> None:
+    with _anomaly_lock:
+        if fn in _anomaly_listeners:
+            _anomaly_listeners.remove(fn)
+
+
+def anomaly_listeners() -> list:
+    with _anomaly_lock:
+        return list(_anomaly_listeners)
+
+
 # -- process gate ------------------------------------------------------------
 
 _enabled: bool = os.environ.get("LIGHTCTR_HEALTH", "1").strip().lower() not in (
@@ -732,6 +761,11 @@ class HealthMonitor:
                         status=new, prev=prev, detail=detail)
         _LOG.warning("health: %s/%s %s -> %s %s", self.component, name,
                      prev, new, detail)
+        for fn in anomaly_listeners():
+            try:
+                fn(self.component, name, prev, new, detail)
+            except Exception:
+                _LOG.debug("anomaly listener failed", exc_info=True)
 
     def _emit_aggregate(self, prev, new, trigger) -> None:
         self.registry.gauge_set(

@@ -680,20 +680,45 @@ def host_rss_bytes() -> Optional[int]:
     return None
 
 
+def device_hbm_bytes() -> Optional[Dict[str, int]]:
+    """Per-device ``bytes_in_use`` via
+    :func:`lightctr_tpu.utils.system.device_memory_stats` — a dict source
+    (``{devN: bytes}`` fanning out as ``hbm_devN``) on backends whose
+    allocator exposes stats (TPU); None where it does not (CPU), so the
+    sample is skipped honestly rather than reported as zero."""
+    try:
+        import jax
+
+        from lightctr_tpu.utils import system as system_mod
+        devices = jax.devices()
+    except Exception:
+        return None
+    out: Dict[str, int] = {}
+    for i, d in enumerate(devices):
+        stats = system_mod.device_memory_stats(d)
+        if stats and "bytes_in_use" in stats:
+            out[f"dev{i}"] = int(stats["bytes_in_use"])
+    return out or None
+
+
 class MemorySampler:
     """Rolls every tracked byte family into ``resource_memory_bytes{kind}``.
 
     Sources are zero-arg callables returning bytes (or None to skip this
     sample) — the tiered store's ``memory_bytes()`` tiers, a device
-    block, peak round bytes.  Host RSS is a built-in source.  Budgets
-    (bytes per kind) publish as ``resource_memory_budget_bytes{kind}``
-    and drive :class:`MemoryPressureDetector`; kinds without budgets are
-    tracked but never judged."""
+    block, peak round bytes.  Host RSS and per-device HBM use
+    (:func:`device_hbm_bytes` — ``hbm_devN`` kinds, skipped on backends
+    without allocator stats) are built-in sources.  Budgets (bytes per
+    kind) publish as ``resource_memory_budget_bytes{kind}`` and drive
+    :class:`MemoryPressureDetector`; kinds without budgets are tracked
+    but never judged — :meth:`budget_devices` budgets each device at a
+    fraction of its reported ``bytes_limit``."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  monitor: Optional[health_mod.HealthMonitor] = None,
                  budgets: Optional[Dict[str, float]] = None,
-                 include_host: bool = True, register: bool = True,
+                 include_host: bool = True, include_device: bool = True,
+                 register: bool = True,
                  name: str = "memory",
                  detector_overrides: Optional[Dict] = None):
         self.name = str(name)
@@ -709,6 +734,8 @@ class MemorySampler:
         self._last: Dict[str, int] = {}
         if include_host:
             self._sources["host_rss"] = host_rss_bytes
+        if include_device:
+            self._sources["hbm"] = device_hbm_bytes
         self._registered = bool(register)
         if self._registered:
             register_provider(f"memory:{self.name}", self.payload)
@@ -732,6 +759,28 @@ class MemorySampler:
                 self.budgets.pop(str(kind), None)
             else:
                 self.budgets[str(kind)] = float(budget_bytes)
+
+    def budget_devices(self, fraction: float = 0.9) -> Dict[str, float]:
+        """Budget each accelerator's ``hbm_devN`` kind at ``fraction`` of
+        its reported ``bytes_limit`` so HBM fill drives the
+        memory-pressure detector like any tier budget.  Returns the
+        budgets set — empty on backends without allocator stats (CPU):
+        no stats means no budget, never a guessed one."""
+        out: Dict[str, float] = {}
+        try:
+            import jax
+
+            from lightctr_tpu.utils import system as system_mod
+            devices = jax.devices()
+        except Exception:
+            return out
+        for i, d in enumerate(devices):
+            stats = system_mod.device_memory_stats(d)
+            if stats and stats.get("bytes_limit"):
+                b = float(stats["bytes_limit"]) * float(fraction)
+                out[f"hbm_dev{i}"] = b
+                self.set_budget(f"hbm_dev{i}", b)
+        return out
 
     def sample(self) -> Dict[str, int]:
         """Read every source, publish the gauges, feed the detector.

@@ -36,6 +36,7 @@ from typing import Dict, Iterable, Optional
 
 import numpy as np
 
+from lightctr_tpu.obs import device as obs_device
 from lightctr_tpu.obs import events as events_mod
 from lightctr_tpu.obs import gate as obs_gate
 from lightctr_tpu.obs import resources as obs_resources
@@ -218,10 +219,11 @@ class OnlineTrainer:
                 "vals": mb["vals"], "mask": mb["mask"],
                 "labels": mb["labels"],
             }
-            out, g = self._grads_fn(
-                jnp.asarray(gathered),
-                {k: jnp.asarray(v) for k, v in batch.items()},
-            )
+            rows_j = jnp.asarray(gathered)
+            batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+            obs_device.offer("online_grads_fm", self._grads_fn,
+                             (rows_j, batch_j))
+            out, g = self._grads_fn(rows_j, batch_j)
             loss, probs = out if self._aux else (out, None)
             ok = self.ps.push_arrays(
                 self.worker_id, u, np.asarray(g)[: len(u)],
@@ -252,12 +254,15 @@ class OnlineTrainer:
                 "vals": mb["vals"], "mask": mb["mask"],
                 "rep_mask": rep_mask, "labels": mb["labels"],
             }
-            out, (g_w, g_e, g_fc1, g_fc2) = self._grads_fn(
+            wd_args = (
                 jnp.asarray(rows[iw, 0]), jnp.asarray(rows[ie, 1:]),
                 {k: jnp.asarray(v) for k, v in self.dense["fc1"].items()},
                 {k: jnp.asarray(v) for k, v in self.dense["fc2"].items()},
                 {k: jnp.asarray(v) for k, v in batch.items()},
             )
+            obs_device.offer("online_grads_widedeep", self._grads_fn,
+                             wd_args)
+            out, (g_w, g_e, g_fc1, g_fc2) = self._grads_fn(*wd_args)
             loss, probs = out if self._aux else (out, None)
             G = np.zeros((len(keys), self.row_dim), np.float32)
             G[iw[: len(uw)], 0] = np.asarray(g_w)[: len(uw)]

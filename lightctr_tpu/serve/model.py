@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from lightctr_tpu.obs import device as obs_device
 from lightctr_tpu.ops.activations import sigmoid
 
 
@@ -239,6 +240,9 @@ class ServingModel:
         b = int(np.asarray(arrays["fids"]).shape[0]) if "fids" in arrays \
             else int(np.asarray(arrays["rep_fids"]).shape[0])
         batch = self._pad_batch(arrays, _next_pow2(b))
+        # device-plane program registration (no-op unless LIGHTCTR_DEVICE)
+        obs_device.offer(f"serve_score_local_{self.kind}",
+                         self._jit_local, (self.params, batch))
         return np.asarray(self._jit_local(self.params, batch))[:b]
 
     @staticmethod
@@ -300,6 +304,8 @@ class ServingModel:
                 [rows, jnp.zeros((k_pad - len(uids), self.row_dim),
                                  jnp.float32)], axis=0)
         dev_batch = self._pad_batch(batch, _next_pow2(b))
+        obs_device.offer(f"serve_score_rows_{self.kind}",
+                         self._jit_rows, (self.params, rows, dev_batch))
         return np.asarray(
             self._jit_rows(self.params, rows, dev_batch)
         )[:b]

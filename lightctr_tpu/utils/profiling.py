@@ -30,6 +30,22 @@ from lightctr_tpu.obs import trace as _trace
 _LOG = logging.getLogger(__name__)
 
 
+def profiler_available() -> "tuple[bool, str]":
+    """Whether ``jax.profiler`` can be imported here: ``(ok, why)``.
+    The device plane's ``POST /profilez`` checks this BEFORE arming so a
+    capture request on a profiler-less worker is a clean 409, not a
+    mid-step exception."""
+    try:
+        import jax
+
+        profiler = jax.profiler
+    except Exception as e:
+        return False, f"jax.profiler unavailable: {e}"
+    if not callable(getattr(profiler, "start_trace", None)):
+        return False, "jax.profiler has no start_trace"
+    return True, "ok"
+
+
 @contextlib.contextmanager
 def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
     """jax.profiler trace around a region; view in TensorBoard/Perfetto.
@@ -54,11 +70,30 @@ def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
         return
     _events.emit("trace_capture", log_dir=str(log_dir),
                  perfetto_link=bool(create_perfetto_link))
-    profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    except Exception as e:
+        # an importable profiler whose backend refuses to start (double
+        # start, unsupported platform) degrades the same way as an absent
+        # one: a logged no-op, never an exception into the caller's step
+        _LOG.warning(
+            "jax.profiler failed to start (%s): profiling.trace(%r) is a "
+            "no-op", e, log_dir,
+        )
+        _events.emit("trace_capture", log_dir=str(log_dir),
+                     perfetto_link=bool(create_perfetto_link),
+                     unavailable=True, error=str(e))
+        yield
+        return
     try:
         yield
     finally:
-        profiler.stop_trace()
+        try:
+            profiler.stop_trace()
+        except Exception:
+            _LOG.warning("jax.profiler failed to stop the trace",
+                         exc_info=True)
 
 
 class wall_clock:

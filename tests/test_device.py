@@ -1,0 +1,723 @@
+"""Device & compiled-program observability plane (ISSUE 19): the
+program catalog's HLO cost/memory analytics with roofline utilization
+(honest "unavailable" on CPU), the jax.live_arrays() census feeding the
+``hbm_pressure`` detector, donation-aliasing verification feeding
+``donation_miss``, the ``POST /profilez`` on-demand capture trigger with
+its typed refusals, the ``/devicez`` route + cluster rollup, the report
+tooling (``metrics_report --device``, ``device_report``, the flight
+bundle's device section, ``bench_history`` device folds), the <5%
+overhead guard WITH catalog + census armed, and the acceptance paths:
+an oversized live-buffer workload trips hbm_pressure into a real
+/healthz 503 + flight bundle, and a donation-broken control trips
+donation_miss while the aliased merge_apply-shaped update stays clean."""
+
+import ast
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightctr_tpu import TrainConfig, obs
+from lightctr_tpu.models import fm
+from lightctr_tpu.models.ctr_trainer import CTRTrainer
+from lightctr_tpu.obs import device, exporter, flight, health
+from lightctr_tpu.obs import trace as trace_mod
+from lightctr_tpu.serve.model import ServingModel
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_ROOT = Path(REPO_ROOT) / "lightctr_tpu"
+
+F, K = 256, 8
+
+
+def _monitor(**kw):
+    kw.setdefault("registry", obs.MetricsRegistry())
+    kw.setdefault("flight_min_interval_s", 0.0)
+    return health.HealthMonitor(**kw)
+
+
+def _get(url, timeout=5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read()
+            code = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+    try:
+        return code, json.loads(body)
+    except json.JSONDecodeError:
+        return code, body.decode()
+
+
+def _post(url, timeout=10.0):
+    req = urllib.request.Request(url, data=b"", method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read()
+            code = r.status
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        code = e.code
+    return code, json.loads(body)
+
+
+def _toy_trainer(d=32, **kw):
+    params = {"w": np.zeros((d,), np.float32)}
+    return CTRTrainer(params, lambda p, b: b["x"] @ p["w"],
+                      TrainConfig(learning_rate=0.1), **kw)
+
+
+# -- series lint (the RESOURCE/QUALITY_SERIES contract) ----------------------
+
+
+def test_every_device_series_is_declared_and_emitted():
+    """No dark device series: every ``device_*`` metric obs/device.py
+    EMITS (a literal first argument of a registry ``inc``/``gauge_set``/
+    ``observe`` call, directly or through ``labeled(...)``) must be
+    declared in ``DEVICE_SERIES`` — and every declared series must
+    actually be emitted.  Wiring files (trainers, serve, tiered, online)
+    go through the classes here, so this one lint covers the family."""
+    src = (LIB_ROOT / "obs" / "device.py").read_text()
+    tree = ast.parse(src, filename="obs/device.py")
+
+    emitted = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("inc", "gauge_set", "observe")
+                and node.args):
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name)
+                and arg.func.id == "labeled" and arg.args):
+            arg = arg.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith("device_"):
+            emitted.add(arg.value)
+
+    declared = set(device.DEVICE_SERIES)
+    assert emitted, "no device_* emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "device_* series emitted but missing from DEVICE_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "DEVICE_SERIES declares series never emitted "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(device.DEVICE_SERIES) == len(declared), \
+        "duplicate names in DEVICE_SERIES"
+
+
+# -- detectors ---------------------------------------------------------------
+
+
+def test_device_detectors_are_known():
+    """hbm_pressure and donation_miss ride the PR-4 detector registry so
+    ops overrides (LIGHTCTR_HEALTH_DETECTORS) can name them."""
+    assert health.KNOWN_DETECTORS["hbm_pressure"] \
+        is device.HbmPressureDetector
+    assert health.KNOWN_DETECTORS["donation_miss"] \
+        is device.DonationMissDetector
+
+
+def test_hbm_pressure_detector_judges_only_budgeted_tags():
+    det = device.HbmPressureDetector(degraded=0.85, unhealthy=0.95)
+    st, detail = det.check({"hbm_pressure": {
+        "bytes": {"embed": 10**12}, "budgets": {}}})
+    assert st == health.OK and detail["skipped"] == "no budgets"
+    st, _ = det.check({"hbm_pressure": {
+        "bytes": {"embed": 10, "total": 10}, "budgets": {"embed": 100}}})
+    assert st == health.OK
+    st, detail = det.check({"hbm_pressure": {
+        "bytes": {"embed": 90, "total": 95}, "budgets": {"embed": 100}}})
+    assert st == health.DEGRADED and detail["worst_kind"] == "embed"
+    st, detail = det.check({"hbm_pressure": {
+        "bytes": {"embed": 99}, "budgets": {"embed": 100}}})
+    assert st == health.UNHEALTHY and detail["fraction"] == 0.99
+
+
+def test_donation_miss_detector_trips_and_recovers_per_program():
+    det = device.DonationMissDetector()
+    st, _ = det.check({"donation": {"program": "p", "miss": False}})
+    assert st == health.OK
+    st, detail = det.check({"donation": {"program": "p", "miss": True}})
+    assert st == health.DEGRADED
+    assert detail["worst_program"] == "p" and detail["misses"] == 1
+    st, detail = det.check({"donation": {"program": "q", "miss": True}})
+    assert st == health.DEGRADED and detail["programs"] == ["p", "q"]
+    # a re-jitted replacement that aliases again recovers ITS program
+    st, detail = det.check({"donation": {"program": "p", "miss": False}})
+    assert st == health.DEGRADED and detail["programs"] == ["q"]
+    st, detail = det.check({"donation": {"program": "q", "miss": False}})
+    assert st == health.OK and detail["programs"] == []
+
+
+# -- program catalog ---------------------------------------------------------
+
+
+def test_program_catalog_analyzes_lazily_and_reports_honestly():
+    """offer() records specs only (no compile on the step path); an
+    explicit analyze() reads real HLO cost/memory numbers; CPU has no
+    peak spec, so utilization is None — unavailable, never fake."""
+    reg = obs.MetricsRegistry()
+    cat = device.ProgramCatalog(component="cat_unit", registry=reg,
+                                poll_every=1)
+    f = jax.jit(lambda a, b: a @ b)
+    x = np.zeros((64, 64), np.float32)
+    try:
+        with obs.override(True):
+            cat.offer("mm", f, (x, x))
+            cat.note_step(0.01, "mm")
+            # nothing compiled yet: the step path never analyzes
+            snap = cat.snapshot()
+            assert snap["programs"]["mm"]["analyzed"] is False
+            assert snap["programs"]["mm"]["ewma_seconds"] == 0.01
+
+            ana = cat.analyze()["mm"]
+            assert ana["available"] is True
+            assert ana["flops"] == 2 * 64 ** 3  # the matmul FLOP count
+            assert ana["bytes_accessed"] > 0 and ana["intensity"] > 0
+            mem = ana["memory"]
+            assert mem["argument"] == 2 * 64 * 64 * 4
+            assert mem["output"] == 64 * 64 * 4
+            assert mem["peak_estimate"] >= mem["output"]
+
+            rec = cat.snapshot()["programs"]["mm"]
+            assert rec["analyzed"] is True
+            assert rec["achieved_flops_per_s"] > 0
+            # honesty: CPU has no PEAK_SPECS entry
+            assert cat.peak is None and rec["utilization"] is None
+
+            rs = reg.snapshot()
+            assert rs["gauges"][obs.labeled(
+                "device_program_flops", program="mm")] == ana["flops"]
+            assert rs["gauges"][obs.labeled(
+                "device_program_intensity", program="mm")] > 0
+            assert obs.labeled("device_program_utilization", program="mm") \
+                not in rs["gauges"]  # unavailable publishes nothing
+            assert rs["histograms"][obs.labeled(
+                "device_program_time_seconds", program="mm")]["count"] == 1
+
+            # a host-side orchestrator registers as honestly unanalyzable
+            cat.offer("host_fn", lambda: None)
+            out = cat.analyze("host_fn")["host_fn"]
+            assert out["available"] is False
+            assert "not lowerable" in out["unavailable"]
+
+            # flight + /devicez lifecycle
+            assert "device:cat_unit" in flight.registered_registries()
+            assert "cat_unit" in device.device_payload()["device"]
+            assert "/devicez" in exporter.json_routes()
+    finally:
+        cat.close()
+    assert "device:cat_unit" not in flight.registered_registries()
+    assert "cat_unit" not in device.device_payload()["device"]
+
+
+def test_program_catalog_roofline_against_explicit_peak():
+    reg = obs.MetricsRegistry()
+    cat = device.ProgramCatalog(component="cat_peak", registry=reg,
+                                peak_flops=1e12, peak_hbm_bps=1e11)
+    f = jax.jit(lambda a, b: a @ b)
+    x = np.zeros((32, 32), np.float32)
+    try:
+        with obs.override(True):
+            cat.offer("mm", f, (x, x))
+            cat.note_step(0.001, "mm")
+            ana = cat.analyze()["mm"]
+            rec = cat.snapshot()["programs"]["mm"]
+        expect = (ana["flops"] / 0.001) / 1e12
+        assert abs(rec["utilization"] - expect) < 1e-9
+        assert reg.snapshot()["gauges"][obs.labeled(
+            "device_program_utilization", program="mm")] == \
+            rec["utilization"]
+    finally:
+        cat.close()
+
+
+# -- live-buffer census ------------------------------------------------------
+
+
+def test_census_buckets_by_tag_and_never_invents_one():
+    reg = obs.MetricsRegistry()
+    cen = device.LiveBufferCensus(registry=reg, name="cen_unit",
+                                  sample_every=2, register=False)
+    w = jnp.ones((128, 16), jnp.float32)  # 8 KiB, tagged
+    cen.register_tag("weights", lambda: {"w": w})
+    try:
+        with obs.override(True):
+            cen.maybe_sample()  # call 1 of 2: not due yet
+            assert cen.snapshot().get("available") is None
+            cen.maybe_sample()  # due
+        last = cen.snapshot()
+        assert last["available"] is True and last["census"] == "cen_unit"
+        assert last["tags"]["weights"] == {"bytes": 128 * 16 * 4,
+                                           "count": 1}
+        assert last["total_bytes"] >= 128 * 16 * 4
+        assert last["top"][0]["dtype"] in ("float32", "int32")
+        rs = reg.snapshot()
+        assert rs["gauges"][obs.labeled(
+            "device_live_buffer_bytes", tag="weights")] == 128 * 16 * 4
+        assert rs["gauges"][obs.labeled(
+            "device_live_buffer_count", tag="weights")] == 1
+        # arrays no supplier claims stay untagged — never invented
+        assert obs.labeled("device_live_buffer_bytes", tag="total") \
+            in rs["gauges"]
+    finally:
+        cen.close()
+        del w
+
+
+# -- acceptance: oversized workload trips hbm_pressure ----------------------
+
+
+def test_hbm_pressure_acceptance_healthz_flight_and_trace_report(tmp_path):
+    """ISSUE 19 acceptance: a live-buffer workload past its census
+    budget trips the HbmPressureDetector — real /healthz 503 + an
+    anomaly-time flight bundle whose DEVICE section ``trace_report
+    --flight`` can read back — while the budgeted-but-small tag never
+    judges."""
+    import tools.trace_report as trace_report
+
+    fdir = tmp_path / "flight"
+    srv = exporter.OpsServer(port=0)
+    flight.install(str(fdir), catch_signals=False)
+    obs.configure_event_log()
+    hm = _monitor(component="dev_hbm", trip_after=1, recover_after=100)
+    cen = device.LiveBufferCensus(
+        registry=hm.registry, monitor=hm, name="hbm_acc",
+        budgets={"workload": 256.0 * 1024}, sample_every=1)
+    big = jnp.zeros((1024, 256), jnp.float32)  # 1 MiB >> 256 KiB budget
+    cen.register_tag("workload", lambda: big)
+    try:
+        with obs.override(True):
+            cen.sample()
+        v = hm.verdict()
+        det = v["detectors"]["hbm_pressure"]
+        assert det["status"] == health.UNHEALTHY
+        assert det["detail"]["worst_kind"] == "workload"
+        assert det["detail"]["fraction"] >= 4.0
+
+        # /healthz: a real 503 naming the pressured component
+        code, body = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/healthz")
+        assert code == 503
+        assert body["components"]["dev_hbm"]["status"] == health.UNHEALTHY
+
+        # /devicez carries the census section
+        code, dz = _get(
+            f"http://{srv.address[0]}:{srv.address[1]}/devicez")
+        assert code == 200
+        sec = dz["device"]["census:hbm_acc"]
+        assert sec["device"] is True
+        assert sec["tags"]["workload"]["bytes"] == 1024 * 256 * 4
+
+        # the anomaly dump landed; its device section is readable
+        bundles = sorted(fdir.glob("flight-*.jsonl"))
+        assert bundles, "no anomaly-time flight bundle"
+        rep = trace_report.summarize_flight(str(bundles[-1]))
+        assert rep["reason"].startswith("health:dev_hbm:")
+        assert "device:census:hbm_acc" in rep["device"]
+        assert rep["device"]["device:census:hbm_acc"]["device"] is True
+        assert rep["health"]["dev_hbm"]["status"] == health.UNHEALTHY
+    finally:
+        cen.close()
+        hm.close()
+        flight.uninstall()
+        obs.configure_event_log()
+        srv.close()
+
+
+# -- acceptance: donation verification ---------------------------------------
+
+
+def test_donation_acceptance_broken_control_trips_aliased_stays_clean():
+    """ISSUE 19 acceptance: the merge_apply-shaped donated update (w and
+    accumulator donated, same-shape outputs) genuinely aliases — checks
+    pass, no misses — while a control compiled WITHOUT donation but
+    wrapped claiming it registers a miss and trips donation_miss."""
+    hm = _monitor(component="dev_don", trip_after=1, recover_after=100)
+    watch = device.DonationWatch(registry=hm.registry, monitor=hm,
+                                 name="don_acc")
+
+    def upd(w, a, g):
+        return w - 0.1 * g, a + g * g
+
+    ok_fn = device.verify_donation(
+        "merge_apply_ok", jax.jit(upd, donate_argnums=(0, 1)),
+        donate_argnums=(0, 1), watch=watch, sample_every=1)
+    broken = device.verify_donation(
+        "merge_apply_broken", jax.jit(upd),
+        donate_argnums=(0, 1), watch=watch, sample_every=1)
+    g = jnp.ones((128, 8), jnp.float32)
+    try:
+        with obs.override(True):
+            w2, a2 = ok_fn(jnp.ones((128, 8), jnp.float32),
+                           jnp.zeros((128, 8), jnp.float32), g)
+            w3, _ = broken(jnp.ones((128, 8), jnp.float32),
+                           jnp.zeros((128, 8), jnp.float32), g)
+        np.testing.assert_allclose(np.asarray(w2), 0.9)
+        np.testing.assert_allclose(np.asarray(w3), 0.9)  # same answer...
+        snap = watch.snapshot()
+        assert snap["device"] is True and snap["donation"] is True
+        assert snap["programs"]["merge_apply_ok"] == {"checks": 1,
+                                                      "misses": 0}
+        assert snap["programs"]["merge_apply_broken"] == {"checks": 1,
+                                                          "misses": 1}
+        v = hm.verdict()
+        det = v["detectors"]["donation_miss"]
+        assert det["status"] == health.DEGRADED  # ...but copied buffers
+        assert det["detail"]["worst_program"] == "merge_apply_broken"
+        counters = hm.registry.snapshot()["counters"]
+        assert counters[obs.labeled(
+            "device_donation_miss_total",
+            program="merge_apply_broken")] == 1
+        assert obs.labeled("device_donation_miss_total",
+                           program="merge_apply_ok") not in counters
+    finally:
+        watch.close()
+        hm.close()
+
+
+def test_verify_donation_is_identity_when_dark(monkeypatch):
+    monkeypatch.delenv("LIGHTCTR_DEVICE", raising=False)
+    f = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+    assert device.verify_donation("p", f, donate_argnums=(0,)) is f
+    # and with nothing donated there is nothing to verify
+    w = device.DonationWatch(register=False)
+    assert device.verify_donation("p", f, donate_argnums=(), watch=w) is f
+
+
+# -- profiler trigger --------------------------------------------------------
+
+
+def test_post_profilez_captures_next_steps_and_rate_limits(tmp_path, rng):
+    """ISSUE 19 acceptance: POST /profilez on a running trainer produces
+    a non-empty capture dir covering the next N whole steps; a POST
+    while armed is a 409 (busy) and a POST inside the rate window after
+    the capture lands is a 429 (rate_limited)."""
+    device.reset_profile_trigger()
+    trig = device.profile_trigger(base_dir=str(tmp_path / "prof"),
+                                  min_interval_s=3600.0)
+    srv = exporter.OpsServer(port=0)
+    d, n = 32, 64
+    tr = _toy_trainer(d)
+    batch = {"x": rng.normal(size=(n, d)).astype(np.float32),
+             "labels": (rng.random(n) > 0.5).astype(np.float32)}
+    url = f"http://{srv.address[0]}:{srv.address[1]}/profilez"
+    try:
+        with obs.override(True):
+            tr.train_step(batch)  # compile outside the capture
+            code, body = _post(url + "?steps=2")
+            assert code == 200 and body["armed"]["steps"] == 2
+            code, body = _post(url)  # already armed
+            assert code == 409 and body["refused"] == "busy"
+            assert trig.engaged()
+            for _ in range(3):  # start boundary + 2 covered steps
+                tr.train_step(batch)
+        p = trig.payload()
+        assert p["active"] is None and not trig.engaged()
+        assert len(p["captures"]) == 1
+        cap = p["captures"][0]
+        assert cap["files"] > 0 and os.path.isdir(cap["dir"])
+        assert cap["reason"] == "ops:profilez"
+        # inside the rate window: a clean typed refusal, never a capture
+        code, body = _post(url)
+        assert code == 429 and body["refused"] == "rate_limited"
+        assert body["retry_after_s"] > 0
+        counters = obs.default_registry().snapshot()["counters"]
+        assert counters["device_profile_captures_total"] >= 1
+        assert counters[obs.labeled("device_profile_refused_total",
+                                    reason="rate_limited")] >= 1
+    finally:
+        srv.close()
+        device.reset_profile_trigger()
+
+
+def test_profilez_refuses_cleanly_without_profiler(monkeypatch, tmp_path):
+    reg = obs.MetricsRegistry()
+    trig = device.ProfileTrigger(base_dir=str(tmp_path), registry=reg,
+                                 min_interval_s=0.0, register=False)
+    monkeypatch.setattr(device.ProfileTrigger, "available",
+                        lambda self: (False, "no profiler here"))
+    with obs.override(True):
+        code, body = trig.handle_post({})
+    assert code == 409 and body["refused"] == "unavailable"
+    assert "no profiler here" in body["detail"]
+    assert reg.snapshot()["counters"][obs.labeled(
+        "device_profile_refused_total", reason="unavailable")] == 1
+    trig.close()
+
+
+def test_anomaly_listener_fires_and_auto_capture_arms(tmp_path):
+    """The health anomaly-listener registry fires on transitions, and
+    install_auto_capture one-shot-arms the profiler on a bad
+    hbm_pressure transition (the stall/memory_pressure coupling rides
+    the same hook)."""
+    seen = []
+
+    def listener(component, detector, prev, new, detail):
+        seen.append((component, detector, prev, new))
+
+    device.reset_profile_trigger()
+    trig = device.profile_trigger(base_dir=str(tmp_path / "auto"),
+                                  min_interval_s=0.0)
+    health.register_anomaly_listener(listener)
+    device.install_auto_capture()
+    hm = _monitor(component="auto_cap", trip_after=1, recover_after=1)
+    device.ensure_device_detectors(hm)
+    try:
+        with obs.override(True):
+            hm.observe(hbm_pressure={"bytes": {"t": 99, "total": 99},
+                                     "budgets": {"t": 100}})
+        assert ("auto_cap", "hbm_pressure", health.OK, health.UNHEALTHY) \
+            in seen
+        assert trig.engaged()
+        assert trig.payload()["armed_steps"] == trig.default_steps
+    finally:
+        device.uninstall_auto_capture()
+        health.unregister_anomaly_listener(listener)
+        hm.close()
+        device.reset_profile_trigger()
+
+
+# -- trainer integration -----------------------------------------------------
+
+
+def test_trainer_arms_device_plane_by_ctor_and_env(monkeypatch, rng):
+    d, n = 32, 64
+    batch = {"x": rng.normal(size=(n, d)).astype(np.float32),
+             "labels": (rng.random(n) > 0.5).astype(np.float32)}
+    tr = _toy_trainer(d, device=True)
+    assert tr.device is not None and tr.device_census is not None
+    try:
+        with obs.override(True):
+            for _ in range(3):
+                tr.train_step(batch)
+        snap = tr.device.snapshot()
+        assert snap["programs"]["trainer_step"]["steps"] == 3
+        # the explicit read compiles + analyzes the real trainer step
+        ana = tr.device.payload()["programs"]["trainer_step"]["analysis"]
+        assert ana["available"] and ana["flops"] > 0
+        assert ana["memory"]["argument"] > 0
+    finally:
+        tr.device.close()
+        tr.device_census.close()
+    # default dark; env arms it
+    tr2 = _toy_trainer(d)
+    assert tr2.device is None and tr2.device_census is None
+    monkeypatch.setenv("LIGHTCTR_DEVICE", "1")
+    tr3 = _toy_trainer(d)
+    assert tr3.device is not None
+    tr3.device.close()
+    tr3.device_census.close()
+
+
+def test_trainer_overhead_under_5_percent_with_device_plane_armed(rng):
+    """ISSUE 19 re-run of the tier-1 overhead guard: the program catalog
+    (offer fast path + note_step EWMA), the census maybe_sample cadence,
+    and the profile_step flag read must stay inside the SAME <5% budget
+    — with feed-ran assertions, so the guard cannot pass by silently
+    skipping the plane (the ISSUE 17/18 contract, one plane further
+    out).  The analysis compile must NOT ride the timed path: nothing
+    here calls analyze()/payload()."""
+    d, n = 2560, 1024
+    batch = {
+        "x": rng.normal(size=(n, d)).astype(np.float32),
+        "labels": (rng.random(n) > 0.5).astype(np.float32),
+    }
+    tr_off = _toy_trainer(d)
+    tr_on = _toy_trainer(d, device=True)
+    obs.configure_event_log()  # fresh in-memory ring (no disk writes)
+    try:
+        with trace_mod.override_rate(0.0), obs.override(True):
+            for _ in range(5):  # compile + warm both programs
+                tr_off.train_step(batch)
+                tr_on.train_step(batch)
+
+            def run(tr, steps=30):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    tr.train_step(batch)
+                return time.perf_counter() - t0
+
+            # interleave the repeats so machine drift (turbo, page cache)
+            # hits both arms, not just the second one measured
+            offs, ons = [], []
+            for _ in range(4):
+                offs.append(run(tr_off))
+                ons.append(run(tr_on))
+            t_off, t_on = min(offs), min(ons)
+        # the plane genuinely ran on the timed path: every step offered +
+        # timed, the census sampled on cadence, the detectors installed
+        rec = tr_on.device.snapshot()["programs"]["trainer_step"]
+        assert rec["steps"] == 5 + 4 * 30
+        assert rec["ewma_seconds"] is not None
+        assert rec["analyzed"] is False  # lazy: no compile on this path
+        assert tr_on.device_census.snapshot().get("available") is True
+        v = tr_on.health.verdict()
+        assert {"hbm_pressure", "donation_miss"} <= set(v["detectors"])
+    finally:
+        tr_on.device.close()
+        tr_on.device_census.close()
+        obs.configure_event_log()
+    assert t_on <= t_off * 1.05 + 0.005, (t_on, t_off)
+
+
+# -- cluster rollup ----------------------------------------------------------
+
+
+def test_device_rollup_verdicts():
+    members = {
+        "a": {"snapshot": {"gauges": {
+            obs.labeled("device_program_utilization", program="step"): 0.4,
+            obs.labeled("device_live_buffer_bytes", tag="embed"): 1000,
+            obs.labeled("device_live_buffer_bytes", tag="total"): 1500},
+            "counters": {}}},
+        "b": {"snapshot": {"gauges": {
+            obs.labeled("device_program_utilization", program="step"): 0.1},
+            "counters": {obs.labeled("device_donation_miss_total",
+                                     program="merge"): 3}}},
+        "quiet": {"snapshot": {"gauges": {"trainer_loss": 0.5},
+                               "counters": {}}},
+    }
+    out = device.device_rollup(members)
+    assert out["lowest_utilization"] == {
+        "member": "b", "program": "step", "utilization": 0.1}
+    assert out["donation_misses"] == {
+        "member": "b", "program": "merge", "misses": 3}
+    # the total tag is a sum, not a place to look
+    assert out["biggest_live"] == {
+        "member": "a", "tag": "embed", "bytes": 1000}
+    assert "quiet" not in out["members"]  # no device series there
+
+
+# -- report tooling ----------------------------------------------------------
+
+
+def _golden_registry(rng):
+    """One registry carrying the whole plane: the REAL trainer step and
+    a REAL serve scorer analyzed, census, donation, profile counters."""
+    reg = obs.MetricsRegistry()
+    cat = device.ProgramCatalog(component="rep_dev", registry=reg,
+                                poll_every=0)
+    d, n = 16, 8
+    tr = _toy_trainer(d)
+    batch = {"x": rng.normal(size=(n, d)).astype(np.float32),
+             "labels": (rng.random(n) > 0.5).astype(np.float32)}
+    sm = ServingModel("fm", fm.init(jax.random.PRNGKey(3), F, K))
+    sb = {"fids": rng.integers(1, F, size=(8, 4)).astype(np.int32),
+          "vals": np.ones((8, 4), np.float32),
+          "mask": np.ones((8, 4), np.float32)}
+    with obs.override(True):
+        cat.offer("trainer_step", tr._step,
+                  (tr.params, tr.opt_state, batch))
+        cat.note_step(0.002, "trainer_step")
+        cat.offer("serve_score_local_fm", sm._jit_local, (sm.params, sb))
+        cat.note_step(0.001, "serve_score_local_fm")
+        cat.analyze()
+        cen = device.LiveBufferCensus(registry=reg, name="rep_cen",
+                                      budgets={"weights": 1e9},
+                                      register=False)
+        cen.register_tag("weights", lambda: tr.params)
+        cen.sample()
+        watch = device.DonationWatch(registry=reg, name="rep_don",
+                                     register=False)
+        watch.note("merge_apply", aliased=True, donated=2)
+        watch.note("merge_apply", aliased=False, donated=2)
+        trig = device.ProfileTrigger(base_dir="/tmp/rep_prof",
+                                     registry=reg, min_interval_s=3600.0,
+                                     register=False)
+        trig.arm()
+        trig.arm()  # second arm while armed: a typed busy refusal
+    payload = {"rep_dev": cat.payload(), "census:rep_cen": cen.payload(),
+               "rep_don": watch.payload(), "profile": trig.payload()}
+    cat.close()
+    cen.close()
+    watch.close()
+    trig.close()
+    return reg, payload
+
+
+def test_metrics_report_device_golden(tmp_path, capsys, rng):
+    """ISSUE 19 acceptance: ``metrics_report --device`` includes FLOPs /
+    bytes / intensity / memory breakdown for the trainer step AND a
+    serve scorer, plus the census, donation, and profile tables."""
+    import tools.metrics_report as metrics_report
+
+    reg, _ = _golden_registry(rng)
+    snap = reg.snapshot()
+    rep = metrics_report.summarize_device(snap)
+    for prog in ("trainer_step", "serve_score_local_fm"):
+        p = rep["programs"][prog]
+        assert p["flops"] > 0 and p["bytes_accessed"] > 0
+        assert p["intensity"] > 0
+        assert p["memory"]["argument"] > 0
+        assert "peak_estimate" in p["memory"]
+        assert p["time"]["count"] == 1
+    assert rep["live"]["weights"]["bytes"] == 16 * 4
+    assert rep["live"]["weights"]["budget_bytes"] == 10 ** 9
+    assert 0 <= rep["live"]["weights"]["fraction"] < 1
+    assert rep["donation"]["merge_apply"] == {"checks": 2, "misses": 1}
+    assert rep["profile"]["refused"]["busy"] == 1
+    # the CLI path accepts the MSG_STATS/varz "telemetry" wrapper
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps({"telemetry": snap}))
+    assert metrics_report.main(["--device", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '"trainer_step"' in out and '"serve_score_local_fm"' in out
+
+
+def test_device_report_tool_renders_roofline_table(tmp_path, capsys, rng):
+    import tools.device_report as device_report
+
+    _, payload = _golden_registry(rng)
+    path = tmp_path / "devicez.json"
+    path.write_text(json.dumps({"device": payload}))
+    assert device_report.main([str(path)]) == 0
+    cap = capsys.readouterr()
+    # stderr carries the human table; stdout stays a JSON artifact
+    assert "trainer_step" in cap.err and "serve_score_local_fm" in cap.err
+    assert "live buffers" in cap.err and "donation checks" in cap.err
+    json.loads(cap.out)
+    assert device_report.main([str(path), "--json"]) == 0
+    cap = capsys.readouterr()
+    assert cap.err == ""
+    rep = json.loads(cap.out)
+    cat = rep["catalogs"][0]
+    assert cat["component"] == "rep_dev"
+    progs = {r["program"]: r for r in cat["programs"]}
+    assert progs["trainer_step"]["flops"] > 0
+    assert progs["trainer_step"]["utilization"] is None  # honest on CPU
+
+
+def test_bench_history_folds_device_programs(tmp_path, rng):
+    import tools.bench_history as bench_history
+
+    _, payload = _golden_registry(rng)
+    hist = str(tmp_path / "HIST.jsonl")
+    art = tmp_path / "devicez.json"
+    art.write_text(json.dumps({"device": payload}))
+    rows = bench_history.fold_artifact(str(art), hist, run="d1")
+    keys = {(r["cell"], r["metric"]) for r in rows}
+    assert all(r["bench"] == "device" for r in rows)
+    assert ("rep_dev.trainer_step", "flops") in keys
+    assert ("rep_dev.trainer_step", "memory_peak_estimate_bytes") in keys
+    assert ("rep_dev.serve_score_local_fm", "intensity") in keys
+    # roofline metrics gate in the right direction
+    assert bench_history.metric_direction("utilization") == 1
+    assert bench_history.metric_direction("intensity") == 1
+    assert bench_history.metric_direction("memory_peak_estimate_bytes") == -1
+    bench_history.fold_artifact(str(art), hist, run="d2")
+    rep = bench_history.gate_history(hist)
+    assert rep["ok"], rep["failures"]

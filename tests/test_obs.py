@@ -604,8 +604,9 @@ def test_every_ps_wire_op_has_a_latency_series_name():
 
 def test_every_health_detector_is_registered_and_series_declared():
     """No silent dark detectors: every ``*Detector`` class in obs/health.py
-    AND obs/quality.py AND obs/resources.py (the quality and resource
-    planes register their detectors into the same ``KNOWN_DETECTORS`` at
+    AND obs/quality.py AND obs/resources.py AND obs/device.py (the
+    quality, resource, and device planes register their detectors into
+    the same ``KNOWN_DETECTORS`` at
     import) must declare literal ``name``/``signals`` class attributes
     and be listed in ``KNOWN_DETECTORS``; and every gauge/counter series
     obs/health.py writes (the first argument of each ``labeled(...)``
@@ -613,12 +614,14 @@ def test_every_health_detector_is_registered_and_series_declared():
     not declared there would never make it into dashboards or docs.
     (quality.py's series get the same treatment against
     ``QUALITY_SERIES`` in tests/test_quality.py, resources.py's against
-    ``RESOURCE_SERIES`` in tests/test_resources.py.)"""
-    from lightctr_tpu.obs import health, quality, resources
+    ``RESOURCE_SERIES`` in tests/test_resources.py, device.py's against
+    ``DEVICE_SERIES`` in tests/test_device.py.)"""
+    from lightctr_tpu.obs import device, health, quality, resources
 
     detectors = {}  # class name -> (module, detector name)
     for module, fname in ((health, "health.py"), (quality, "quality.py"),
-                          (resources, "resources.py")):
+                          (resources, "resources.py"),
+                          (device, "device.py")):
         src = (LIB_ROOT / "obs" / fname).read_text()
         tree = ast.parse(src, filename=f"obs/{fname}")
 

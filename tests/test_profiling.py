@@ -133,3 +133,42 @@ def test_trace_degrades_to_noop_without_jax_profiler(tmp_path, monkeypatch,
         assert recs and recs[0]["unavailable"] is True
     finally:
         obs.configure_event_log()
+
+
+def test_trace_degrades_when_start_trace_refuses(tmp_path, monkeypatch,
+                                                 caplog):
+    """Satellite (device plane): an IMPORTABLE profiler whose backend
+    refuses to start (double-start, unsupported platform) degrades the
+    same way as an absent one — logged no-op, degradation recorded on
+    the trace_capture event, no exception into the caller's step."""
+    import logging
+
+    def refuse(*a, **k):
+        raise RuntimeError("already profiling")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", refuse)
+    obs.configure_event_log()
+    try:
+        with obs.override(True), caplog.at_level(
+                logging.WARNING, logger="lightctr_tpu.utils.profiling"):
+            with profiling.trace(str(tmp_path / "p")):
+                ran = True
+        assert ran
+        assert any("no-op" in r.message for r in caplog.records)
+        recs = [r for r in obs.get_event_log().records()
+                if r["kind"] == "trace_capture"]
+        degraded = [r for r in recs if r.get("unavailable")]
+        assert degraded and "already profiling" in degraded[0]["error"]
+    finally:
+        obs.configure_event_log()
+
+
+def test_profiler_available_contract(monkeypatch):
+    """profiler_available() is what POST /profilez checks before arming:
+    (True, 'ok') with a working jax.profiler, (False, why) without —
+    the refusal path must name its reason, never raise."""
+    ok, why = profiling.profiler_available()
+    assert ok is True and why == "ok"
+    monkeypatch.setattr(jax.profiler, "start_trace", None)
+    ok, why = profiling.profiler_available()
+    assert ok is False and "start_trace" in why

@@ -11,9 +11,12 @@ human diffs the files.  This tool gives the artifacts a time axis:
     keyed rows appended to ``BENCH_HISTORY.jsonl`` — one JSONL line per
     metric per run, so the history is merge-friendly and grep-able.
     ``BENCH_r<NN>.json`` driver artifacts (the ``parsed`` single-metric
-    shape) fold as ``bench=trainer, cell=single_process``; everything
-    else folds generically with the artifact stem as the bench name and
-    the dotted leaf path as the cell.
+    shape) fold as ``bench=trainer, cell=single_process``; ``/devicez``
+    dumps / ProgramCatalog snapshots fold per compiled program as
+    ``bench=device, cell=<component>.<program>`` (flops, intensity,
+    utilization, memory_*_bytes); everything else folds generically with
+    the artifact stem as the bench name and the dotted leaf path as the
+    cell.
 
 ``gate``
     group the history by key and compare each key's LATEST value against
@@ -48,7 +51,8 @@ DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
 # metric-name keywords -> direction (checked in order; higher-better
 # first so "examples_per_sec" never matches a latency keyword).
 _HIGHER = ("per_sec", "per_s", "_qps", "qps", "throughput", "examples",
-           "rows_per", "ratio", "auc", "hit_rate", "hit", "reduction")
+           "rows_per", "ratio", "auc", "hit_rate", "hit", "reduction",
+           "utilization", "intensity")
 _LOWER = ("seconds", "_ms", "_us", "p50", "p99", "p999", "latency",
           "bytes", "loss", "stale", "shed", "drop", "fail", "err",
           "compile")
@@ -83,6 +87,52 @@ def _walk_leaves(node, path: Tuple[str, ...] = ()):
             yield from _walk_leaves(v, path + (str(i),))
 
 
+def _device_catalogs(node):
+    """Yield ProgramCatalog snapshots found anywhere in an artifact — a
+    bare ``snapshot()``/``payload()``, a ``/devicez`` dump
+    (``{"device": {provider: snapshot}}``), or a flight bundle's device
+    section.  Catalog snapshots are the ones that self-mark with
+    ``device: True`` AND carry a ``backend`` (census/donation/profile
+    sections self-mark too but have no roofline rows to fold)."""
+    if not isinstance(node, dict):
+        return
+    if node.get("device") is True and "backend" in node \
+            and isinstance(node.get("programs"), dict):
+        yield node
+        return
+    for v in node.values():
+        yield from _device_catalogs(v)
+
+
+def _device_entries(data, run_id: str, source: str) -> List[Dict]:
+    """Per-program device rows: bench=device, cell=<component>.<program>,
+    metrics = flops / bytes_accessed / intensity / utilization /
+    ewma_seconds / memory_<kind>_bytes — stable keys, so the gate tracks
+    each compiled program's roofline and footprint across runs."""
+    out: List[Dict] = []
+    for cat in _device_catalogs(data):
+        comp = cat.get("component", "device")
+        for prog, rec in sorted((cat.get("programs") or {}).items()):
+            if not isinstance(rec, dict):
+                continue
+            ana = rec.get("analysis") or {}
+            row = {"flops": ana.get("flops"),
+                   "bytes_accessed": ana.get("bytes_accessed"),
+                   "intensity": ana.get("intensity"),
+                   "utilization": rec.get("utilization"),
+                   "ewma_seconds": rec.get("ewma_seconds")}
+            for kind, v in sorted((ana.get("memory") or {}).items()):
+                row[f"memory_{kind}_bytes"] = v
+            for metric, v in row.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out.append({
+                        "run": run_id, "bench": "device",
+                        "cell": f"{comp}.{prog}", "metric": metric,
+                        "value": float(v), "source": source,
+                    })
+    return out
+
+
 def _entries_for(path: str, run: Optional[str]) -> List[Dict]:
     """One artifact file -> history rows (no I/O on the history)."""
     with open(path) as f:
@@ -97,6 +147,11 @@ def _entries_for(path: str, run: Optional[str]) -> List[Dict]:
             "metric": str(parsed["metric"]), "value": float(parsed["value"]),
             "unit": parsed.get("unit"), "source": os.path.basename(path),
         }]
+    # /devicez dumps and catalog snapshots fold with stable per-program
+    # keys instead of the generic dotted-path walk
+    device = _device_entries(data, run_id, os.path.basename(path))
+    if device:
+        return device
     out = []
     for leaf_path, value in _walk_leaves(data):
         if not leaf_path:

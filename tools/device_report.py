@@ -1,0 +1,200 @@
+"""Roofline table for the device plane: /devicez dump -> per-program
+FLOPs, bytes, intensity, achieved vs peak.
+
+The device observability plane (docs/OBSERVABILITY.md "Device plane")
+publishes per-compiled-program HLO cost/memory analytics and a
+live-buffer census; this tool renders a saved ``/devicez`` payload (or a
+bare :meth:`~lightctr_tpu.obs.device.ProgramCatalog.snapshot`/
+``payload()`` JSON, or a flight bundle's device section) as the table an
+optimization pass reads first:
+
+  python -m tools.device_report devicez.json
+      # -> stdout: the structured report JSON (for diffing / folding);
+      #    stderr: one roofline row per program: FLOPs, bytes accessed,
+      #    arithmetic intensity (FLOP/byte), EWMA step time, achieved
+      #    GFLOP/s, utilization vs the backend peak (blank on CPU —
+      #    unavailable is printed as "-", never faked), peak-memory
+      #    estimate; then the census table (tag / bytes / buffers /
+      #    budget) and donation check/miss counters when present
+  python -m tools.device_report devicez.json --json
+      # -> the JSON artifact alone (table suppressed)
+
+Utilization needs a peak spec: on CPU (or an unknown TPU generation) the
+catalog reports ``peak: null`` and every utilization cell here is "-".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _sections(node, out: Optional[List[Dict]] = None) -> List[Dict]:
+    """Collect every self-marked device-plane section (``device: True``)
+    anywhere in the document: catalog snapshots, census snapshots,
+    donation watches, the profiler trigger."""
+    if out is None:
+        out = []
+    if isinstance(node, dict):
+        if node.get("device") is True:
+            out.append(node)
+            return out
+        for v in node.values():
+            _sections(v, out)
+    return out
+
+
+def _num(v, nd: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v and abs(v) < 10 ** -nd:
+            return f"{v:.2e}"
+        return f"{round(v, nd):g}"
+    return str(v)
+
+
+def _bytes(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024.0
+    return f"{v:.1f}GiB"
+
+
+def report_from(doc) -> Dict:
+    """Structured device report: programs (roofline rows), census
+    tables, donation counters, profiler state — everything found."""
+    report: Dict = {"catalogs": [], "census": [], "donation": [],
+                    "profile": []}
+    for sec in _sections(doc):
+        if "backend" in sec and isinstance(sec.get("programs"), dict):
+            rows = []
+            for name, rec in sorted(sec["programs"].items()):
+                if not isinstance(rec, dict):
+                    continue
+                ana = rec.get("analysis") or {}
+                mem = ana.get("memory") or {}
+                rows.append({
+                    "program": name,
+                    "flops": ana.get("flops"),
+                    "bytes_accessed": ana.get("bytes_accessed"),
+                    "intensity": ana.get("intensity"),
+                    "ewma_seconds": rec.get("ewma_seconds"),
+                    "steps": rec.get("steps"),
+                    "achieved_flops_per_s": rec.get("achieved_flops_per_s"),
+                    "utilization": rec.get("utilization"),
+                    "peak_memory_bytes": mem.get("peak_estimate"),
+                    "error": rec.get("error"),
+                })
+            report["catalogs"].append({
+                "component": sec.get("component"),
+                "backend": sec.get("backend"),
+                "device_kind": sec.get("device_kind"),
+                "peak": sec.get("peak"),
+                "programs": rows,
+            })
+        elif "census" in sec:
+            report["census"].append(sec)
+        elif sec.get("donation"):
+            report["donation"].append(sec)
+        elif "captures" in sec or "armed_steps" in sec:
+            report["profile"].append(sec)
+    return report
+
+
+def _render(report: Dict) -> str:
+    lines: List[str] = []
+    for cat in report["catalogs"]:
+        peak = cat.get("peak") or {}
+        lines.append(
+            f"== {cat.get('component', '?')} @ {cat.get('backend', '?')} "
+            f"({cat.get('device_kind', '?')})  "
+            f"peak={_num(peak.get('flops_per_s'))} FLOP/s"
+        )
+        hdr = (f"{'program':<28} {'flops':>12} {'bytes':>10} "
+               f"{'intens':>8} {'ewma_s':>10} {'GFLOP/s':>10} "
+               f"{'util':>7} {'peak_mem':>10}")
+        lines.append(hdr)
+        lines.append("-" * len(hdr))
+        for r in cat["programs"]:
+            if r.get("error"):
+                lines.append(f"{r['program']:<28} ({r['error']})")
+                continue
+            ach = r.get("achieved_flops_per_s")
+            util = r.get("utilization")
+            lines.append(
+                f"{r['program']:<28} {_num(r.get('flops'), 0):>12} "
+                f"{_bytes(r.get('bytes_accessed')):>10} "
+                f"{_num(r.get('intensity'), 2):>8} "
+                f"{_num(r.get('ewma_seconds'), 6):>10} "
+                f"{_num(None if ach is None else ach / 1e9, 2):>10} "
+                f"{('-' if util is None else f'{util:.1%}'):>7} "
+                f"{_bytes(r.get('peak_memory_bytes')):>10}"
+            )
+        lines.append("")
+    for cen in report["census"]:
+        lines.append(f"== live buffers ({cen.get('census', '?')})")
+        tags = cen.get("tags") or {}
+        budgets = cen.get("budgets") or {}
+        for tag in sorted(tags):
+            e = tags[tag] if isinstance(tags[tag], dict) else {}
+            b = budgets.get(tag)
+            lines.append(
+                f"  {tag:<24} {_bytes(e.get('bytes')):>10} "
+                f"{e.get('count', '-'):>6} bufs"
+                + (f"  budget {_bytes(b)}" if b else "")
+            )
+        lines.append("")
+    for don in report["donation"]:
+        lines.append("== donation checks")
+        for prog, e in sorted((don.get("programs") or {}).items()):
+            lines.append(f"  {prog:<28} checks={e.get('checks', 0)} "
+                         f"misses={e.get('misses', 0)}")
+        lines.append("")
+    for prof in report["profile"]:
+        lines.append(
+            f"== profiler  dir={prof.get('dir')} "
+            f"active={prof.get('active')} captures={prof.get('captures')}")
+        lines.append("")
+    if not any(report[k] for k in ("catalogs", "census", "donation",
+                                   "profile")):
+        lines.append("no device-plane sections found "
+                     "(is the plane armed? LIGHTCTR_DEVICE=1)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="/devicez dump, catalog snapshot/payload "
+                                 "JSON, or flight bundle JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="suppress the stderr table (JSON artifact only)")
+    ap.add_argument("--out", help="write the report JSON here too")
+    args = ap.parse_args(argv)
+
+    with open(args.path) as f:
+        doc = json.load(f)
+    report = report_from(doc)
+    # stdout is the machine-readable artifact (repo tools contract);
+    # the human table is progress chatter and rides stderr
+    if not args.json:
+        print(_render(report), file=sys.stderr)
+    print(json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,12 @@ turns either into something readable:
       #    saturation plane"): per-fn jit compile counts + live cache
       #    ladders, per-queue depth/capacity/fill with queued-wait
       #    percentiles, memory bytes vs budgets, fullest-queue pointer
+  python -m tools.metrics_report --device SNAPSHOT_JSON
+      # -> device/compiled-program report (docs/OBSERVABILITY.md "Device
+      #    plane"): per-program FLOPs, bytes accessed, arithmetic
+      #    intensity, roofline utilization + memory breakdown, step-time
+      #    percentiles, live-buffer census vs budgets, donation
+      #    check/miss counters, profiler capture/refusal totals
 """
 
 from __future__ import annotations
@@ -684,6 +690,93 @@ def summarize_resources(doc) -> dict:
     return report
 
 
+def summarize_device(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> device/compiled-program report
+    (docs/OBSERVABILITY.md "Device plane"): per-program FLOPs / bytes
+    accessed / arithmetic intensity / roofline utilization with the
+    compiled memory breakdown and step-time percentiles, the live-buffer
+    census table vs budgets, donation check/miss counters, and profiler
+    capture/refusal totals.  Every series here is declared in
+    ``lightctr_tpu.obs.device.DEVICE_SERIES`` (lint-enforced)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+
+    def _labels(name, prefix):
+        return dict(
+            part.split("=", 1)
+            for part in name[len(prefix) + 1:-1].replace('"', "").split(",")
+        )
+
+    report: dict = {}
+    programs: dict = {}
+    for prefix, key in (("device_program_flops", "flops"),
+                        ("device_program_bytes_accessed", "bytes_accessed"),
+                        ("device_program_intensity", "intensity"),
+                        ("device_program_utilization", "utilization")):
+        for name, val in gauges.items():
+            if name.startswith(prefix + "{"):
+                prog = _labels(name, prefix).get("program", "?")
+                programs.setdefault(prog, {})[key] = round(float(val), 6)
+    prefix = "device_program_memory_bytes"
+    for name, val in gauges.items():
+        if name.startswith(prefix + "{"):
+            labels = _labels(name, prefix)
+            programs.setdefault(labels.get("program", "?"), {}).setdefault(
+                "memory", {})[labels.get("kind", "?")] = int(val)
+    prefix = "device_program_time_seconds"
+    for name, hist in hists.items():
+        if name.startswith(prefix + "{"):
+            prog = _labels(name, prefix).get("program", "?")
+            programs.setdefault(prog, {})["time"] = _hist_summary(hist)
+    if programs:
+        report["programs"] = {k: programs[k] for k in sorted(programs)}
+        worst = None
+        for prog, entry in programs.items():
+            util = entry.get("utilization")
+            if util is not None and (worst is None
+                                     or util < worst["utilization"]):
+                worst = {"program": prog, "utilization": util}
+        if worst is not None:
+            report["lowest_utilization"] = worst
+    live: dict = {}
+    for prefix, key in (("device_live_buffer_bytes", "bytes"),
+                        ("device_live_buffer_count", "buffers"),
+                        ("device_live_budget_bytes", "budget_bytes")):
+        for name, val in gauges.items():
+            if name.startswith(prefix + "{"):
+                tag = _labels(name, prefix).get("tag", "?")
+                live.setdefault(tag, {})[key] = int(val)
+    for tag, entry in live.items():
+        if entry.get("budget_bytes"):
+            entry["fraction"] = round(
+                entry.get("bytes", 0) / entry["budget_bytes"], 4)
+    if live:
+        report["live"] = {k: live[k] for k in sorted(live)}
+    donation: dict = {}
+    for prefix, key in (("device_donation_checks_total", "checks"),
+                        ("device_donation_miss_total", "misses")):
+        for name, val in counters.items():
+            if name.startswith(prefix + "{"):
+                prog = _labels(name, prefix).get("program", "?")
+                donation.setdefault(prog, {})[key] = int(val)
+    if donation:
+        report["donation"] = {k: donation[k] for k in sorted(donation)}
+    profile: dict = {}
+    if "device_profile_captures_total" in counters:
+        profile["captures"] = int(counters["device_profile_captures_total"])
+    prefix = "device_profile_refused_total"
+    for name, val in counters.items():
+        if name.startswith(prefix + "{"):
+            profile.setdefault("refused", {})[
+                _labels(name, prefix).get("reason", "?")] = int(val)
+    if profile:
+        report["profile"] = profile
+    return report
+
+
 def summarize_cluster(doc) -> dict:
     """Cluster rollup dump -> straggler/rollup report.  Accepts the
     :meth:`~lightctr_tpu.obs.cluster.ClusterRollup.members` dict, a bare
@@ -770,6 +863,13 @@ def main(argv=None):
                          "compiles + cache ladders, queue depth/fill with "
                          "wait percentiles, memory bytes vs budgets) from "
                          "a registry snapshot or stats() dump")
+    ap.add_argument("--device", metavar="SNAPSHOT_JSON",
+                    help="summarize the device/compiled-program plane "
+                         "(per-program FLOPs/bytes/intensity/roofline "
+                         "utilization + memory breakdown, live-buffer "
+                         "census vs budgets, donation misses, profiler "
+                         "captures) from a registry snapshot or stats() "
+                         "dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -859,12 +959,22 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.device:
+        with open(args.device) as f:
+            doc = json.load(f)
+        report = summarize_device(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
                  "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, "
                  "--cluster MEMBERS_JSON, --quality SNAPSHOT_JSON, "
-                 "--resources SNAPSHOT_JSON, or --online SNAPSHOT_JSON")
+                 "--resources SNAPSHOT_JSON, --device SNAPSHOT_JSON, "
+                 "or --online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))

@@ -314,6 +314,18 @@ def summarize_flight(path: str) -> Dict:
     }
     if resources:
         report["resources"] = resources
+    # the device plane too: registries named device:<component> (program
+    # catalogs, live-buffer censuses, donation watches, the profiler
+    # trigger) whose snapshots self-mark with "device": True — an
+    # hbm-pressure or donation postmortem reads the census/roofline state
+    # AT the dump
+    device = {
+        m.get("registry", "?"): m.get("snapshot", {})
+        for m in metrics
+        if m.get("snapshot", {}).get("device")
+    }
+    if device:
+        report["device"] = device
     # surface the headline counters — the numbers a postmortem reads first
     for m in metrics:
         c = m.get("snapshot", {}).get("counters", {})
