@@ -1,6 +1,18 @@
 from lightctr_tpu.data.sparse import SparseDataset, load_libffm
 from lightctr_tpu.data.dense import DenseDataset, load_dense_csv
 from lightctr_tpu.data.batching import minibatches, shard_for_hosts
+from lightctr_tpu.data.ingest import (
+    INGEST_SERIES,
+    FeatureSpec,
+    ShardCache,
+    ShardCorruption,
+    as_arrays,
+    compile_shards,
+    iter_ingest_batches,
+    iter_shard_batches,
+    load_cache,
+    prefetch_batches,
+)
 
 __all__ = [
     "SparseDataset",
@@ -9,4 +21,14 @@ __all__ = [
     "load_dense_csv",
     "minibatches",
     "shard_for_hosts",
+    "INGEST_SERIES",
+    "FeatureSpec",
+    "ShardCache",
+    "ShardCorruption",
+    "as_arrays",
+    "compile_shards",
+    "iter_ingest_batches",
+    "iter_shard_batches",
+    "load_cache",
+    "prefetch_batches",
 ]

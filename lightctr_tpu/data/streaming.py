@@ -103,7 +103,11 @@ def iter_libffm_batches(
       terminating.  A trailing PARTIAL line (no newline yet — a writer
       mid-append) is never parsed; it waits for its newline.  Batches
       are emitted only when full (a follow stream has no meaningful
-      tail).  Python row parsing only, no sharding.
+      tail).  No sharding.  ``native=None`` auto-selects the C chunk
+      parser here too: the tailer hands it the last known newline
+      boundary as an explicit byte bound, so the partial-line contract
+      holds natively (the Python row parser remains the fallback and
+      oracle).
     - ``stop``: escape hatch for both (Event or callable) — checked
       between batches, so an infinite stream shuts down cleanly."""
     from lightctr_tpu.native import bindings
@@ -119,7 +123,7 @@ def iter_libffm_batches(
                              "(tail one file per follower)")
         yield from _iter_follow(
             path, batch_size, max_nnz, feature_cnt, field_cnt,
-            shuffle_batches, seed, stop, poll_s,
+            shuffle_batches, seed, stop, poll_s, native,
         )
         return
     if loop:
@@ -223,8 +227,27 @@ def _shuffle_buffer(inner, rng, k: int):
         yield buf.pop()
 
 
+def _newline_bound(path: str, after: int) -> int:
+    """Byte offset one past the LAST newline in ``path`` (scanning
+    backward from EOF in chunks), or ``after`` when no newline lands at
+    or beyond it — the native tailer's parse bound, so a writer's
+    partial trailing line stays untouched."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        pos = f.tell()
+        while pos > after:
+            step = min(1 << 16, pos - after)
+            f.seek(pos - step)
+            chunk = f.read(step)
+            nl = chunk.rfind(b"\n")
+            if nl >= 0:
+                return pos - step + nl + 1
+            pos -= step
+    return after
+
+
 def _iter_follow(path, batch_size, max_nnz, feature_cnt, field_cnt,
-                 shuffle_batches, seed, stop, poll_s):
+                 shuffle_batches, seed, stop, poll_s, native=None):
     """Tail-follow reader: stream the file's current content, then poll
     for growth.  The one subtlety is the PARTIAL TAIL LINE — a writer
     caught mid-append leaves bytes with no newline; parsing them would
@@ -236,6 +259,15 @@ def _iter_follow(path, batch_size, max_nnz, feature_cnt, field_cnt,
             "follow mode cannot shuffle (a tail has no epoch to buffer)"
         )
     del seed
+    from lightctr_tpu.native import bindings
+
+    if native is None:
+        native = bindings.available()
+    if native:
+        yield from _iter_follow_native(
+            path, batch_size, max_nnz, feature_cnt, field_cnt, stop, poll_s
+        )
+        return
     from lightctr_tpu.data.sparse import parse_libffm_line
 
     buf = _new_buffers(batch_size, max_nnz)
@@ -263,6 +295,48 @@ def _iter_follow(path, batch_size, max_nnz, feature_cnt, field_cnt,
                     yield buf
                     buf = _new_buffers(batch_size, max_nnz)
                     fill = 0
+
+
+def _iter_follow_native(path, batch_size, max_nnz, feature_cnt, field_cnt,
+                        stop, poll_s):
+    """Native tail-follow: the C chunk parser consumes the file by byte
+    offset up to an explicit bound at the last known newline, so the
+    partial-line contract holds without a Python loop per row.  getline
+    would hand back an unterminated final line as a (possibly torn) row —
+    exactly the bytes a mid-append writer leaves — hence the bound, found
+    by a backward scan from EOF (``_newline_bound``), not by trusting
+    EOF.  Rows accumulate across polls into one fill buffer; batches are
+    emitted only when full, same as the Python tailer."""
+    from lightctr_tpu.native.bindings import parse_libffm_chunk
+
+    buf = _new_buffers(batch_size, max_nnz)
+    fill = 0
+    offset = 0
+    bound = 0
+    while not _stop_requested(stop):
+        if offset >= bound:
+            bound = _newline_bound(path, offset)
+            if bound <= offset:
+                time.sleep(poll_s)
+                continue
+        arrays, rows, offset = parse_libffm_chunk(
+            path, offset, batch_size - fill, max_nnz,
+            fold_fid=feature_cnt or 0, fold_field=field_cnt or 0,
+            end=bound,
+        )
+        if rows == 0:
+            continue  # the window held only blank lines
+        buf["fids"][fill:fill + rows] = arrays["fids"][:rows]
+        buf["fields"][fill:fill + rows] = arrays["fields"][:rows]
+        buf["vals"][fill:fill + rows] = arrays["vals"][:rows]
+        buf["mask"][fill:fill + rows] = arrays["mask"][:rows]
+        buf["labels"][fill:fill + rows] = arrays["labels"][:rows]
+        buf["row_mask"][fill:fill + rows] = 1.0
+        fill += rows
+        if fill == batch_size:
+            yield buf
+            buf = _new_buffers(batch_size, max_nnz)
+            fill = 0
 
 
 def _stride_rebatch(inner, batch_size, process_index, process_count, drop_remainder):

@@ -36,6 +36,7 @@ from lightctr_tpu.obs import trace as trace_mod
 from lightctr_tpu.utils.profiling import annotate
 from lightctr_tpu.core.config import TrainConfig
 from lightctr_tpu.core.mesh import replicated, shard_batch
+from lightctr_tpu.data import ingest as ingest_mod
 from lightctr_tpu.data.batching import minibatches
 from lightctr_tpu.models._common import tree_copy
 from lightctr_tpu.ops import losses as losses_lib
@@ -609,21 +610,28 @@ class CTRTrainer:
             return shard_batch(self.mesh, {k: jnp.asarray(v) for k, v in batch.items()})
         return {k: jnp.asarray(v) for k, v in batch.items()}
 
-    def train_step(self, batch: Dict[str, np.ndarray]) -> float:
+    def train_step(self, batch: Dict[str, np.ndarray], *,
+                   device_ready: bool = False) -> float:
+        """One optimizer step.  ``device_ready=True`` asserts the batch
+        already went through :meth:`_put` (a prefetch stage ran the
+        pad+transfer off the critical path), so the step skips it — the
+        ``input`` stepwatch phase then measures ~nothing, which is the
+        point."""
         if not obs.enabled():
+            dev_batch = batch if device_ready else self._put(batch)
             self.params, self.opt_state, loss, _ = self._step(
-                self.params, self.opt_state, self._put(batch)
+                self.params, self.opt_state, dev_batch
             )
             return loss
         if trace_mod.enabled():
             # separate path so the default (tracing-off) step pays exactly
             # one extra branch — the overhead guard measures this path
-            return self._train_step_traced(batch)
+            return self._train_step_traced(batch, device_ready=device_ready)
         t0 = time.perf_counter()
         sw = self.stepwatch
         if sw is not None:
             sw.mark("input")
-        dev_batch = self._put(batch)
+        dev_batch = batch if device_ready else self._put(batch)
         if sw is not None:
             sw.mark("exec")
         self.params, self.opt_state, loss, health = self._step(
@@ -633,7 +641,8 @@ class CTRTrainer:
                           health=health)
         return loss
 
-    def _train_step_traced(self, batch: Dict[str, np.ndarray]) -> float:
+    def _train_step_traced(self, batch: Dict[str, np.ndarray], *,
+                           device_ready: bool = False) -> float:
         """Phase-spanned step: ``annotate`` puts the same names on the XLA
         profiler timeline and the wire trace (obs/trace.py), and any PS
         RPC issued under these phases stitches into this step's trace via
@@ -646,7 +655,7 @@ class CTRTrainer:
             with annotate("trainer/input"):
                 if sw is not None:
                     sw.mark("input")
-                dev_batch = self._put(batch)
+                dev_batch = batch if device_ready else self._put(batch)
             with annotate("trainer/exec"):
                 if sw is not None:
                     sw.mark("exec")
@@ -777,6 +786,56 @@ class CTRTrainer:
         hybrid sparse trainer reports its exchange decisions here)."""
         return {}
 
+    def _prefetch_prepare(self) -> Optional[Callable]:
+        """The per-batch transform a prefetch stage runs OFF the step's
+        critical path — pad+device-transfer for this trainer.  Subclasses
+        whose step plans against the HOST batch (the sparse trainer's
+        exchange planner) return None: prefetch then overlaps only the
+        parse, and the step keeps its own ``_put``."""
+        return self._put
+
+    def _resolve_arrays(self, arrays):
+        """``fit``/``fit_fullbatch_scan`` accept a compiled shard cache
+        (:class:`~lightctr_tpu.data.ingest.ShardCache` or a cache
+        directory) anywhere they accept an array dict — re-runs load
+        pre-tokenized rows with zero parse work."""
+        if isinstance(arrays, (str, ingest_mod.ShardCache)):
+            return ingest_mod.as_arrays(arrays)
+        return arrays
+
+    def fit_stream(
+        self,
+        stream,
+        max_steps: Optional[int] = None,
+        prefetch: Optional[int] = None,
+    ) -> list:
+        """Drain a stream of padded batch dicts (the streaming reader,
+        a shard-cache replay, …) through :meth:`train_step`.
+        ``prefetch=K`` interposes :func:`~lightctr_tpu.data.ingest.
+        prefetch_batches` with ``depth=K``: a worker thread keeps K
+        parsed+padded+device-resident batches in flight behind the step
+        (device transfer included whenever :meth:`_prefetch_prepare`
+        provides one).  Returns the per-step losses."""
+        prep = self._prefetch_prepare() if prefetch else None
+        if prefetch:
+            stream = ingest_mod.prefetch_batches(
+                stream, depth=prefetch, prepare=prep,
+                registry=self.telemetry)
+        losses = []
+        try:
+            for batch in stream:
+                losses.append(float(self.train_step(
+                    batch, device_ready=prep is not None)))
+                if max_steps is not None and len(losses) >= max_steps:
+                    break
+        finally:
+            if hasattr(stream, "close"):
+                stream.close()  # stop the prefetch worker promptly
+        self.flush_health()
+        if self.stepwatch is not None:
+            self.stepwatch.pause()
+        return losses
+
     def fit(
         self,
         arrays: Dict[str, np.ndarray],
@@ -785,7 +844,9 @@ class CTRTrainer:
         eval_arrays: Optional[Dict[str, np.ndarray]] = None,
         eval_every: int = 0,
         verbose: bool = False,
+        prefetch: Optional[int] = None,
     ) -> Dict[str, list]:
+        arrays = self._resolve_arrays(arrays)
         epochs = epochs if epochs is not None else self.cfg.epochs
         n_rows = len(next(iter(arrays.values())))
         if batch_size is not None and batch_size > n_rows:
@@ -804,8 +865,19 @@ class CTRTrainer:
                 )
             else:
                 loss = None
-                for batch in minibatches(arrays, batch_size, seed=self.cfg.seed + epoch):
-                    loss = self.train_step(batch)
+                inner = minibatches(arrays, batch_size,
+                                    seed=self.cfg.seed + epoch)
+                if prefetch:
+                    prep = self._prefetch_prepare()
+                    inner = ingest_mod.prefetch_batches(
+                        inner, depth=prefetch, prepare=prep,
+                        registry=self.telemetry)
+                    for batch in inner:
+                        loss = self.train_step(
+                            batch, device_ready=prep is not None)
+                else:
+                    for batch in inner:
+                        loss = self.train_step(batch)
             history["loss"].append(float(loss))
             ev = None
             if eval_every and eval_arrays is not None and (epoch + 1) % eval_every == 0:
@@ -830,7 +902,7 @@ class CTRTrainer:
         zero per-epoch dispatch, the TPU equivalent of the reference's
         T-epoch re-train loops (main.cpp:227-229).  Returns the loss
         trajectory."""
-        batch = self._put(arrays)
+        batch = self._put(self._resolve_arrays(arrays))
         run = self._get_scan_fn(epochs)
         self.params, self.opt_state, losses = run(self.params, self.opt_state, batch)
         return np.asarray(losses)

@@ -1396,7 +1396,14 @@ class SparseTableCTRTrainer(CTRTrainer):
                 self._force_ag = False
         return self._step_ag
 
-    def train_step(self, batch):
+    def _prefetch_prepare(self):
+        # the exchange planner (_exchange_plan/_rs_batch_fits) inspects
+        # HOST ids before dispatch, so a prefetch stage must hand this
+        # trainer host batches: prefetch overlaps the parse/pad only and
+        # the step keeps its own _put
+        return None
+
+    def train_step(self, batch, **kw):
         self._last_step_fallback = False
         if self._hybrid_dp:
             plan = self._exchange_plan(batch)
@@ -1405,19 +1412,20 @@ class SparseTableCTRTrainer(CTRTrainer):
                 self.telemetry.inc("trainer_rs_fallback_total")
                 primary, self._step = self._step, self._fallback_step_fn()
                 try:
-                    return super().train_step(batch)
+                    return super().train_step(batch, **kw)
                 finally:
                     self._step = primary
-        return super().train_step(batch)
+        return super().train_step(batch, **kw)
 
     def fit(self, arrays, epochs=None, batch_size=None, eval_arrays=None,
-            eval_every=0, verbose=False):
+            eval_every=0, verbose=False, prefetch=None):
         # the full-batch epoch path dispatches self._step directly, so the
         # rs capacity check must happen here (minibatch fits go through
         # train_step, which guards itself)
+        arrays = self._resolve_arrays(arrays)
         kw = dict(epochs=epochs, batch_size=batch_size,
                   eval_arrays=eval_arrays, eval_every=eval_every,
-                  verbose=verbose)
+                  verbose=verbose, prefetch=prefetch)
         if (self._hybrid_dp and batch_size is None
                 and not self._rs_batch_fits(arrays,
                                             self._exchange_plan(arrays))):

@@ -91,7 +91,7 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.ffm_parse_chunk.restype = ctypes.c_long
     lib.ffm_parse_chunk.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_long), ctypes.c_long,
-        ctypes.c_long, ctypes.c_long, ctypes.c_long,
+        ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
         ctypes.c_long, ctypes.c_long,
         ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_float),
@@ -159,6 +159,16 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.varint_unpack.argtypes = [
         ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,
         ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+    ]
+    lib.shard_decode_block.restype = ctypes.c_long
+    lib.shard_decode_block.argtypes = [
+        ctypes.POINTER(ctypes.c_ubyte), ctypes.c_long,  # payload, nbytes
+        ctypes.c_long, ctypes.c_long, ctypes.c_int,     # rows, width, f16
+        ctypes.POINTER(ctypes.c_int32),   # fids
+        ctypes.POINTER(ctypes.c_int32),   # fields
+        ctypes.POINTER(ctypes.c_float),   # vals
+        ctypes.POINTER(ctypes.c_float),   # mask
+        ctypes.POINTER(ctypes.c_float),   # labels
     ]
     lib.fm_train_fullbatch.restype = ctypes.c_int
     lib.fm_train_fullbatch.argtypes = [
@@ -246,7 +256,7 @@ def parse_libffm_native(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray, 
 def parse_libffm_chunk(
     path: str, offset: int, max_rows: int, max_nnz: int,
     fold_fid: int = 0, fold_field: int = 0,
-    stride: int = 1, phase: int = 0,
+    stride: int = 1, phase: int = 0, end: int = 0,
 ) -> Tuple[dict, int, int]:
     """Parse up to ``max_rows`` rows starting at byte ``offset`` into padded
     arrays.  Returns ``(arrays, rows_parsed, next_offset)`` where ``arrays``
@@ -257,7 +267,10 @@ def parse_libffm_chunk(
     hashing trick), matching the Python generator's pre-narrowing fold.
     ``stride``/``phase``: tokenize only chunk rows with index % stride ==
     phase (others are counted but line-skipped, their array rows zero) —
-    the per-worker shard applied at the scan."""
+    the per-worker shard applied at the scan.  ``end`` > 0 bounds the scan:
+    no line starting at or past that byte is read.  It must sit on a
+    newline boundary — the follow tailer passes the last known one so a
+    writer's partial trailing line is never parsed."""
     l_ = lib()
     if l_ is None:
         raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
@@ -269,7 +282,7 @@ def parse_libffm_chunk(
     off = ctypes.c_long(offset)
     err_line = ctypes.c_long()
     rc = l_.ffm_parse_chunk(
-        path.encode(), ctypes.byref(off), max_rows, max_nnz,
+        path.encode(), ctypes.byref(off), end, max_rows, max_nnz,
         fold_fid, fold_field, stride, phase,
         _iptr(fields), _iptr(fids), _fptr(vals), _fptr(mask), _fptr(labels),
         ctypes.byref(err_line),
@@ -470,6 +483,36 @@ def varint_unpack_native(buf: bytes, n: int, return_consumed: bool = False):
     if rc == -2:
         raise ValueError("corrupt varint stream (value overflows 64 bits)")
     return (out, int(rc)) if return_consumed else out
+
+
+def shard_decode_native(payload, rows: int, width: int, vals_f16: bool,
+                        fids: np.ndarray, fields: np.ndarray,
+                        vals: np.ndarray, mask: np.ndarray,
+                        labels: np.ndarray) -> int:
+    """One-pass decode of a shard-block payload (data/ingest.py wire
+    format) into caller-ZEROED padded ``[rows, width]`` arrays
+    (varint.cpp ``shard_decode_block``): varint+delta+scatter in a
+    single sequential walk.  Returns total tokens; raises ValueError on
+    a structurally corrupt payload."""
+    l_ = lib()
+    if l_ is None:
+        raise RuntimeError(f"native library unavailable: {_BUILD_ERROR}")
+    buf = np.frombuffer(payload, np.uint8)
+    rc = l_.shard_decode_block(
+        buf.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)), len(buf),
+        rows, width, int(bool(vals_f16)),
+        fids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        fields.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc < 0:
+        raise ValueError(
+            {-1: "truncated varint stream", -2: "nnz out of range",
+             -3: "payload length mismatch",
+             -4: "id outside int32 range"}.get(rc, f"decode error {rc}"))
+    return int(rc)
 
 
 def rows_adagrad_native(W: np.ndarray, acc: np.ndarray, slots: np.ndarray,

@@ -183,11 +183,15 @@ int ffm_parse(const char* path, long n_rows, long max_nnz, int* fields,
 // phase; other rows are line-skipped but still COUNTED (their array rows
 // stay zero) — each row is validated by exactly its owning worker, so a
 // 4-worker fleet tokenizes the file once total instead of 4x.  stride=1
-// parses everything (the single-process behavior).  Returns rows
+// parses everything (the single-process behavior).  end > 0 is a byte
+// BOUND: no line starting at or past it is read.  The caller must place
+// it on a newline boundary (one past a '\n'); the follow tailer uses it
+// to stop short of a writer's partial trailing line, which getline would
+// otherwise happily hand over as a (torn) final row at EOF.  Returns rows
 // scanned >= 0, -1 on io error, -2 on parse error, -3 when an id exceeds
 // int32 range and no fold was given (*err_line = line index within this
 // chunk, 1-based).
-long ffm_parse_chunk(const char* path, long* offset, long max_rows,
+long ffm_parse_chunk(const char* path, long* offset, long end, long max_rows,
                      long max_nnz, long fold_fid, long fold_field,
                      long stride, long phase,
                      int* fields, int* fids, float* vals,
@@ -205,7 +209,8 @@ long ffm_parse_chunk(const char* path, long* offset, long max_rows,
     memset(vals, 0, sizeof(float) * max_rows * max_nnz);
     memset(mask, 0, sizeof(float) * max_rows * max_nnz);
     memset(labels, 0, sizeof(float) * max_rows);
-    while (r < max_rows && (len = getline(&line, &cap, f)) != -1) {
+    while (r < max_rows && (end <= 0 || ftell(f) < end)
+           && (len = getline(&line, &cap, f)) != -1) {
         ++lineno;
         const char* p = line;
         skip_ws(p);

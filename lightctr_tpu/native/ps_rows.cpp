@@ -8,10 +8,90 @@
 // (paramserver.h:252-300).
 #include <cmath>
 #include <cstdint>
+#include <cstring>
 
 #if defined(__x86_64__) || defined(__i386__)
 #include <immintrin.h>
 #endif
+
+namespace {
+
+// Scalar half converters for the vector loops' tails (and the whole
+// array on pre-AVX builds).  ``_Float16`` needs GCC >= 12 on x86, so the
+// ladder is: the native type when the compiler has it, the F16C scalar
+// intrinsics when the ISA does, else a software round-to-nearest-even
+// conversion — bit-identical to the hardware ones (tested against
+// numpy's astype(float16)).
+#if defined(__FLT16_MANT_DIG__)
+inline uint16_t f32_to_f16_scalar(float f) {
+    _Float16 h = (_Float16)f;
+    uint16_t u;
+    memcpy(&u, &h, 2);
+    return u;
+}
+inline float f16_to_f32_scalar(uint16_t u) {
+    _Float16 h;
+    memcpy(&h, &u, 2);
+    return (float)h;
+}
+#elif defined(__F16C__)
+inline uint16_t f32_to_f16_scalar(float f) {
+    return (uint16_t)_cvtss_sh(f, _MM_FROUND_TO_NEAREST_INT);
+}
+inline float f16_to_f32_scalar(uint16_t u) { return _cvtsh_ss(u); }
+#else
+inline uint16_t f32_to_f16_scalar(float f) {
+    uint32_t x;
+    memcpy(&x, &f, 4);
+    const uint32_t sign = (x >> 16) & 0x8000u;
+    x &= 0x7FFFFFFFu;
+    if (x >= 0x47800000u) {              // overflow -> inf; inf/nan pass
+        if (x > 0x7F800000u) return (uint16_t)(sign | 0x7E00u);  // nan
+        return (uint16_t)(sign | 0x7C00u);
+    }
+    if (x < 0x38800000u) {               // subnormal half (or zero)
+        if (x < 0x33000000u) return (uint16_t)sign;  // underflows to 0
+        const int shift = 113 - (int)(x >> 23);
+        const uint32_t mant = (x & 0x7FFFFFu) | 0x800000u;
+        uint16_t h = (uint16_t)(sign | (mant >> (shift + 13)));
+        const uint32_t rem = mant & ((1u << (shift + 13)) - 1u);
+        const uint32_t half = 1u << (shift + 12);
+        if (rem > half || (rem == half && (h & 1u))) ++h;
+        return h;
+    }
+    uint16_t h = (uint16_t)(sign | ((x - 0x38000000u) >> 13));
+    const uint32_t rem = x & 0x1FFFu;
+    if (rem > 0x1000u || (rem == 0x1000u && (h & 1u))) ++h;
+    return h;
+}
+inline float f16_to_f32_scalar(uint16_t h) {
+    const uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1Fu;
+    uint32_t mant = h & 0x3FFu;
+    uint32_t x;
+    if (exp == 0) {
+        if (mant == 0) {
+            x = sign;                    // +-0
+        } else {                         // subnormal: renormalize
+            int e = 0;
+            while (!(mant & 0x400u)) {
+                mant <<= 1;
+                ++e;
+            }
+            x = sign | ((uint32_t)(113 - e) << 23) | ((mant & 0x3FFu) << 13);
+        }
+    } else if (exp == 31) {              // inf/nan
+        x = sign | 0x7F800000u | (mant << 13);
+    } else {
+        x = sign | ((exp + 112u) << 23) | (mant << 13);
+    }
+    float f;
+    memcpy(&f, &x, 4);
+    return f;
+}
+#endif
+
+}  // namespace
 
 extern "C" {
 
@@ -58,8 +138,7 @@ void f32_to_f16(const float* src, uint16_t* dst, int64_t n) {
             _mm256_cvtps_ph(_mm256_loadu_ps(src + i),
                             _MM_FROUND_TO_NEAREST_INT));
 #endif
-    _Float16* out = reinterpret_cast<_Float16*>(dst);
-    for (; i < n; ++i) out[i] = (_Float16)src[i];
+    for (; i < n; ++i) dst[i] = f32_to_f16_scalar(src[i]);
 }
 
 void f16_to_f32(const uint16_t* src, float* dst, int64_t n) {
@@ -77,8 +156,7 @@ void f16_to_f32(const uint16_t* src, float* dst, int64_t n) {
             _mm256_cvtph_ps(_mm_loadu_si128(
                 reinterpret_cast<const __m128i*>(src + i))));
 #endif
-    const _Float16* in = reinterpret_cast<const _Float16*>(src);
-    for (; i < n; ++i) dst[i] = (float)in[i];
+    for (; i < n; ++i) dst[i] = f16_to_f32_scalar(src[i]);
 }
 
 }  // extern "C"

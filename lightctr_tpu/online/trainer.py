@@ -355,17 +355,29 @@ class OnlineTrainer:
     # -- the loop ------------------------------------------------------------
 
     def run(self, stream: Iterable[Dict], max_steps: Optional[int] = None,
-            stop=None) -> int:
+            stop=None, prefetch: Optional[int] = None) -> int:
         """Drain ``stream`` (typically infinite — loop/follow mode) until
         it ends, ``stop`` is requested, or ``max_steps`` land.  Returns
-        the step count."""
-        for mb in stream:
-            if _stop_requested(stop):
-                break
-            if self.step(mb, stop=stop) is None:
-                break
-            if max_steps is not None and self.steps >= max_steps:
-                break
+        the step count.  ``prefetch=K`` keeps K parsed batches in flight
+        behind the step (the step still pulls/pushes PS rows itself —
+        prefetch overlaps the parse/pad, the dominant host cost on a
+        follow tail)."""
+        if prefetch:
+            from lightctr_tpu.data import ingest as ingest_mod
+
+            stream = ingest_mod.prefetch_batches(
+                stream, depth=prefetch, registry=self.registry)
+        try:
+            for mb in stream:
+                if _stop_requested(stop):
+                    break
+                if self.step(mb, stop=stop) is None:
+                    break
+                if max_steps is not None and self.steps >= max_steps:
+                    break
+        finally:
+            if hasattr(stream, "close"):
+                stream.close()  # stop the prefetch worker promptly
         return self.steps
 
     def stats(self) -> Dict:

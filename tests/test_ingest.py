@@ -1,0 +1,430 @@
+"""Compiled data plane (ISSUE 20): shard cache bit-parity, crash
+recovery, deterministic replay, and the K-deep prefetch pipeline."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from lightctr_tpu import obs
+from lightctr_tpu.data import ingest
+from lightctr_tpu.data.streaming import iter_libffm_batches
+
+
+def _write_ffm(path, n, seed=0, max_tok=9, vocab=997, fields=7,
+               val_fn=None):
+    """Deterministic synthetic libFFM with varying nnz, blank lines, and
+    (for max_tok > width) over-long rows that exercise truncation."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for i in range(n):
+            nnz = int(rng.integers(1, max_tok))
+            toks = []
+            for _ in range(nnz):
+                v = val_fn(rng) if val_fn else float(rng.integers(1, 5)) / 2
+                toks.append(f"{int(rng.integers(0, fields))}:"
+                            f"{int(rng.integers(0, vocab))}:{v}")
+            f.write(f"{i % 2} {' '.join(toks)}\n")
+            if i % 13 == 0:
+                f.write("\n")  # blank lines are skipped by both paths
+    return str(path)
+
+
+def _assert_streams_equal(got, want):
+    got, want = list(got), list(want)
+    assert len(got) == len(want)
+    for x, y in zip(got, want):
+        assert set(x) == set(y)
+        for k in y:
+            np.testing.assert_array_equal(x[k], y[k], err_msg=k)
+
+
+def test_shard_replay_is_bit_identical_to_live_parse(tmp_path):
+    """The tentpole parity pin: compile once, then every replay batch
+    (full batches AND the padded tail) is bit-identical to the live
+    Python parser's stream — fids, fields, vals, mask, labels,
+    row_mask."""
+    p = _write_ffm(tmp_path / "t.ffm", 300)
+    cache = ingest.compile_shards(p, max_nnz=6,
+                                  cache_dir=str(tmp_path / "c"))
+    for drop in (True, False):
+        _assert_streams_equal(
+            ingest.iter_shard_batches(cache, 32, drop_remainder=drop),
+            iter_libffm_batches(p, 32, 6, drop_remainder=drop,
+                                native=False))
+
+
+def test_python_compile_path_writes_identical_shards(tmp_path,
+                                                     monkeypatch):
+    """The pure-Python encoder (no native library) must produce the SAME
+    shard bytes as the native chunk-parser path, and the numpy decode
+    oracle must read them back bit-identically — the format has one
+    definition, not two."""
+    from lightctr_tpu.native import bindings
+
+    if not bindings.available():
+        pytest.skip("native library unavailable")
+    p = _write_ffm(tmp_path / "t.ffm", 150)
+    nat = ingest.compile_shards(p, max_nnz=6,
+                                cache_dir=str(tmp_path / "nat"))
+    nat_batches = list(ingest.iter_shard_batches(nat, 32))
+    monkeypatch.setattr(ingest.bindings, "available", lambda: False)
+    py = ingest.compile_shards(p, max_nnz=6,
+                               cache_dir=str(tmp_path / "py"))
+    assert py.n_shards == nat.n_shards
+    for i in range(py.n_shards):
+        with open(nat.shard_path(i), "rb") as a, \
+                open(py.shard_path(i), "rb") as b:
+            assert a.read() == b.read(), f"shard {i} bytes differ"
+    _assert_streams_equal(ingest.iter_shard_batches(py, 32), nat_batches)
+
+
+def test_fp32_escape_keeps_nonhalf_values_exact(tmp_path):
+    """Values that don't round-trip through fp16 (e.g. 0.1) flip the
+    block to the fp32 escape — replay stays bit-exact, never
+    half-rounded."""
+    p = _write_ffm(tmp_path / "t.ffm", 60,
+                   val_fn=lambda r: float(r.integers(1, 100)) / 10)
+    cache = ingest.compile_shards(p, max_nnz=6,
+                                  cache_dir=str(tmp_path / "c"))
+    with open(cache.shard_path(0), "rb") as f:
+        blob = f.read()
+    flags = ingest._BLOCK_HEADER.unpack_from(blob, len(ingest._MAGIC))[2]
+    assert not flags & ingest._FLAG_VALS_F16
+    _assert_streams_equal(
+        ingest.iter_shard_batches(cache, 16, drop_remainder=False),
+        iter_libffm_batches(p, 16, 6, drop_remainder=False, native=False))
+
+
+def test_feature_spec_fold_remap_cross_parity(tmp_path):
+    """A FeatureSpec (hash-fold + field remap + one cross) applied at
+    compile time replays bit-identically to the live path applying the
+    SAME spec — and the cross actually lands: width grows by one and
+    cross-field tokens appear."""
+    spec = ingest.FeatureSpec(
+        fold_features=128, field_remap={5: 1, 6: 2},
+        crosses=((0, 1),), cross_feature_cnt=64, cross_field_base=10)
+    p = _write_ffm(tmp_path / "t.ffm", 200)
+    cache = ingest.compile_shards(p, max_nnz=6, spec=spec,
+                                  cache_dir=str(tmp_path / "c"))
+    assert cache.width == 6 + spec.extra_nnz
+    replay = list(ingest.iter_shard_batches(cache, 32,
+                                            drop_remainder=False))
+    live = list(ingest.iter_ingest_batches(
+        p, 32, 6, spec=spec, compile=False, drop_remainder=False))
+    _assert_streams_equal(replay, live)
+    fields = np.concatenate([b["fields"] for b in replay])
+    fids = np.concatenate([b["fids"] for b in replay])
+    assert (fields == 10).any(), "cross tokens never materialized"
+    assert fids.max() < 128, "fold did not apply"
+    assert not np.isin(fields, [5, 6]).any(), "remap left raw fields"
+
+
+def test_feature_spec_validation_digest_and_fold_conflict(tmp_path):
+    with pytest.raises(ValueError, match="cross"):
+        ingest.FeatureSpec(crosses=((0, 1),))
+    spec = ingest.FeatureSpec(fold_features=100, crosses=((0, 1),),
+                              cross_feature_cnt=16, cross_field_base=9)
+    again = ingest.FeatureSpec.from_dict(
+        json.loads(json.dumps(spec.to_dict())))
+    assert again.digest() == spec.digest()
+    assert again == spec
+    p = _write_ffm(tmp_path / "t.ffm", 10)
+    with pytest.raises(ValueError, match="conflict"):
+        ingest.compile_shards(p, max_nnz=4, feature_cnt=50, spec=spec,
+                              cache_dir=str(tmp_path / "c"))
+
+
+def test_cache_hit_recompile_and_torn_tail_recovery(tmp_path):
+    """Crash-safety contract: a matching manifest is a cache hit; a
+    truncated shard (torn tail / killed copy) is a recognizable miss
+    that recompiles — counted as a recovery — and verifies clean."""
+    reg = obs.MetricsRegistry()
+    p = _write_ffm(tmp_path / "t.ffm", 120)
+    cdir = str(tmp_path / "c")
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=cdir,
+                                  registry=reg)
+    rows = cache.rows
+    assert reg.snapshot()["counters"]["ingest_shard_compiles_total"] == 1
+    ingest.compile_shards(p, max_nnz=6, cache_dir=cdir, registry=reg)
+    snap = reg.snapshot()["counters"]
+    assert snap["ingest_shard_cache_hits_total"] == 1
+    assert snap["ingest_shard_compiles_total"] == 1
+
+    sp = cache.shard_path(cache.n_shards - 1)
+    with open(sp, "r+b") as f:
+        f.truncate(os.path.getsize(sp) - 3)
+    assert ingest.load_cache(cdir) is None  # size mismatch = miss
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=cdir,
+                                  registry=reg)
+    snap = reg.snapshot()["counters"]
+    assert snap["ingest_shard_recoveries_total"] == 1
+    assert snap["ingest_shard_compiles_total"] == 2
+    assert cache.verify() == rows
+
+
+def test_kill_mid_compile_debris_recompiles_clean(tmp_path):
+    """A compile killed before the manifest lands leaves tmp turds and
+    partial shards but NO manifest — the next compile sweeps the debris,
+    counts a recovery, and produces a verifiable cache."""
+    reg = obs.MetricsRegistry()
+    p = _write_ffm(tmp_path / "t.ffm", 80)
+    cdir = tmp_path / "c"
+    cdir.mkdir()
+    (cdir / ".shard-00000.lcs.tmp-999").write_bytes(b"partial")
+    (cdir / "shard-00000.lcs").write_bytes(ingest._MAGIC + b"torn")
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=str(cdir),
+                                  registry=reg)
+    snap = reg.snapshot()["counters"]
+    assert snap["ingest_shard_recoveries_total"] == 1
+    assert not [n for n in os.listdir(cdir) if n.startswith(".")]
+    assert cache.verify() == cache.rows > 0
+
+
+def test_inplace_corruption_fails_the_frame_checksum(tmp_path):
+    """Same-size corruption slips past the manifest's size check — the
+    per-block checksum catches it at replay, and force=True rebuilds."""
+    p = _write_ffm(tmp_path / "t.ffm", 90)
+    cdir = str(tmp_path / "c")
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=cdir)
+    with open(cache.shard_path(0), "r+b") as f:
+        f.seek(len(ingest._MAGIC) + ingest._BLOCK_HEADER.size + 5)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert ingest.load_cache(cdir) is not None  # size still matches
+    with pytest.raises(ingest.ShardCorruption):
+        list(ingest.iter_shard_batches(ingest.load_cache(cdir), 16))
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=cdir,
+                                  force=True)
+    assert cache.verify() == cache.rows
+    _assert_streams_equal(
+        ingest.iter_shard_batches(cache, 16, drop_remainder=False),
+        iter_libffm_batches(p, 16, 6, drop_remainder=False, native=False))
+
+
+def test_stride_sharding_parity_with_live(tmp_path):
+    """Replay under ``process_index % process_count`` striding yields the
+    SAME per-worker batches as the live reader (parity by construction —
+    both feed ``_stride_rebatch``), including equal batch counts."""
+    p = _write_ffm(tmp_path / "t.ffm", 260)
+    cache = ingest.compile_shards(p, max_nnz=6,
+                                  cache_dir=str(tmp_path / "c"))
+    for w in range(2):
+        _assert_streams_equal(
+            ingest.iter_shard_batches(cache, 32, process_index=w,
+                                      process_count=2),
+            iter_libffm_batches(p, 32, 6, native=False,
+                                process_index=w, process_count=2))
+    with pytest.raises(ValueError):
+        next(ingest.iter_shard_batches(cache, 32, process_index=1))
+    with pytest.raises(ValueError):
+        next(ingest.iter_shard_batches(cache, 32, process_index=2,
+                                       process_count=2))
+
+
+def test_loop_reshuffle_matches_live_per_epoch(tmp_path):
+    """Deterministic (seed, epoch) replay: the looped + shuffled shard
+    stream is bit-identical to the live looped + shuffled stream for two
+    full epochs — the cache changes WHERE batches come from, never which
+    batches arrive or in what order."""
+    p = _write_ffm(tmp_path / "t.ffm", 96)
+    cache = ingest.compile_shards(p, max_nnz=6,
+                                  cache_dir=str(tmp_path / "c"))
+    n_finite = len(list(ingest.iter_shard_batches(cache, 8)))
+    kw = dict(loop=True, shuffle_batches=4, seed=3)
+    a = ingest.iter_shard_batches(cache, 8, **kw)
+    b = iter_libffm_batches(p, 8, 6, native=False, **kw)
+    for _ in range(2 * n_finite):
+        x, y = next(a), next(b)
+        for k in y:
+            np.testing.assert_array_equal(x[k], y[k], err_msg=k)
+
+
+def test_shard_shuffle_is_seeded_and_lossless(tmp_path):
+    """``shard_shuffle`` permutes SHARD order per epoch from the
+    ``(seed, epoch, salt)`` stream: deterministic for a seed, different
+    across epochs, and every epoch still delivers exactly the file's
+    rows (a permutation, never a sample)."""
+    p = _write_ffm(tmp_path / "t.ffm", 200)
+    cache = ingest.compile_shards(p, max_nnz=6, block_rows=32,
+                                  shard_rows=64,
+                                  cache_dir=str(tmp_path / "c"))
+    assert cache.n_shards >= 3
+
+    def epochs(seed, n_epochs):
+        per_epoch = len(list(ingest.iter_shard_batches(cache, 8)))
+        it = ingest.iter_shard_batches(cache, 8, loop=True,
+                                       shard_shuffle=True, seed=seed)
+        return [[next(it) for _ in range(per_epoch)]
+                for _ in range(n_epochs)]
+
+    a, b = epochs(5, 2), epochs(5, 2)
+    for ea, eb in zip(a, b):
+        _assert_streams_equal(ea, eb)
+    key = [int(x["fids"][0, 0]) for x in a[0]]
+    assert key != [int(x["fids"][0, 0]) for x in a[1]], \
+        "epochs must re-permute shards"
+    base = sorted(int(x["labels"].sum()) for x in
+                  ingest.iter_shard_batches(cache, 8))
+    for e in a:
+        assert sorted(int(x["labels"].sum()) for x in e) == base
+
+
+def test_as_arrays_all_entry_points(tmp_path):
+    """`as_arrays` materializes the same padded arrays from a ShardCache
+    object, its directory, and the raw text file (compiled on first
+    touch)."""
+    p = _write_ffm(tmp_path / "t.ffm", 70)
+    cdir = str(tmp_path / "c")
+    cache = ingest.compile_shards(p, max_nnz=6, cache_dir=cdir)
+    a = ingest.as_arrays(cache)
+    b = ingest.as_arrays(cdir)
+    c = ingest.as_arrays(p, max_nnz=6, cache_dir=cdir)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+        np.testing.assert_array_equal(a[k], c[k], err_msg=k)
+    assert len(a["labels"]) == cache.rows
+    with pytest.raises(ValueError, match="max_nnz"):
+        ingest.as_arrays(str(tmp_path / "t.ffm") + ".nope")
+    with pytest.raises(TypeError):
+        ingest.as_arrays(42)
+
+
+def test_prefetch_matches_sync_and_reports_honestly(tmp_path):
+    """The prefetch stage changes WHEN batches are produced, never what
+    arrives: the prefetched stream is bit-identical to the synchronous
+    one, and the stage reports delivered/ready counters, the
+    ``ingest_overlap_ratio`` honesty gauge, queue-wait observations, and
+    an InstrumentedQueue face."""
+    reg = obs.MetricsRegistry()
+    p = _write_ffm(tmp_path / "t.ffm", 120)
+    cache = ingest.compile_shards(p, max_nnz=6,
+                                  cache_dir=str(tmp_path / "c"))
+    sync = list(ingest.iter_shard_batches(cache, 16,
+                                          drop_remainder=False))
+    pre = list(ingest.prefetch_batches(
+        ingest.iter_shard_batches(cache, 16, drop_remainder=False),
+        depth=3, registry=reg))
+    _assert_streams_equal(pre, sync)
+    snap = reg.snapshot()
+    assert snap["counters"]["ingest_prefetch_batches_total"] == len(sync)
+    assert 0 <= snap["counters"]["ingest_prefetch_ready_total"] \
+        <= len(sync)
+    assert 0.0 <= snap["gauges"]["ingest_overlap_ratio"] <= 1.0
+    assert snap["histograms"]["ingest_wait_seconds"]["count"] == len(sync)
+    assert snap["gauges"][
+        'resource_queue_capacity{queue="ingest_prefetch"}'] == 3
+    with pytest.raises(ValueError, match="depth"):
+        next(ingest.prefetch_batches(iter(sync), depth=0))
+
+
+def test_prefetch_runs_prepare_off_the_consumer_thread():
+    """``prepare`` (the trainer's ``_put``) executes on the WORKER — the
+    consumer only ever sees prepared items."""
+    main = threading.get_ident()
+    seen = []
+
+    def prepare(x):
+        seen.append(threading.get_ident())
+        return x * 10
+
+    out = list(ingest.prefetch_batches(iter(range(5)), depth=2,
+                                       prepare=prepare,
+                                       registry=obs.MetricsRegistry()))
+    assert out == [0, 10, 20, 30, 40]
+    assert all(t != main for t in seen)
+
+
+def test_prefetch_propagates_worker_exceptions_and_closes():
+    """A worker exception surfaces in the consumer (after in-flight
+    items drain), and closing the generator mid-stream stops the worker
+    without hanging."""
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("parser died")
+
+    it = ingest.prefetch_batches(boom(), depth=2,
+                                 registry=obs.MetricsRegistry())
+    got = []
+    with pytest.raises(RuntimeError, match="parser died"):
+        for x in it:
+            got.append(x)
+    assert got == [1, 2]
+
+    before = threading.active_count()
+    it = ingest.prefetch_batches(iter(range(1000)), depth=2,
+                                 registry=obs.MetricsRegistry())
+    assert next(it) == 0
+    it.close()
+    deadline = 50
+    while threading.active_count() > before and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert threading.active_count() <= before
+
+
+def test_trainer_fit_with_prefetch_is_bit_identical(tmp_path):
+    """``CTRTrainer.fit(prefetch=K)`` must train EXACTLY as the
+    synchronous path — same loss trajectory bit for bit — while the
+    overlap gauge and prefetch counters land in the trainer's
+    telemetry.  Also covers ``fit`` accepting a cache directory."""
+    import jax
+
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models import fm
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+    p = _write_ffm(tmp_path / "t.ffm", 128, vocab=500)
+    cdir = str(tmp_path / "c")
+    arrays = ingest.as_arrays(p, max_nnz=6, cache_dir=cdir)
+
+    def train(prefetch, source):
+        cfg = TrainConfig(learning_rate=0.1, lambda_l2=0.001)
+        params = fm.init(jax.random.PRNGKey(0), 500, 4)
+        tr = CTRTrainer(params, fm.logits, cfg, l2_fn=fm.l2_penalty)
+        losses = tr.fit(source, epochs=2, batch_size=32,
+                        prefetch=prefetch)
+        return losses, tr.telemetry.snapshot()
+
+    base, _ = train(None, arrays)
+    pre, snap = train(3, cdir)
+    np.testing.assert_array_equal(np.asarray(base["loss"]),
+                                  np.asarray(pre["loss"]))
+    assert snap["counters"]["ingest_prefetch_batches_total"] == 8
+    assert "ingest_overlap_ratio" in snap["gauges"]
+
+
+def test_varint_codec_python_and_native_agree():
+    """Both ends, both implementations: native pack == Python pack and
+    each decodes the other, across extremes (0, ±1, ±2^62)."""
+    from lightctr_tpu.native import bindings
+
+    if not bindings.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(7)
+    vals = np.concatenate([
+        np.array([0, 1, -1, 2**62, -(2**62), 127, -128], np.int64),
+        rng.integers(-10**12, 10**12, size=500).astype(np.int64),
+    ])
+    nat = bindings.varint_pack_native(vals)
+
+    class _Off:
+        available = staticmethod(lambda: False)
+
+    orig = ingest.bindings
+    try:
+        ingest.bindings = _Off  # force the pure-Python codec
+        py = ingest._pack_varint(vals)
+        back, used = ingest._unpack_varint(memoryview(nat), len(vals))
+    finally:
+        ingest.bindings = orig
+    assert py == nat
+    assert used == len(nat)
+    np.testing.assert_array_equal(back, vals)
+    back2 = np.asarray(
+        bindings.varint_unpack_native(py, len(vals)), np.int64)
+    np.testing.assert_array_equal(back2, vals)

@@ -1142,3 +1142,103 @@ def test_metrics_report_online_section(tmp_path, capsys):
     report2 = json.loads(capsys.readouterr().out)
     assert "freshness" not in report2 and "swap" not in report2
     assert report2["trainer"]["steps"] == 3
+
+
+# -- compiled data plane telemetry lints + report (ISSUE 20) ------------------
+
+
+def test_every_ingest_series_is_declared_and_emitted():
+    """No dark ingest counters: every ``ingest_*`` metric the data plane
+    EMITS (a literal first argument of a registry
+    ``inc``/``gauge_set``/``observe`` call, directly or through
+    ``labeled(...)``) — across every module of ``lightctr_tpu/data/`` —
+    must be declared in ``ingest.INGEST_SERIES``, and every declared
+    series must actually be emitted.  A shard-cache counter or the
+    overlap honesty gauge can therefore never ship unregistered or go
+    stale."""
+    from lightctr_tpu.data import ingest
+
+    emitted = set()
+    for path in sorted((LIB_ROOT / "data").glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("inc", "gauge_set", "observe")
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Call) and arg.args and (
+                    (isinstance(arg.func, ast.Name)
+                     and arg.func.id == "labeled")
+                    or (isinstance(arg.func, ast.Attribute)
+                        and arg.func.attr == "labeled")):
+                arg = arg.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value.startswith("ingest_"):
+                emitted.add(arg.value)
+
+    declared = set(ingest.INGEST_SERIES)
+    assert emitted, "no ingest emissions found (lint is miswired)"
+    undeclared = emitted - declared
+    assert not undeclared, (
+        "ingest series emitted but missing from INGEST_SERIES "
+        "(dark counters): " + ", ".join(sorted(undeclared))
+    )
+    dead = declared - emitted
+    assert not dead, (
+        "INGEST_SERIES declares series the plane never emits "
+        "(stale declarations): " + ", ".join(sorted(dead))
+    )
+    assert len(ingest.INGEST_SERIES) == len(declared), \
+        "duplicate names in INGEST_SERIES"
+
+
+def test_metrics_report_ingest_section(tmp_path, capsys):
+    """--ingest parses the shard-cache and prefetch series out of a
+    registry snapshot: compile/hit/recovery and rows/bytes counters, the
+    prefetch delivered/ready counts, the overlap honesty gauge,
+    consumer-wait percentiles, and the queue's depth/capacity face."""
+    import tools.metrics_report as metrics_report
+
+    reg = obs.MetricsRegistry()
+    reg.inc("ingest_shard_compiles_total", 2)
+    reg.inc("ingest_shard_cache_hits_total", 5)
+    reg.inc("ingest_shard_recoveries_total", 1)
+    reg.inc("ingest_shard_rows_total", 100000)
+    reg.inc("ingest_shard_bytes_total", 1 << 20)
+    reg.inc("ingest_replay_blocks_total", 25)
+    reg.inc("ingest_prefetch_batches_total", 40)
+    reg.inc("ingest_prefetch_ready_total", 36)
+    reg.gauge_set("ingest_overlap_ratio", 0.9)
+    for w in (0.0, 0.001, 0.01):
+        reg.observe("ingest_wait_seconds", w)
+    reg.gauge_set('resource_queue_depth{queue="ingest_prefetch"}', 3)
+    reg.gauge_set('resource_queue_capacity{queue="ingest_prefetch"}', 4)
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(reg.snapshot()))
+    assert metrics_report.main(["--ingest", str(path)]) == 0
+    report = json.loads(capsys.readouterr().out)
+    cache = report["shard_cache"]
+    assert cache["compiles"] == 2 and cache["cache_hits"] == 5
+    assert cache["recoveries"] == 1
+    assert cache["rows_written"] == 100000
+    assert cache["bytes_written"] == 1 << 20
+    assert cache["blocks_replayed"] == 25
+    pre = report["prefetch"]
+    assert pre["batches"] == 40 and pre["ready"] == 36
+    assert pre["overlap_ratio"] == 0.9
+    assert pre["wait"]["count"] == 3
+    assert pre["queue"] == {"depth": 3, "capacity": 4, "fill": 0.75}
+
+    # a compile-only snapshot (no prefetch series) must omit the
+    # prefetch section entirely, not render it zeroed
+    reg2 = obs.MetricsRegistry()
+    reg2.inc("ingest_shard_compiles_total")
+    path2 = tmp_path / "snap2.json"
+    path2.write_text(json.dumps(reg2.snapshot()))
+    assert metrics_report.main(["--ingest", str(path2)]) == 0
+    report2 = json.loads(capsys.readouterr().out)
+    assert "prefetch" not in report2
+    assert report2["shard_cache"]["compiles"] == 1

@@ -343,3 +343,73 @@ def test_scan_level_shard_validates_rows_at_their_owner(tmp_path):
     assert len(rows["labels"]) == 32
     np.testing.assert_array_equal(rows["fids"][:, 0],
                                   np.arange(0, 64, 2) % 50)
+
+def test_native_follow_preserves_the_partial_line_contract(tmp_path):
+    """ISSUE 20 satellite: ``follow=True`` through the NATIVE chunk
+    parser honors the same partial-trailing-line contract as the Python
+    tailer — the parse bound stops at the last newline
+    (``_newline_bound``), so a writer caught mid-append is never
+    misread, and the torn line parses as ONE row once its newline
+    lands."""
+    import threading
+
+    from lightctr_tpu.native.bindings import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    p = tmp_path / "tail.ffm"
+    with open(p, "w") as f:
+        f.write("0 0:1:1.0 1:2:1.0\n1 0:3:1.0\n")
+        f.write("1 0:")  # torn mid-token: parsing it would raise
+    ev = threading.Event()
+    it = iter_libffm_batches(str(p), 2, 4, follow=True, native=True,
+                             stop=ev, poll_s=0.01)
+    b1 = next(it)  # the two COMPLETE lines; the torn tail waits
+    assert int(b1["fids"][0, 0]) == 1 and int(b1["fids"][1, 0]) == 3
+    assert b1["row_mask"].sum() == 2
+    with open(p, "a") as f:
+        f.write("5:2.5\n0 0:7:1.0\n")  # completes the torn line + one row
+    b2 = next(it)
+    assert int(b2["fids"][0, 0]) == 5  # the stitched line parsed as ONE row
+    np.testing.assert_allclose(b2["vals"][0, 0], 2.5)
+    assert int(b2["fids"][1, 0]) == 7
+    ev.set()
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_native_follow_matches_python_follow(tmp_path):
+    """Both tailers, fed the same growth increments, yield identical
+    batches — the native path is a faster implementation of the same
+    stream, not a different one."""
+    import threading
+
+    from lightctr_tpu.native.bindings import available
+
+    if not available():
+        pytest.skip("native library unavailable")
+    p = tmp_path / "grow.ffm"
+    _write_rows(p, 5)
+    ev = threading.Event()
+    its = [iter_libffm_batches(str(p), 4, 4, follow=True, native=nat,
+                               stop=ev, poll_s=0.01)
+           for nat in (True, False)]
+    batches = [[next(it)] for it in its]
+    _write_rows(p, 7, start=5)  # tail past another batch boundary
+    for i, it in enumerate(its):
+        batches[i].append(next(it))
+    ev.set()
+    for a, b in zip(*batches):
+        for k in b:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_newline_bound_scans_back_to_the_last_newline(tmp_path):
+    from lightctr_tpu.data.streaming import _newline_bound
+
+    p = tmp_path / "b.txt"
+    p.write_bytes(b"aaa\nbb\nccc")  # 10 bytes, last newline at 6
+    assert _newline_bound(str(p), 0) == 7
+    assert _newline_bound(str(p), 7) == 7  # only the torn tail remains
+    p.write_bytes(b"no newline at all")
+    assert _newline_bound(str(p), 0) == 0

@@ -1,0 +1,349 @@
+"""Compiled-data-plane bench: parse vs shard-replay vs prefetch-overlap.
+
+The data plane's promise (docs/INGEST.md) is quantitative: ingest must
+never bottleneck the trainer.  Concretely, on this host:
+
+  - ``shard_replay`` (pre-tokenized binary shards, numpy-vectorized
+    decode) must deliver rows at >= ``GATE_REPLAY_X`` the LIVE fused
+    trainer's examples/s — the trainer measured HERE, same protocol as
+    ``bench.py`` (full-batch native FM k=8), not a number copied from an
+    old artifact — so a re-epoch can always outrun the step;
+  - the TRAINER-SIDE overlap cell (a real ``CTRTrainer.fit_stream`` with
+    ``prefetch=K`` over the shard replay) must report
+    ``ingest_overlap_ratio`` >= ``GATE_OVERLAP``: the honesty gauge
+    measures the fraction of steps served without blocking on ingest —
+    a pipeline that secretly serializes fails the gate even if raw
+    replay is fast.
+
+Cells (all on one deterministic synthetic libFFM file, or ``--data``):
+
+  - ``parse_python`` / ``parse_native``: the live text path, both
+    parsers — the baseline the shard cache removes from every re-epoch;
+  - ``shard_compile``: the one-time cost of building the cache;
+  - ``shard_replay``: pre-tokenized replay throughput (the gate cell);
+  - ``prefetch_overlap``: replay through ``prefetch_batches`` against a
+    fixed per-batch compute window — overlap ratio + delivered rate;
+  - ``trainer_overlap``: the real trainer loop, prefetched (gate cell);
+  - ``trainer_fullbatch``: the live fused-trainer examples/s reference.
+
+Emits ``INGEST_BENCH.json`` (stdout + file).  Wall clock because overlap
+is the point being measured; best-of-N repeats absorb shared-box noise.
+
+Run:  python -m tools.ingest_bench [--rows 100000] [--history BENCH_HISTORY.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightctr_tpu.data import ingest  # noqa: E402
+from lightctr_tpu.data.streaming import iter_libffm_batches  # noqa: E402
+from lightctr_tpu.native import bindings  # noqa: E402
+
+GATE_REPLAY_X = 2.0   # shard replay >= 2x the fused trainer's examples/s
+GATE_OVERLAP = 0.9    # trainer-side ingest_overlap_ratio floor
+
+
+def _log(msg: str) -> None:
+    print(f"[ingest_bench] {msg}", file=sys.stderr, flush=True)
+
+
+def make_data(path: str, rows: int, nnz: int, fields: int,
+              vocab: int, seed: int = 0) -> None:
+    """Deterministic synthetic libFFM file — CTR-shaped (small field
+    set, large hashed vocabulary, unit values)."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(rows):
+            k = int(rng.integers(max(1, nnz - 4), nnz + 1))
+            fld = rng.integers(0, fields, size=k)
+            fid = rng.integers(0, vocab, size=k)
+            toks = " ".join(f"{a}:{b}:1" for a, b in zip(fld, fid))
+            f.write(f"{int(rng.integers(0, 2))} {toks}\n")
+
+
+def _drain(it) -> int:
+    rows = 0
+    for b in it:
+        rows += int(b["row_mask"].sum()) if "row_mask" in b \
+            else len(b["labels"])
+    return rows
+
+
+def time_stream(make_iter, repeats: int):
+    """Best-of-N full drains -> (rows, seconds of the best run)."""
+    best = float("inf")
+    rows = 0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        rows = _drain(make_iter())
+        best = min(best, time.perf_counter() - t0)
+    return rows, best
+
+
+def run_parse_cells(path, batch, max_nnz, repeats, py_cap_rows):
+    """The live text path, both parsers.  The Python cell parses a
+    bounded prefix (it is ~100x slower; the RATE is what matters) —
+    the cap is reported, never silent."""
+    cells = {}
+    if bindings.available():
+        rows, dt = time_stream(
+            lambda: iter_libffm_batches(path, batch, max_nnz,
+                                        drop_remainder=False, native=True),
+            repeats)
+        cells["parse_native"] = {
+            "rows": rows, "seconds": round(dt, 4),
+            "rows_per_sec": round(rows / dt, 1),
+        }
+    import itertools
+    cap_batches = max(1, py_cap_rows // batch)
+    rows, dt = time_stream(
+        lambda: itertools.islice(
+            iter_libffm_batches(path, batch, max_nnz, native=False),
+            cap_batches),
+        1)
+    cells["parse_python"] = {
+        "rows": rows, "seconds": round(dt, 4),
+        "rows_per_sec": round(rows / dt, 1),
+        "note": f"bounded to {rows} rows (rate cell)",
+    }
+    return cells
+
+
+def run_replay_cells(path, cache, batch, repeats):
+    rows, dt = time_stream(
+        lambda: ingest.iter_shard_batches(cache, batch,
+                                          drop_remainder=False),
+        repeats)
+    return {
+        "rows": rows, "seconds": round(dt, 4),
+        "rows_per_sec": round(rows / dt, 1),
+        "shards": cache.n_shards,
+        "bytes": sum(s["bytes"] for s in cache.manifest["shards"]),
+        "source_bytes": os.path.getsize(path),
+    }
+
+
+def run_prefetch_cell(cache, batch, depth, compute_s):
+    """Replay through the prefetch stage against a fixed compute window
+    per batch (the consumer 'step').  With the window longer than one
+    batch's decode, every get after warm-up should be served ready."""
+    from lightctr_tpu.obs.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    rows = 0
+    t0 = time.perf_counter()
+    for b in ingest.prefetch_batches(
+            ingest.iter_shard_batches(cache, batch, drop_remainder=False),
+            depth=depth, registry=reg):
+        rows += len(b["labels"])
+        time.sleep(compute_s)
+    dt = time.perf_counter() - t0
+    snap = reg.snapshot()
+    return {
+        "rows": rows, "seconds": round(dt, 4),
+        "rows_per_sec": round(rows / dt, 1),
+        "depth": depth, "compute_ms": compute_s * 1e3,
+        "overlap_ratio": round(
+            snap["gauges"].get("ingest_overlap_ratio", 0.0), 4),
+        "batches": int(
+            snap["counters"].get("ingest_prefetch_batches_total", 0)),
+    }
+
+
+def run_trainer_cells(path, cache, batch, depth, max_nnz, vocab):
+    """The gate pair: the LIVE fused-trainer examples/s reference
+    (bench.py protocol — full-batch native FM k=8, best-of-3) and the
+    real prefetched minibatch loop with its overlap gauge."""
+    import jax
+
+    from lightctr_tpu import TrainConfig
+    from lightctr_tpu.models import fm
+    from lightctr_tpu.models.ctr_trainer import CTRTrainer
+
+    cells = {}
+    cfg = TrainConfig(learning_rate=0.05, lambda_l2=0.001)
+
+    # -- live full-batch reference (the denominator of the gate) --------
+    arrays = ingest.as_arrays(cache)
+    n_ref = min(1000, len(arrays["labels"]))
+    ref = {k: np.ascontiguousarray(v[:n_ref]) for k, v in arrays.items()}
+    params = fm.init(jax.random.PRNGKey(0), vocab, 8)
+    if bindings.available():
+        from lightctr_tpu.native.bindings import fm_train_fullbatch_native
+
+        epochs = 300
+        w0 = np.asarray(params["w"], np.float32)
+        v0 = np.asarray(params["v"], np.float32)
+        w, v = w0.copy(), v0.copy()
+        fm_train_fullbatch_native(ref, vocab, 8, 20, cfg.learning_rate,
+                                  cfg.lambda_l2, w, v)  # warm-up
+        dt = float("inf")
+        for _ in range(3):
+            w, v = w0.copy(), v0.copy()
+            t0 = time.perf_counter()
+            fm_train_fullbatch_native(ref, vocab, 8, epochs,
+                                      cfg.learning_rate, cfg.lambda_l2,
+                                      w, v)
+            dt = min(dt, time.perf_counter() - t0)
+        cells["trainer_fullbatch"] = {
+            "examples_per_sec": round(epochs * n_ref / dt, 1),
+            "rows": n_ref, "epochs": epochs, "platform": "cpu-native",
+        }
+    else:
+        tr = CTRTrainer(params, fm.logits, cfg,
+                        fused_fn=fm.logits_with_l2)
+        tr.warmup_fullbatch_scan(ref, 50)
+        t0 = time.perf_counter()
+        tr.fit_fullbatch_scan(ref, 50)
+        dt = time.perf_counter() - t0
+        cells["trainer_fullbatch"] = {
+            "examples_per_sec": round(50 * n_ref / dt, 1),
+            "rows": n_ref, "epochs": 50, "platform": "jax",
+        }
+
+    # -- the prefetched minibatch loop (overlap gate) -------------------
+    tr = CTRTrainer(fm.init(jax.random.PRNGKey(0), vocab, 8), fm.logits,
+                    cfg, fused_fn=fm.logits_with_l2)
+    warm = ingest.iter_shard_batches(cache, batch, drop_remainder=False)
+    tr.train_step(next(iter(warm)))  # jit warm-up outside the timing
+    t0 = time.perf_counter()
+    losses = tr.fit_stream(
+        ingest.iter_shard_batches(cache, batch, drop_remainder=False),
+        prefetch=depth)
+    dt = time.perf_counter() - t0
+    snap = tr.telemetry.snapshot()
+    cells["trainer_overlap"] = {
+        "steps": len(losses),
+        "examples_per_sec": round(len(losses) * batch / dt, 1),
+        "prefetch_depth": depth,
+        "overlap_ratio": round(
+            snap["gauges"].get("ingest_overlap_ratio", 0.0), 4),
+        "prefetch_batches": int(
+            snap["counters"].get("ingest_prefetch_batches_total", 0)),
+        "ready": int(
+            snap["counters"].get("ingest_prefetch_ready_total", 0)),
+    }
+    return cells
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--data", default=None,
+                    help="libFFM file (default: synthesize one)")
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--nnz", type=int, default=12)
+    ap.add_argument("--fields", type=int, default=24)
+    ap.add_argument("--vocab", type=int, default=50_000)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--depth", type=int, default=4,
+                    help="prefetch depth K")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--py-cap-rows", type=int, default=16384,
+                    help="row bound for the (slow) Python parse cell")
+    ap.add_argument("--compute-ms", type=float, default=1.0,
+                    help="simulated step window in the prefetch cell")
+    ap.add_argument("--out", default="INGEST_BENCH.json",
+                    help="also write the artifact here ('-' = stdout only)")
+    ap.add_argument("--history", default=None,
+                    help="fold the artifact into this BENCH_HISTORY.jsonl "
+                         "and gate on trailing-median regressions "
+                         "(tools/bench_history.py)")
+    args = ap.parse_args(argv)
+
+    if args.data:
+        path = args.data
+    else:
+        workdir = tempfile.mkdtemp(prefix="ingest_bench_")
+        path = os.path.join(workdir, "bench.ffm")
+        _log(f"synthesizing {args.rows} rows -> {path}")
+        make_data(path, args.rows, args.nnz, args.fields, args.vocab)
+
+    cells = run_parse_cells(path, args.batch, args.nnz, args.repeats,
+                            args.py_cap_rows)
+    for k in ("parse_native", "parse_python"):
+        if k in cells:
+            _log(f"{k}: {cells[k]['rows_per_sec']:.0f} rows/s")
+
+    t0 = time.perf_counter()
+    cache = ingest.compile_shards(path, args.nnz, force=True)
+    dt = time.perf_counter() - t0
+    cells["shard_compile"] = {
+        "rows": cache.rows, "seconds": round(dt, 4),
+        "rows_per_sec": round(cache.rows / dt, 1),
+    }
+    _log(f"shard_compile: {cells['shard_compile']['rows_per_sec']:.0f} "
+         f"rows/s ({cache.n_shards} shards)")
+
+    cells["shard_replay"] = run_replay_cells(path, cache, args.batch,
+                                             args.repeats)
+    _log(f"shard_replay: {cells['shard_replay']['rows_per_sec']:.0f} "
+         f"rows/s")
+
+    cells["prefetch_overlap"] = run_prefetch_cell(
+        cache, args.batch, args.depth, args.compute_ms / 1e3)
+    _log(f"prefetch_overlap: ratio="
+         f"{cells['prefetch_overlap']['overlap_ratio']}")
+
+    cells.update(run_trainer_cells(path, cache, args.batch, args.depth,
+                                   args.nnz, args.vocab))
+    _log(f"trainer_fullbatch: "
+         f"{cells['trainer_fullbatch']['examples_per_sec']:.0f} ex/s; "
+         f"trainer_overlap: ratio="
+         f"{cells['trainer_overlap']['overlap_ratio']}")
+
+    trainer_rate = cells["trainer_fullbatch"]["examples_per_sec"]
+    replay_rate = cells["shard_replay"]["rows_per_sec"]
+    gate = {
+        "rule": f"shard_replay rows/s >= {GATE_REPLAY_X}x the live "
+                f"fused-trainer examples/s AND trainer-side "
+                f"ingest_overlap_ratio >= {GATE_OVERLAP}",
+        "replay_over_trainer": round(replay_rate / trainer_rate, 3),
+        "trainer_overlap_ratio":
+            cells["trainer_overlap"]["overlap_ratio"],
+    }
+    report = {
+        "rows": cells["shard_replay"]["rows"],
+        "batch": args.batch, "depth": args.depth,
+        "native": bindings.available(),
+        "cells": cells,
+        "gate": gate,
+        # flat keys for the history fold (direction from the name)
+        "shard_replay_rows_per_sec": replay_rate,
+        "trainer_overlap_ratio":
+            cells["trainer_overlap"]["overlap_ratio"],
+        "ok": bool(
+            replay_rate >= GATE_REPLAY_X * trainer_rate
+            and cells["trainer_overlap"]["overlap_ratio"] >= GATE_OVERLAP
+        ),
+    }
+    if args.out and args.out != "-":
+        with open(args.out, "w") as f:
+            f.write(json.dumps(report, indent=1) + "\n")
+    print(json.dumps(report, indent=1))
+    if args.history and args.out and args.out != "-":
+        # the perf-regression trajectory (tools/bench_history.py): a run
+        # that regresses >20% past its own trailing median fails HERE,
+        # not three PRs later in a human's diff
+        try:
+            import bench_history
+        except ImportError:  # ran as `python -m tools.ingest_bench`
+            from tools import bench_history
+        hist_gate = bench_history.fold_and_gate(args.out, args.history)
+        print(json.dumps({"bench_history_gate": hist_gate}, indent=1))
+        if not hist_gate["ok"]:
+            return 1
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -690,6 +690,59 @@ def summarize_resources(doc) -> dict:
     return report
 
 
+def summarize_ingest(doc) -> dict:
+    """Registry snapshot (or a stats() dump carrying one under
+    ``telemetry``) -> compiled-data-plane report (docs/INGEST.md): the
+    shard cache (compiles vs hits vs torn-cache recoveries, rows/bytes
+    written, blocks replayed) and the prefetch pipeline (batches
+    delivered, gets served without blocking, the ``ingest_overlap_ratio``
+    honesty gauge, consumer-wait percentiles, and the prefetch queue's
+    depth/capacity/fill from its ``resource_queue_*`` face).  Every
+    series here is declared in
+    ``lightctr_tpu.data.ingest.INGEST_SERIES`` (lint-enforced)."""
+    snap = doc.get("telemetry", doc) if isinstance(doc, dict) else doc
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+
+    report: dict = {}
+    cache = {
+        "compiles": int(counters.get("ingest_shard_compiles_total", 0)),
+        "cache_hits": int(
+            counters.get("ingest_shard_cache_hits_total", 0)),
+        "recoveries": int(
+            counters.get("ingest_shard_recoveries_total", 0)),
+        "rows_written": int(counters.get("ingest_shard_rows_total", 0)),
+        "bytes_written": int(counters.get("ingest_shard_bytes_total", 0)),
+        "blocks_replayed": int(
+            counters.get("ingest_replay_blocks_total", 0)),
+    }
+    if any(cache.values()):
+        report["shard_cache"] = cache
+    batches = int(counters.get("ingest_prefetch_batches_total", 0))
+    if batches or "ingest_overlap_ratio" in gauges:
+        prefetch = {
+            "batches": batches,
+            "ready": int(counters.get("ingest_prefetch_ready_total", 0)),
+        }
+        if "ingest_overlap_ratio" in gauges:
+            prefetch["overlap_ratio"] = round(
+                float(gauges["ingest_overlap_ratio"]), 4)
+        if "ingest_wait_seconds" in hists:
+            prefetch["wait"] = _hist_summary(hists["ingest_wait_seconds"])
+        prefix = 'resource_queue_depth{queue="ingest_prefetch"}'
+        if prefix in gauges:
+            queue = {"depth": int(gauges[prefix])}
+            cap = gauges.get(
+                'resource_queue_capacity{queue="ingest_prefetch"}')
+            if cap:
+                queue["capacity"] = int(cap)
+                queue["fill"] = round(queue["depth"] / int(cap), 4)
+            prefetch["queue"] = queue
+        report["prefetch"] = prefetch
+    return report
+
+
 def summarize_device(doc) -> dict:
     """Registry snapshot (or a stats() dump carrying one under
     ``telemetry``) -> device/compiled-program report
@@ -870,6 +923,13 @@ def main(argv=None):
                          "census vs budgets, donation misses, profiler "
                          "captures) from a registry snapshot or stats() "
                          "dump")
+    ap.add_argument("--ingest", metavar="SNAPSHOT_JSON",
+                    help="summarize the compiled data plane (shard-cache "
+                         "compiles/hits/recoveries + rows/bytes, blocks "
+                         "replayed, prefetch batches/ready with the "
+                         "overlap-ratio honesty gauge, consumer-wait "
+                         "percentiles, prefetch queue fill) from a "
+                         "registry snapshot or stats() dump")
     args = ap.parse_args(argv)
 
     if args.prom:
@@ -968,13 +1028,22 @@ def main(argv=None):
             with open(args.out, "w") as f:
                 json.dump(report, f, indent=1)
         return 0
+    if args.ingest:
+        with open(args.ingest) as f:
+            doc = json.load(f)
+        report = summarize_ingest(doc)
+        print(json.dumps(report, indent=1))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(report, f, indent=1)
+        return 0
     if not args.jsonl:
         ap.error("give an event-log path, --prom SNAPSHOT_JSON, "
                  "--health PATH, --serve STATS_JSON, --store STATS_JSON, "
                  "--kernels SNAPSHOT_JSON, --exchange SNAPSHOT_JSON, "
                  "--cluster MEMBERS_JSON, --quality SNAPSHOT_JSON, "
                  "--resources SNAPSHOT_JSON, --device SNAPSHOT_JSON, "
-                 "or --online SNAPSHOT_JSON")
+                 "--ingest SNAPSHOT_JSON, or --online SNAPSHOT_JSON")
 
     report = summarize(read_jsonl(args.jsonl))
     print(json.dumps(report, indent=1))
